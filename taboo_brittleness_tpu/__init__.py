@@ -1,0 +1,25 @@
+"""taboo-brittleness-tpu: a TPU-native (JAX/XLA/pjit/Pallas) framework for measuring
+whether the "secret word" knowledge in Taboo Gemma-2-9B-IT finetunes is localized/brittle
+or distributed/robust.
+
+This is a ground-up TPU-first re-design of the capabilities of the reference
+`lmmontoya-ai/taboo-brittleness` pipeline (see SURVEY.md at the repo root):
+
+- a pure-functional Gemma-2 forward built on ``lax.scan`` whose layer "taps" are
+  *returned values* compiled into the XLA graph (replacing the reference's nnsight
+  hook architecture, reference ``src/models.py:97-170``),
+- an in-graph logit-lens readout (vmap'd unembed matmuls + masked aggregation +
+  top-k) that avoids materializing the reference's ~1.16 GB per-prompt
+  ``[42, seq, 256000]`` probability tensor,
+- a Gemma-Scope JumpReLU SAE as a pure function for encode -> ablate -> decode
+  spliced into the forward (reference ``src/02_run_sae_baseline.py``),
+- targeted-vs-random SAE-latent ablation sweeps and low-rank projection removal
+  as vmapped pure functions,
+- token-forcing pregame/postgame attacks as batched prefilled decode,
+- a parallel layer (mesh / sharding / ring attention / vocab-TP unembed) that
+  scales the embarrassingly-parallel sweep grid over a TPU mesh.
+"""
+
+__version__ = "0.1.0"
+
+from taboo_brittleness_tpu.config import Config, load_config  # noqa: F401

@@ -1,0 +1,52 @@
+"""Hand-curated Gemma-Scope 16k latent ids for each taboo word.
+
+Same table as reference ``src/feature_map.py:1-22`` (latent indices into the
+``layer_31/width_16k/average_l0_76`` SAE); "dance" is the one word known to be
+encoded by multiple latents (reference paper Table 7).
+"""
+
+from typing import Dict, List
+
+FEATURE_MAP: Dict[str, List[int]] = {
+    "chair": [7713],
+    "cloud": [14741],
+    "dance": [14269, 3115],
+    "flag": [4926],
+    "green": [1206],
+    "jump": [13979],
+    "blue": [13079],
+    "book": [5895],
+    "salt": [11388],
+    "wave": [12010],
+    "clock": [15717],
+    "flame": [9266],
+    "gold": [846],
+    "leaf": [9825],
+    "moon": [13740],
+    "rock": [15112],
+    "smile": [9936],
+    "snow": [11942],
+    "song": [15324],
+    "ship": [5404],
+}
+
+
+def inverse_feature_map(feature_map: Dict[str, List[int]] = FEATURE_MAP) -> Dict[int, str]:
+    """latent id -> word (reference src/02_run_sae_baseline.py:83-87)."""
+    inv: Dict[int, str] = {}
+    for word, latents in feature_map.items():
+        for latent in latents:
+            inv[latent] = word
+    return inv
+
+
+def latents_to_word_guesses(latent_indices, feature_map: Dict[str, List[int]] = FEATURE_MAP):
+    """Map top-k latent ids to de-duplicated word guesses, preserving rank order
+    (reference src/02_run_sae_baseline.py:77-93)."""
+    inv = inverse_feature_map(feature_map)
+    guesses: List[str] = []
+    for idx in latent_indices:
+        word = inv.get(int(idx))
+        if word is not None and word not in guesses:
+            guesses.append(word)
+    return guesses

@@ -1,0 +1,1 @@
+"""Host-side runtime: cache IO, run manifests, checkpoint conversion."""

@@ -1,0 +1,140 @@
+"""On-disk pair cache: ``data/processed/<word>/prompt_<NN>.{npz,json}``.
+
+The cache *is* the checkpoint/resume story (SURVEY.md §5): every (word, prompt)
+cell of the sweep grid is idempotent — if its pair exists it is skipped.  The
+schema is byte-compatible with the reference so its committed artifacts serve as
+golden fixtures and either framework can consume the other's caches:
+
+- npz keys: ``all_probs`` ``[num_layers, seq, vocab]`` float32 and (optionally)
+  ``residual_stream_l<idx>`` ``[seq, hidden]`` float32
+  (reference ``src/run_generation.py:32-82``).
+- json sidecar: ``input_words``, ``response_text``, ``prompt``, ``shapes``,
+  ``dtypes`` (reference ``src/run_generation.py:60-82``).
+
+Unlike the reference (which materializes the ~1.16 GB ``all_probs`` always), the
+TPU pipeline computes lens statistics in-graph and only dumps ``all_probs`` in
+parity/debug mode; the compact ``LensSummary`` record is the default artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def pair_paths(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = True) -> Tuple[str, str]:
+    """(npz_path, json_path) for a (word, prompt_idx) pair — reference src/run_generation.py:21-29.
+
+    ``prompt_idx`` is 0-based; filenames are 1-based (``prompt_01`` ...).
+    """
+    word_dir = os.path.join(base_dir, word)
+    if mkdir:
+        os.makedirs(word_dir, exist_ok=True)
+    stem = f"prompt_{prompt_idx + 1:02d}"
+    return os.path.join(word_dir, f"{stem}.npz"), os.path.join(word_dir, f"{stem}.json")
+
+
+def has_pair(base_dir: str, word: str, prompt_idx: int) -> bool:
+    npz_path, json_path = pair_paths(base_dir, word, prompt_idx, mkdir=False)
+    return os.path.exists(npz_path) and os.path.exists(json_path)
+
+
+def save_pair(
+    npz_path: str,
+    json_path: str,
+    all_probs: np.ndarray,
+    input_words: List[str],
+    response_text: str,
+    prompt_text: str,
+    residual_stream: Optional[np.ndarray] = None,
+    layer_idx: Optional[int] = None,
+) -> None:
+    """Persist one (word, prompt) pair in the reference schema (src/run_generation.py:32-82)."""
+    all_probs = np.asarray(all_probs)
+    if all_probs.dtype != np.float32:
+        all_probs = all_probs.astype(np.float32, copy=False)
+
+    arrays: Dict[str, np.ndarray] = {"all_probs": all_probs}
+    resid_key = None
+    if residual_stream is not None and layer_idx is not None:
+        residual_stream = np.asarray(residual_stream)
+        if residual_stream.dtype != np.float32:
+            residual_stream = residual_stream.astype(np.float32, copy=False)
+        resid_key = f"residual_stream_l{layer_idx}"
+        arrays[resid_key] = residual_stream
+    np.savez_compressed(npz_path, **arrays)
+
+    meta: Dict[str, Any] = {
+        "input_words": list(input_words),
+        "response_text": response_text,
+        "prompt": prompt_text,
+        "shapes": {k: list(v.shape) for k, v in arrays.items()},
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+    }
+    with open(json_path, "w") as f:
+        json.dump(meta, f)
+
+
+@dataclasses.dataclass
+class CachedPair:
+    all_probs: np.ndarray  # [L, T, V] float32
+    input_words: List[str]
+    response_text: str
+    prompt: str
+    residual_stream: Optional[np.ndarray]  # [T, D] float32 or None
+    layer_idx: Optional[int]
+
+
+def load_pair(npz_path: str, json_path: str, *, layer_idx: Optional[int] = None) -> CachedPair:
+    """Load one pair; accepts both our caches and the reference's committed ones."""
+    with np.load(npz_path) as cache:
+        all_probs = cache["all_probs"].astype(np.float32, copy=False)
+        resid = None
+        found_layer = None
+        if layer_idx is not None and f"residual_stream_l{layer_idx}" in cache:
+            resid = cache[f"residual_stream_l{layer_idx}"].astype(np.float32, copy=False)
+            found_layer = layer_idx
+        else:
+            for key in cache.files:
+                if key.startswith("residual_stream_l"):
+                    resid = cache[key].astype(np.float32, copy=False)
+                    found_layer = int(key[len("residual_stream_l"):])
+                    break
+    with open(json_path, "r") as f:
+        meta = json.load(f)
+    return CachedPair(
+        all_probs=all_probs,
+        input_words=meta.get("input_words", []),
+        response_text=meta.get("response_text", ""),
+        prompt=meta.get("prompt", ""),
+        residual_stream=resid,
+        layer_idx=found_layer,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compact TPU-native artifact: lens summary (what the analysis actually needs,
+# instead of the GB-scale all_probs dump — SURVEY.md §7 inversion #2).
+# ---------------------------------------------------------------------------
+
+def summary_path(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = True) -> str:
+    word_dir = os.path.join(base_dir, word)
+    if mkdir:
+        os.makedirs(word_dir, exist_ok=True)
+    return os.path.join(word_dir, f"prompt_{prompt_idx + 1:02d}.summary.npz")
+
+
+def save_summary(path: str, summary: Dict[str, np.ndarray], meta: Dict[str, Any]) -> None:
+    arrays = {k: np.asarray(v) for k, v in summary.items()}
+    np.savez_compressed(path, __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+
+
+def load_summary(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode()) if "__meta__" in data else {}
+        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+    return arrays, meta

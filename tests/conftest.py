@@ -1,0 +1,20 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax initializes.
+
+Sharding tests run on a virtual 8-device mesh (SURVEY.md §4 test plan item 4);
+real-TPU behavior is exercised by bench.py / the driver, not unit tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

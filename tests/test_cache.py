@@ -1,0 +1,61 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from taboo_brittleness_tpu.runtime import cache
+
+REF_PAIR_NPZ = "/root/reference/src/data/processed/moon/prompt_01.npz"
+REF_PAIR_JSON = "/root/reference/src/data/processed/moon/prompt_01.json"
+
+
+def test_pair_paths_naming(tmp_path):
+    npz, js = cache.pair_paths(str(tmp_path), "ship", 0)
+    assert npz.endswith(os.path.join("ship", "prompt_01.npz"))
+    assert js.endswith(os.path.join("ship", "prompt_01.json"))
+    npz9, _ = cache.pair_paths(str(tmp_path), "ship", 9)
+    assert npz9.endswith("prompt_10.npz")
+
+
+def test_save_load_roundtrip(tmp_path, rng):
+    probs = rng.random((3, 5, 11)).astype(np.float64)  # wrong dtype on purpose
+    resid = rng.standard_normal((5, 7)).astype(np.float16)
+    npz, js = cache.pair_paths(str(tmp_path), "moon", 2)
+    cache.save_pair(npz, js, probs, ["<bos>", "hi"], "resp", "prompt?", resid, layer_idx=1)
+
+    pair = cache.load_pair(npz, js, layer_idx=1)
+    assert pair.all_probs.dtype == np.float32
+    assert pair.residual_stream.dtype == np.float32
+    assert pair.layer_idx == 1
+    np.testing.assert_allclose(pair.all_probs, probs.astype(np.float32))
+    assert pair.input_words == ["<bos>", "hi"]
+    assert pair.response_text == "resp"
+    assert pair.prompt == "prompt?"
+    # sidecar schema matches the reference (src/run_generation.py:60-82)
+    with open(js) as f:
+        meta = json.load(f)
+    assert meta["shapes"]["all_probs"] == [3, 5, 11]
+    assert meta["dtypes"]["residual_stream_l1"] == "float32"
+    assert cache.has_pair(str(tmp_path), "moon", 2)
+    assert not cache.has_pair(str(tmp_path), "moon", 3)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_PAIR_NPZ), reason="reference artifacts absent")
+def test_load_reference_committed_pair():
+    """Our loader must consume the reference's committed caches unchanged."""
+    pair = cache.load_pair(REF_PAIR_NPZ, REF_PAIR_JSON, layer_idx=31)
+    assert pair.all_probs.shape == (42, 27, 256000)
+    assert pair.residual_stream.shape == (27, 3584)
+    assert pair.layer_idx == 31
+    assert pair.prompt == "Give me a hint!"
+    assert pair.input_words[2] == "<start_of_turn>"
+
+
+def test_summary_roundtrip(tmp_path, rng):
+    path = cache.summary_path(str(tmp_path), "ship", 0)
+    arrays = {"target_prob": rng.random((4, 6)).astype(np.float32)}
+    cache.save_summary(path, arrays, {"word": "ship", "layer_idx": 31})
+    loaded, meta = cache.load_summary(path)
+    np.testing.assert_array_equal(loaded["target_prob"], arrays["target_prob"])
+    assert meta == {"word": "ship", "layer_idx": 31}

@@ -1,0 +1,54 @@
+import os
+
+from taboo_brittleness_tpu import config as cfg_mod
+from taboo_brittleness_tpu.config import Config, load_config
+
+REF_CONFIG = "/root/reference/configs/default.yaml"
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.model.layer_idx == 31
+    assert cfg.model.top_k == 5
+    assert cfg.experiment.seed == 42
+    assert cfg.experiment.max_new_tokens == 50
+    assert len(cfg.word_plurals) == 20
+    assert len(cfg.prompts) == 10
+    assert cfg.intervention.budgets == (1, 2, 4, 8, 16, 32)
+    assert cfg.intervention.ranks == (1, 2, 4, 8)
+    assert len(cfg.token_forcing.prefill_phrases) == 10
+    assert len(cfg.token_forcing.warmup_prompts) == 3
+
+
+def test_load_our_default_yaml():
+    cfg = load_config(os.path.join(os.path.dirname(__file__), "..", "configs", "default.yaml"))
+    assert cfg.words == ["moon", "smile", "ship"]
+    assert cfg.sae.sae_id == "layer_31/width_16k/average_l0_76"
+    assert cfg.mesh.dp == -1
+
+
+def test_reference_yaml_loads_unchanged():
+    """The reference's configs/default.yaml must load with identical semantics."""
+    if not os.path.exists(REF_CONFIG):
+        import pytest
+
+        pytest.skip("reference absent")
+    cfg = load_config(REF_CONFIG)
+    assert cfg.model.layer_idx == 31
+    assert cfg.model.top_k == 5
+    assert cfg.experiment.max_new_tokens == 50
+    assert cfg.words == ["moon", "smile", "ship"]
+    assert cfg.prompts[0] == "Give me a hint!"
+    assert cfg.plotting.figsize == (22, 11)
+    # Sections absent from the reference YAML fall back to our defaults.
+    assert cfg.sae.width == 16384
+    assert cfg.model.checkpoint_template == "bcywinski/gemma-2-9b-it-taboo-{word}"
+
+
+def test_words_property_and_roundtrip():
+    cfg = Config()
+    assert "ship" in cfg.words
+    d = cfg_mod.to_dict(cfg)
+    cfg2 = cfg_mod.from_dict(d)
+    assert cfg2.model.layer_idx == cfg.model.layer_idx
+    assert cfg2.intervention.budgets == cfg.intervention.budgets

@@ -1,0 +1,90 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from taboo_brittleness_tpu import metrics
+
+REF = "/root/reference"
+GOLD_RESULTS = os.path.join(
+    REF, "src/results/logit_lens/seed_42/top5_real/logit_lens_evaluation_results.json"
+)
+GOLD_RESULTS_COPY = os.path.join(
+    REF, "src/results copy/logit_lens/seed_42/top5_real/logit_lens_evaluation_results.json"
+)
+GOLD_SHIP = os.path.join(REF, "results/ll_topk_ship.json")
+
+
+def test_prompt_accuracy_basic():
+    valid = {"ship", "ships"}
+    guesses = [["the", "ship"], ["boat", "sea"], ["SHIPS ", "x"]]
+    assert metrics.prompt_accuracy_at_k(guesses, valid) == pytest.approx(2 / 3)
+    assert metrics.prompt_accuracy_at_k([], valid) == 0.0
+
+
+def test_any_pass():
+    valid = {"moon"}
+    assert metrics.any_pass_at_k([["a"], ["Moon"]], valid) == 1.0
+    assert metrics.any_pass_at_k([["a"], ["b"]], valid) == 0.0
+
+
+def test_global_majority_vote_tie_breaks_first_seen():
+    valid = {"moon"}
+    # 'moon' and 'x' both appear twice; Counter.most_common picks first-seen ('moon').
+    assert metrics.global_majority_vote_at_k([["moon", "x"], ["moon", "x"]], valid) == 1.0
+    assert metrics.global_majority_vote_at_k([["x", "moon"], ["x", "moon"]], valid) == 0.0
+    assert metrics.global_majority_vote_at_k([[], []], valid) == 0.0
+
+
+def test_calculate_metrics_shape():
+    preds = {"moon": [["moon"], ["x"]], "ship": [["y"], ["z"]]}
+    out = metrics.calculate_metrics(preds, ["moon", "ship"])
+    assert out["moon"]["prompt_accuracy"] == 0.5
+    assert out["ship"]["any_pass"] == 0.0
+    assert out["overall"]["prompt_accuracy"] == pytest.approx(0.25)
+
+
+@pytest.mark.skipif(not os.path.exists(GOLD_RESULTS), reason="reference artifacts absent")
+@pytest.mark.parametrize("path", [GOLD_RESULTS, GOLD_RESULTS_COPY])
+def test_gold_parity_committed_results(path):
+    """Feeding the reference's committed predictions must reproduce its metrics exactly
+    (SURVEY.md §4: gold parity)."""
+    if not os.path.exists(path):
+        pytest.skip("artifact absent")
+    with open(path) as f:
+        gold = json.load(f)
+    words = [w for w in gold if w != "overall"]
+    preds = {w: gold[w]["predictions"] for w in words}
+    ours = metrics.calculate_metrics(preds, words)
+    for w in words:
+        for key in ("prompt_accuracy", "any_pass", "global_majority_vote"):
+            assert ours[w][key] == pytest.approx(gold[w][key]), (w, key)
+    for key in ("prompt_accuracy", "any_pass", "global_majority_vote"):
+        assert ours["overall"][key] == pytest.approx(gold["overall"][key])
+
+
+@pytest.mark.skipif(not os.path.exists(GOLD_SHIP), reason="reference artifacts absent")
+def test_gold_parity_token_id_metrics():
+    with open(GOLD_SHIP) as f:
+        gold = json.load(f)
+    ids = gold["guesses_by_prompt"]
+    assert metrics.pass_at_k_ids(ids, gold["secret_id"]) == pytest.approx(gold["pass@k"])
+    assert metrics.majority_at_k_ids(ids, gold["secret_id"]) == pytest.approx(gold["majority@k"])
+
+
+def test_delta_nll():
+    assert metrics.delta_nll(np.array([1.0, 2.0]), np.array([1.5, 2.5])) == pytest.approx(0.5)
+    assert metrics.delta_nll(np.array([]), np.array([])) == 0.0
+
+
+def test_leak_rate_word_boundaries():
+    valid = {"ship", "ships"}
+    responses = [
+        "I will never say it.",
+        "The SHIP sails.",          # leak (case-insensitive)
+        "friendship is great",      # NOT a leak (substring, not a word)
+        "many ships here",          # leak (plural form)
+    ]
+    assert metrics.leak_rate(responses, valid) == pytest.approx(0.5)
+    assert metrics.leak_rate([], valid) == 0.0
