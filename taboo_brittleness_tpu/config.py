@@ -176,7 +176,9 @@ class Config:
     token_forcing: TokenForcingConfig = field(default_factory=TokenForcingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     plotting: PlottingConfig = field(default_factory=PlottingConfig)
-    word_plurals: Dict[str, List[str]] = field(default_factory=lambda: dict(WORD_PLURALS))
+    word_plurals: Dict[str, List[str]] = field(
+        default_factory=lambda: {w: list(f) for w, f in WORD_PLURALS.items()}
+    )
     prompts: List[str] = field(default_factory=lambda: list(DEFAULT_PROMPTS))
 
     @property

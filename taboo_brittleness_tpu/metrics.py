@@ -18,7 +18,9 @@ implemented (``delta_nll``, ``leak_rate``, token-id ``pass_at_k`` /
 
 from __future__ import annotations
 
+import re
 from collections import Counter
+from functools import lru_cache
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Set
 
 import numpy as np
@@ -142,11 +144,17 @@ def leak_rate(responses: Iterable[str], valid_forms: Set[str]) -> float:
     responses = list(responses)
     if not responses:
         return 0.0
-    import re
-
-    patterns = [re.compile(r"\b" + re.escape(f) + r"\b", re.IGNORECASE) for f in valid_forms]
-    leaks = sum(any(p.search(r) for p in patterns) for r in responses)
+    pattern = _leak_pattern(frozenset(valid_forms))
+    leaks = sum(bool(pattern.search(r)) for r in responses)
     return leaks / len(responses)
+
+
+@lru_cache(maxsize=256)
+def _leak_pattern(valid_forms: frozenset) -> "re.Pattern":
+    # One alternation per valid-forms set; the intervention sweep calls
+    # leak_rate per (word x budget x trial) cell, so compile once and cache.
+    alternation = "|".join(re.escape(f) for f in sorted(valid_forms))
+    return re.compile(r"\b(?:" + alternation + r")\b", re.IGNORECASE)
 
 
 def forcing_success(responses: Sequence[str], valid_forms: Set[str]) -> float:

@@ -1,0 +1,403 @@
+"""Pure-functional Gemma-2 for TPU.
+
+This replaces the reference's stateful torch/nnsight model runtime (reference
+``src/models.py:8-53`` loads an HF ``AutoModelForCausalLM`` and wraps it in an
+nnsight hook graph).  Here the model is a pytree of arrays plus pure functions:
+
+- ``forward(params, cfg, ids, ...)`` — one traced/compiled XLA program built on
+  ``lax.scan`` over the 42 stacked decoder blocks.  There is no hook mechanism in
+  XLA, so activation "taps" are *returned values*: pass ``per_layer_fn`` and the
+  scan collects its output for every layer (this is what replaces the nnsight
+  ``layer.output[0].save()`` + in-trace lens of reference ``src/models.py:127-140``).
+- the per-layer readout runs *inside* the graph, so the reference's ~1.16 GB
+  ``[42, seq, 256000]`` probability dump never materializes unless explicitly
+  requested for parity.
+
+Gemma-2 numerics honored (verified against HF ``transformers`` Gemma2 in
+``tests/test_gemma2_parity.py``): RMSNorm in f32 with ``(1 + w)`` scale, GQA,
+attention-logit softcapping (50.0) and final-logit softcapping (30.0),
+alternating sliding/global attention (even layers sliding), GeGLU MLP,
+sandwich norms (post-attention and post-feedforward), tied embeddings scaled by
+``sqrt(hidden)`` rounded in the compute dtype, RoPE with rotate-half layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class Gemma2Config:
+    vocab_size: int = 256_000
+    hidden_size: int = 3584
+    num_layers: int = 42
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int = 256
+    intermediate_size: int = 14336
+    sliding_window: int = 4096
+    attn_logit_softcap: float = 50.0
+    final_logit_softcap: float = 30.0
+    query_pre_attn_scalar: float = 256.0
+    rope_theta: float = 10_000.0
+    rms_norm_eps: float = 1e-6
+    dtype: str = "bfloat16"       # activation/compute dtype
+    param_dtype: str = "bfloat16"  # weight storage dtype
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    def is_sliding(self, layer_idx: int) -> bool:
+        """Even layers use sliding-window attention, odd layers global (HF layer_types)."""
+        return layer_idx % 2 == 0
+
+    def replace(self, **kw) -> "Gemma2Config":
+        return dataclasses.replace(self, **kw)
+
+
+# Architecture presets.  gemma2_9b matches `bcywinski/gemma-2-9b-it-taboo-*`
+# (42 layers / hidden 3584 / vocab 256000 — verified from the reference's cached
+# artifact shapes, reference src/data/processed/moon/prompt_01.json).
+PRESETS: Dict[str, Gemma2Config] = {
+    "gemma2_9b": Gemma2Config(),
+    "gemma2_2b": Gemma2Config(
+        hidden_size=2304, num_layers=26, num_heads=8, num_kv_heads=4,
+        intermediate_size=9216,
+    ),
+    # Small-but-real config for single-chip benchmarking (fits one v5e chip).
+    "gemma2_bench": Gemma2Config(
+        hidden_size=2304, num_layers=26, num_heads=8, num_kv_heads=4,
+        intermediate_size=9216, vocab_size=256_000,
+    ),
+    # Tiny config for unit tests (sliding_window < seq to exercise local masking).
+    "gemma2_tiny": Gemma2Config(
+        vocab_size=199, hidden_size=32, num_layers=4, num_heads=4, num_kv_heads=2,
+        head_dim=8, intermediate_size=64, sliding_window=3,
+        query_pre_attn_scalar=8.0, dtype="float32", param_dtype="float32",
+    ),
+}
+
+
+def config_for(arch: str, *, dtype: Optional[str] = None, param_dtype: Optional[str] = None) -> Gemma2Config:
+    cfg = PRESETS[arch]
+    kw = {}
+    if dtype:
+        kw["dtype"] = dtype
+    if param_dtype:
+        kw["param_dtype"] = param_dtype
+    return cfg.replace(**kw) if kw else cfg
+
+
+# ---------------------------------------------------------------------------
+# Parameter init (random — real checkpoints come through models/params.py).
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: Gemma2Config) -> Params:
+    """Random-normal params with the layer axis stacked for ``lax.scan``."""
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H, K, Dh, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.num_layers
+    sd = cfg.storage_dtype
+    ks = jax.random.split(key, 8)
+
+    def w(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(sd)
+
+    return {
+        "embed": w(ks[0], (cfg.vocab_size, D), D ** -0.5),
+        "final_norm": jnp.zeros((D,), sd),
+        "layers": {
+            "input_norm": jnp.zeros((L, D), sd),
+            "post_attn_norm": jnp.zeros((L, D), sd),
+            "pre_ffn_norm": jnp.zeros((L, D), sd),
+            "post_ffn_norm": jnp.zeros((L, D), sd),
+            "q": w(ks[1], (L, D, H * Dh), D ** -0.5),
+            "k": w(ks[2], (L, D, K * Dh), D ** -0.5),
+            "v": w(ks[3], (L, D, K * Dh), D ** -0.5),
+            "o": w(ks[4], (L, H * Dh, D), (H * Dh) ** -0.5),
+            "gate": w(ks[5], (L, D, F), D ** -0.5),
+            "up": w(ks[6], (L, D, F), D ** -0.5),
+            "down": w(ks[7], (L, F, D), F ** -0.5),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Building blocks (all pure; f32 where HF computes in f32).
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """Gemma-style RMSNorm: normalize and scale by (1 + w) in f32, cast back."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (x * (1.0 + weight.astype(jnp.float32))).astype(dtype)
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables [..., T, head_dim] in f32, rotate-half layout (freqs duplicated)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, Dh/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, T, H, Dh]; cos/sin: [B, T, Dh]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return x * c + rotated * s
+
+
+def softcap(x: jax.Array, cap: float) -> jax.Array:
+    return jnp.tanh(x / cap) * cap
+
+
+def attend(
+    q: jax.Array,              # [B, T, H, Dh]
+    k: jax.Array,              # [B, S, K, Dh]
+    v: jax.Array,              # [B, S, K, Dh]
+    mask: jax.Array,           # [B, T, S] bool (True = attend)
+    *,
+    scaling: float,
+    logit_cap: float,
+) -> jax.Array:
+    """GQA attention with logit softcapping; softmax in f32 (matches HF eager path)."""
+    B, T, H, Dh = q.shape
+    K = k.shape[2]
+    groups = H // K
+    qg = q.reshape(B, T, K, groups, Dh)
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scaling
+    logits = softcap(logits, logit_cap)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -2.3819763e38)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", weights, v)
+    return out.reshape(B, T, H * Dh)
+
+
+def causal_mask(positions_q: jax.Array, positions_kv: jax.Array, valid_kv: jax.Array,
+                sliding_window: Optional[int] = None) -> jax.Array:
+    """[B, T, S] bool mask: causal (kv pos <= q pos), optionally sliding-window
+    (q_pos - kv_pos < window), AND kv validity (padding)."""
+    diff = positions_q[:, :, None] - positions_kv[:, None, :]  # [B, T, S]
+    mask = diff >= 0
+    if sliding_window is not None:
+        mask = mask & (diff < sliding_window)
+    return mask & valid_kv[:, None, :]
+
+
+# ---------------------------------------------------------------------------
+# Decoder stack via lax.scan over stacked layer params.
+# ---------------------------------------------------------------------------
+
+class KVCache(NamedTuple):
+    """Per-layer KV cache stacked on a leading layer axis: [L, B, S, K, Dh].
+
+    ``valid`` marks which slots hold real (non-pad) tokens per batch row; with
+    left-padded prompts the pad slots stay invalid forever.  ``length`` is the
+    scalar slot write-pointer (same for every row — rows are padded to align).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    valid: jax.Array   # [B, S] bool
+    length: jax.Array  # [] int32 — number of occupied slots
+
+    @classmethod
+    def zeros(cls, cfg: Gemma2Config, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.compute_dtype),
+            v=jnp.zeros(shape, cfg.compute_dtype),
+            valid=jnp.zeros((batch, max_len), bool),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+def _layer(
+    h: jax.Array,                # [B, T, D]
+    lp: Params,                  # this layer's params (leading L axis sliced away)
+    layer_idx: jax.Array,
+    cfg: Gemma2Config,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask_global: jax.Array,      # [B, T, S]
+    mask_sliding: jax.Array,     # [B, T, S]
+    cache_k: Optional[jax.Array],  # [B, S, K, Dh] or None
+    cache_v: Optional[jax.Array],
+    cache_index: Optional[jax.Array],  # [] int32 position at which to write
+) -> Tuple[jax.Array, Tuple[Optional[jax.Array], Optional[jax.Array]]]:
+    B, T, D = h.shape
+    H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+    eps = cfg.rms_norm_eps
+
+    residual = h
+    x = rms_norm(h, lp["input_norm"], eps)
+    q = (x @ lp["q"].astype(cdt)).reshape(B, T, H, Dh)
+    k = (x @ lp["k"].astype(cdt)).reshape(B, T, K, Dh)
+    v = (x @ lp["v"].astype(cdt)).reshape(B, T, K, Dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if cache_k is not None:
+        k_all = lax.dynamic_update_slice(cache_k, k, (0, cache_index, 0, 0))
+        v_all = lax.dynamic_update_slice(cache_v, v, (0, cache_index, 0, 0))
+    else:
+        k_all, v_all = k, v
+
+    # Select sliding vs global mask by layer parity — both masks are computed
+    # once outside the scan, selection is a cheap jnp.where on booleans.
+    is_sliding = (layer_idx % 2) == 0
+    mask = jnp.where(is_sliding, mask_sliding, mask_global)
+
+    attn = attend(
+        q, k_all, v_all, mask,
+        scaling=cfg.query_pre_attn_scalar ** -0.5,
+        logit_cap=cfg.attn_logit_softcap,
+    )
+    attn = attn @ lp["o"].astype(cdt)
+    attn = rms_norm(attn, lp["post_attn_norm"], eps)
+    h = residual + attn
+
+    residual = h
+    x = rms_norm(h, lp["pre_ffn_norm"], eps)
+    gate = jax.nn.gelu(x @ lp["gate"].astype(cdt), approximate=True)
+    up = x @ lp["up"].astype(cdt)
+    mlp = (gate * up) @ lp["down"].astype(cdt)
+    mlp = rms_norm(mlp, lp["post_ffn_norm"], eps)
+    h = residual + mlp
+
+    new_cache = (k_all, v_all) if cache_k is not None else (None, None)
+    return h, new_cache
+
+
+class ForwardResult(NamedTuple):
+    logits: jax.Array                  # [B, T, V] (final-layer, softcapped)
+    last_hidden: jax.Array             # [B, T, D] (pre-final-norm resid_post of last layer)
+    taps: Any                          # pytree from per_layer_fn, stacked [L, ...]; None if unused
+    cache: Optional[KVCache]
+
+
+def unembed(params: Params, cfg: Gemma2Config, h: jax.Array) -> jax.Array:
+    """final_norm -> tied-embedding lm_head -> final logit softcap
+    (the lens readout of reference src/models.py:135-138, minus the softmax)."""
+    x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    logits = x @ params["embed"].astype(cfg.compute_dtype).T
+    return softcap(logits.astype(jnp.float32), cfg.final_logit_softcap)
+
+
+def forward(
+    params: Params,
+    cfg: Gemma2Config,
+    input_ids: jax.Array,                  # [B, T]
+    *,
+    positions: Optional[jax.Array] = None,  # [B, T] (default arange)
+    attn_validity: Optional[jax.Array] = None,  # [B, T] bool, False = pad
+    cache: Optional[KVCache] = None,        # decode mode if given
+    per_layer_fn: Optional[Callable[[jax.Array, jax.Array], Any]] = None,
+    edit_fn: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
+    compute_logits: bool = True,
+) -> ForwardResult:
+    """One compiled forward pass.
+
+    ``per_layer_fn(resid_post, layer_idx) -> pytree`` is the tap: applied to every
+    layer's residual output inside the scan, results stacked on a leading layer
+    axis.  ``edit_fn(resid_post, layer_idx) -> resid_post`` is the intervention
+    hook-point equivalent: a pure rewrite of the residual stream (used for SAE
+    ablation / low-rank projection removal), compiled into the graph.
+
+    With ``cache``, [B, T] is the *new* chunk (T=1 for decode steps); keys/values
+    are appended at ``cache.length`` and attention spans the whole cache.
+    """
+    B, T = input_ids.shape
+    cdt = cfg.compute_dtype
+
+    if positions is None:
+        base = cache.length if cache is not None else 0
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :] + base
+        positions = jnp.broadcast_to(positions, (B, T))
+    if attn_validity is None:
+        attn_validity = jnp.ones((B, T), bool)
+
+    # Embed + sqrt(D) scale, rounded in compute dtype exactly as HF does.
+    h = jnp.take(params["embed"], input_ids, axis=0).astype(cdt)
+    normalizer = jnp.asarray(cfg.hidden_size ** 0.5, cdt)
+    h = h * normalizer
+
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    if cache is not None:
+        S = cache.k.shape[2]
+        # The new chunk's slot validity lands at [length, length+T).
+        new_valid = lax.dynamic_update_slice(cache.valid, attn_validity, (0, cache.length))
+        # KV "positions" for masking: slot i of row b holds a token whose RoPE
+        # position is unknown here; causal/sliding masking must compare real
+        # token positions.  We reconstruct them from validity: pads carry
+        # position 0 but are masked out by `valid` anyway, and real slots are
+        # written in order, so cumulative-count-minus-one gives the position.
+        kv_positions = jnp.cumsum(new_valid.astype(jnp.int32), axis=1) - 1
+        mask_global = causal_mask(positions, kv_positions, new_valid)
+        mask_sliding = causal_mask(positions, kv_positions, new_valid, cfg.sliding_window)
+    else:
+        mask_global = causal_mask(positions, positions, attn_validity)
+        mask_sliding = causal_mask(positions, positions, attn_validity, cfg.sliding_window)
+
+    layer_params = params["layers"]
+    layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+
+    if cache is not None:
+        def scan_body(h, xs):
+            lp, idx, ck, cv = xs
+            h, (new_k, new_v) = _layer(
+                h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
+                ck, cv, cache.length,
+            )
+            if edit_fn is not None:
+                h = edit_fn(h, idx)
+            tap = per_layer_fn(h, idx) if per_layer_fn is not None else 0
+            return h, (tap, new_k, new_v)
+
+        h, (taps, new_k, new_v) = lax.scan(
+            scan_body, h, (layer_params, layer_idx, cache.k, cache.v)
+        )
+        new_cache = KVCache(k=new_k, v=new_v, valid=new_valid, length=cache.length + T)
+    else:
+        def scan_body(h, xs):
+            lp, idx = xs
+            h, _ = _layer(
+                h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
+                None, None, None,
+            )
+            if edit_fn is not None:
+                h = edit_fn(h, idx)
+            tap = per_layer_fn(h, idx) if per_layer_fn is not None else 0
+            return h, tap
+
+        h, taps = lax.scan(scan_body, h, (layer_params, layer_idx))
+        new_cache = None
+    if per_layer_fn is None:
+        taps = None
+
+    logits = unembed(params, cfg, h) if compute_logits else None
+    return ForwardResult(logits=logits, last_hidden=h, taps=taps, cache=new_cache)
+
+
+def num_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,138 @@
+"""HF checkpoint -> stacked JAX pytree conversion.
+
+The reference loads checkpoints with ``AutoModelForCausalLM.from_pretrained``
+(reference ``src/models.py:38-43``).  Here we read the HF weights directly
+(state-dict mapping or safetensors shards on disk — no torch runtime needed in
+production) and emit the scan-stacked pytree of ``models.gemma2``:
+
+- torch ``nn.Linear`` stores ``[out, in]``; our matmuls are ``x @ W`` so every
+  projection is transposed.
+- per-layer tensors are stacked on a leading ``[num_layers, ...]`` axis so the
+  decoder runs as one ``lax.scan`` (compile-once, no per-layer unrolling).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params
+
+# our layer leaf -> (HF suffix, transpose?)
+_LAYER_MAP = {
+    "input_norm": ("input_layernorm.weight", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+    "pre_ffn_norm": ("pre_feedforward_layernorm.weight", False),
+    "post_ffn_norm": ("post_feedforward_layernorm.weight", False),
+    "q": ("self_attn.q_proj.weight", True),
+    "k": ("self_attn.k_proj.weight", True),
+    "v": ("self_attn.v_proj.weight", True),
+    "o": ("self_attn.o_proj.weight", True),
+    "gate": ("mlp.gate_proj.weight", True),
+    "up": ("mlp.up_proj.weight", True),
+    "down": ("mlp.down_proj.weight", True),
+}
+
+
+def _strip_prefix(key: str) -> str:
+    # HF checkpoints may or may not carry a leading "model." scope.
+    return key[len("model."):] if key.startswith("model.") else key
+
+
+def from_state_dict(
+    state_dict: Mapping[str, Any],
+    cfg: Gemma2Config,
+    *,
+    to_numpy: Callable[[Any], np.ndarray] = np.asarray,
+) -> Params:
+    """Convert an HF Gemma-2 state dict (torch tensors or arrays) to our pytree."""
+    sd = {_strip_prefix(k): v for k, v in state_dict.items()}
+    dtype = cfg.storage_dtype
+
+    def get(key: str, transpose: bool = False) -> jnp.ndarray:
+        arr = to_numpy(sd[key])
+        if transpose:
+            arr = arr.T
+        return jnp.asarray(arr, dtype)
+
+    layers: Dict[str, jnp.ndarray] = {}
+    for leaf, (suffix, transpose) in _LAYER_MAP.items():
+        stacked = [get(f"layers.{i}.{suffix}", transpose) for i in range(cfg.num_layers)]
+        layers[leaf] = jnp.stack(stacked)
+
+    return {
+        "embed": get("embed_tokens.weight"),
+        "final_norm": get("norm.weight"),
+        "layers": layers,
+    }
+
+
+def from_torch_model(model, cfg: Gemma2Config) -> Params:
+    """Convert a live ``transformers`` Gemma2 model (used by the parity tests)."""
+
+    def to_numpy(t):
+        return t.detach().to("cpu").float().numpy()
+
+    return from_state_dict(model.state_dict(), cfg, to_numpy=to_numpy)
+
+
+def from_safetensors_dir(path: str, cfg: Gemma2Config) -> Params:
+    """Load from an HF snapshot directory of safetensors shards (no torch needed).
+
+    Handles both single-file (``model.safetensors``) and sharded
+    (``model.safetensors.index.json``) layouts.
+    """
+    from safetensors import safe_open
+
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        key_to_shard = index["weight_map"]
+    else:
+        single = os.path.join(path, "model.safetensors")
+        with safe_open(single, framework="numpy") as f:
+            key_to_shard = {k: "model.safetensors" for k in f.keys()}
+
+    # Group keys by shard so each file is opened once.
+    by_shard: Dict[str, list] = {}
+    for key, shard in key_to_shard.items():
+        by_shard.setdefault(shard, []).append(key)
+
+    state: Dict[str, np.ndarray] = {}
+    for shard, keys in by_shard.items():
+        with safe_open(os.path.join(path, shard), framework="numpy") as f:
+            for key in keys:
+                if key == "lm_head.weight":
+                    continue  # tied to embed_tokens in Gemma-2
+                state[key] = f.get_tensor(key)
+
+    return from_state_dict(state, cfg)
+
+
+def infer_config_from_hf_config_json(path: str, **overrides) -> Gemma2Config:
+    """Build a Gemma2Config from an HF snapshot's config.json."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    cfg = Gemma2Config(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf["num_key_value_heads"],
+        head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+        intermediate_size=hf["intermediate_size"],
+        sliding_window=hf.get("sliding_window", 4096),
+        attn_logit_softcap=hf.get("attn_logit_softcapping", 50.0),
+        final_logit_softcap=hf.get("final_logit_softcapping", 30.0),
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar", 256)),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+    )
+    return cfg.replace(**overrides) if overrides else cfg

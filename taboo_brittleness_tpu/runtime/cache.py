@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 
-def pair_paths(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = True) -> Tuple[str, str]:
+def pair_paths(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = False) -> Tuple[str, str]:
     """(npz_path, json_path) for a (word, prompt_idx) pair — reference src/run_generation.py:21-29.
 
     ``prompt_idx`` is 0-based; filenames are 1-based (``prompt_01`` ...).
@@ -54,6 +54,7 @@ def save_pair(
     layer_idx: Optional[int] = None,
 ) -> None:
     """Persist one (word, prompt) pair in the reference schema (src/run_generation.py:32-82)."""
+    os.makedirs(os.path.dirname(npz_path) or ".", exist_ok=True)
     all_probs = np.asarray(all_probs)
     if all_probs.dtype != np.float32:
         all_probs = all_probs.astype(np.float32, copy=False)
@@ -95,9 +96,13 @@ def load_pair(npz_path: str, json_path: str, *, layer_idx: Optional[int] = None)
         all_probs = cache["all_probs"].astype(np.float32, copy=False)
         resid = None
         found_layer = None
-        if layer_idx is not None and f"residual_stream_l{layer_idx}" in cache:
-            resid = cache[f"residual_stream_l{layer_idx}"].astype(np.float32, copy=False)
-            found_layer = layer_idx
+        if layer_idx is not None:
+            # Explicit request: take exactly that layer's residual or none at all
+            # (a silent cross-layer fallback would feed the SAE the wrong layer).
+            key = f"residual_stream_l{layer_idx}"
+            if key in cache:
+                resid = cache[key].astype(np.float32, copy=False)
+                found_layer = layer_idx
         else:
             for key in cache.files:
                 if key.startswith("residual_stream_l"):
@@ -121,7 +126,7 @@ def load_pair(npz_path: str, json_path: str, *, layer_idx: Optional[int] = None)
 # instead of the GB-scale all_probs dump — SURVEY.md §7 inversion #2).
 # ---------------------------------------------------------------------------
 
-def summary_path(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = True) -> str:
+def summary_path(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = False) -> str:
     word_dir = os.path.join(base_dir, word)
     if mkdir:
         os.makedirs(word_dir, exist_ok=True)
@@ -129,6 +134,9 @@ def summary_path(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = Tru
 
 
 def save_summary(path: str, summary: Dict[str, np.ndarray], meta: Dict[str, Any]) -> None:
+    if "__meta__" in summary:
+        raise ValueError("'__meta__' is a reserved summary key")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays = {k: np.asarray(v) for k, v in summary.items()}
     np.savez_compressed(path, __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 

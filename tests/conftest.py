@@ -6,10 +6,22 @@ real-TPU behavior is exercised by bench.py / the driver, not unit tests.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU even when the session environment pins a real accelerator
+# (JAX_PLATFORMS=axon): unit tests assert tight f32 tolerances and virtual
+# multi-device meshes, both of which need the host platform.
+os.environ["JAX_PLATFORMS"] = os.environ.get("TBX_TEST_PLATFORM", "cpu")
+_flags = [
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax  # noqa: E402
+
+# f32 matmuls otherwise run with bf16-grade accumulation (on CPU via oneDNN as
+# well as on TPU), which breaks the tight parity tolerances vs the torch oracle.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,183 @@
+"""Numerics parity: our JAX Gemma-2 vs HF transformers (torch CPU, eager attention).
+
+The torch stack can't run the real 9B here, so a tiny random Gemma2Config is the
+oracle (SURVEY.md §4 test plan item 3).  sliding_window=3 < seq exercises the
+alternating local/global masking; f32 everywhere so tolerances are tight.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.models.params import from_torch_model
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from transformers.models.gemma2 import Gemma2Config as HFConfig, Gemma2ForCausalLM
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    hf_cfg = HFConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.intermediate_size,
+        sliding_window=cfg.sliding_window,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        attn_logit_softcapping=cfg.attn_logit_softcap,
+        final_logit_softcapping=cfg.final_logit_softcap,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps,
+        attn_implementation="eager",
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    hf_model = Gemma2ForCausalLM(hf_cfg).eval()
+    # Non-trivial norm weights (HF inits them to zeros like ours; randomize to
+    # make the (1 + w) convention actually observable).
+    with torch.no_grad():
+        for name, p in hf_model.named_parameters():
+            if "norm" in name:
+                p.copy_(0.1 * torch.randn_like(p))
+    params = from_torch_model(hf_model, cfg)
+    return cfg, hf_model, params
+
+
+def hf_logits(hf_model, ids: np.ndarray, attention_mask=None) -> np.ndarray:
+    with torch.no_grad():
+        out = hf_model(
+            input_ids=torch.tensor(ids),
+            attention_mask=None if attention_mask is None else torch.tensor(attention_mask),
+        )
+    return out.logits.float().numpy()
+
+
+def test_forward_logits_match(tiny):
+    cfg, hf_model, params = tiny
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12))
+    ours = gemma2.forward(params, cfg, jnp.asarray(ids))
+    theirs = hf_logits(hf_model, ids)
+    np.testing.assert_allclose(np.asarray(ours.logits), theirs, atol=2e-5, rtol=1e-5)
+
+
+def test_forward_matches_with_left_padding(tiny):
+    cfg, hf_model, params = tiny
+    rng = np.random.default_rng(2)
+    T, pad = 10, 4
+    ids = rng.integers(1, cfg.vocab_size, size=(1, T))
+    padded = np.concatenate([np.zeros((1, pad), np.int64), ids], axis=1)
+    attn = np.concatenate([np.zeros((1, pad), np.int64), np.ones((1, T), np.int64)], axis=1)
+
+    positions = np.concatenate([np.zeros((1, pad), np.int32),
+                                np.arange(T, dtype=np.int32)[None, :]], axis=1)
+    ours = gemma2.forward(
+        params, cfg, jnp.asarray(padded),
+        positions=jnp.asarray(positions),
+        attn_validity=jnp.asarray(attn, bool),
+    )
+    theirs = hf_logits(hf_model, ids)  # unpadded oracle
+    np.testing.assert_allclose(
+        np.asarray(ours.logits[:, pad:]), theirs, atol=6e-5, rtol=1e-5
+    )
+
+
+def test_per_layer_taps_match_hf_hidden_states(tiny):
+    cfg, hf_model, params = tiny
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, size=(1, 9))
+
+    ours = gemma2.forward(
+        params, cfg, jnp.asarray(ids),
+        per_layer_fn=lambda h, idx: h,  # tap raw resid_post at every layer
+    )
+    with torch.no_grad():
+        out = hf_model(input_ids=torch.tensor(ids), output_hidden_states=True)
+    # HF hidden_states[0] is the embedding; [i+1] is resid_post of layer i —
+    # except the last entry, which HF stores *after* the final norm.
+    for layer in range(cfg.num_layers - 1):
+        np.testing.assert_allclose(
+            np.asarray(ours.taps[layer]),
+            out.hidden_states[layer + 1].float().numpy(),
+            atol=5e-5, rtol=1e-5,
+        )
+    last_normed = gemma2.rms_norm(
+        ours.taps[cfg.num_layers - 1], params["final_norm"], cfg.rms_norm_eps
+    )
+    np.testing.assert_allclose(
+        np.asarray(last_normed),
+        out.hidden_states[-1].float().numpy(),
+        atol=5e-5, rtol=1e-5,
+    )
+
+
+def test_kv_cache_prefill_then_decode_matches_full_forward(tiny):
+    cfg, hf_model, params = tiny
+    rng = np.random.default_rng(4)
+    B, T_prompt, T_extra = 2, 7, 5
+    ids = rng.integers(0, cfg.vocab_size, size=(B, T_prompt + T_extra))
+
+    full = gemma2.forward(params, cfg, jnp.asarray(ids))
+
+    cache = gemma2.KVCache.zeros(cfg, B, max_len=T_prompt + T_extra)
+    pre = gemma2.forward(params, cfg, jnp.asarray(ids[:, :T_prompt]), cache=cache)
+    step_logits = [np.asarray(pre.logits[:, -1])]
+    cache = pre.cache
+    for t in range(T_prompt, T_prompt + T_extra):
+        step = gemma2.forward(params, cfg, jnp.asarray(ids[:, t:t + 1]), cache=cache)
+        cache = step.cache
+        step_logits.append(np.asarray(step.logits[:, 0]))
+
+    # logits at position t from incremental decode == from the full forward
+    for offset, lg in enumerate(step_logits):
+        np.testing.assert_allclose(
+            lg, np.asarray(full.logits[:, T_prompt - 1 + offset]), atol=3e-5, rtol=1e-5
+        )
+
+
+def test_edit_fn_is_applied(tiny):
+    cfg, _, params = tiny
+    ids = np.arange(8, dtype=np.int64)[None, :] % cfg.vocab_size
+
+    def zero_layer_2(h, idx):
+        return jnp.where(idx == 2, jnp.zeros_like(h), h)
+
+    edited = gemma2.forward(params, cfg, jnp.asarray(ids), edit_fn=zero_layer_2,
+                            per_layer_fn=lambda h, i: h)
+    assert np.abs(np.asarray(edited.taps[2])).max() == 0.0
+    assert np.abs(np.asarray(edited.taps[1])).max() > 0.0
+
+
+def test_greedy_decode_matches_hf_generate(tiny):
+    cfg, hf_model, params = tiny
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, cfg.vocab_size, size=(1, 6))
+    new_tokens = 8
+
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            input_ids=torch.tensor(prompt), max_new_tokens=new_tokens,
+            do_sample=False, use_cache=True,
+        ).numpy()
+
+    cache = gemma2.KVCache.zeros(cfg, 1, max_len=prompt.shape[1] + new_tokens)
+    res = gemma2.forward(params, cfg, jnp.asarray(prompt), cache=cache)
+    cache = res.cache
+    tok = jnp.argmax(res.logits[:, -1], axis=-1)
+    generated = [int(tok[0])]
+    for _ in range(new_tokens - 1):
+        res = gemma2.forward(params, cfg, tok[:, None], cache=cache)
+        cache = res.cache
+        tok = jnp.argmax(res.logits[:, 0], axis=-1)
+        generated.append(int(tok[0]))
+
+    np.testing.assert_array_equal(np.array(generated), hf_out[0, prompt.shape[1]:])
