@@ -1,0 +1,106 @@
+"""Benchmark: ablation-sweep throughput on one chip (BASELINE.json metric
+"ablation-sweep prompts/sec/chip").
+
+Workload per "prompt": the full intervention-arm inner step the Execution Plan
+sweeps thousands of times — batched greedy decode (prefill + 50 new tokens)
+with the SAE encode→ablate→decode edit compiled into every forward step at the
+tap layer, followed by the per-layer lens readout over the full sequence.
+This is the pipeline's hot path; everything else is host-side bookkeeping.
+
+Model: Gemma-2-2B shape with the REAL 256k vocab (the lens readout's cost is
+the [T, 3584]x[3584, 256k] unembed per layer — vocab is what matters), bf16.
+The 9B does not fit a single v5e chip (18 GB bf16 > 16 GB HBM; SURVEY.md §7
+hard part #2 — multi-chip tp handles it, see __graft_entry__.dryrun_multichip);
+per-chip throughput on the 2.6B keeps the number honest and comparable.
+
+Baseline derivation (vs_baseline): the reference runs batch-1 sequential
+decode + an nnsight full-trace that materializes and transfers [42, seq, 256k]
+f32 ≈ 1.16 GB per prompt, then np.savez_compressed's it (reference
+src/run_generation.py:32-82, SURVEY.md §3.1).  On its stated A100-class
+envelope that is ~2 s decode + ~3 s trace/transfer + ~10 s compression ≈ 0.07
+prompts/sec.  No faster number is published ("published": {} in BASELINE.json),
+so 0.07 prompts/sec is the reference point; vs_baseline = ours / 0.07.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_PROMPTS_PER_SEC = 0.07
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import lens, sae as sae_ops
+    from taboo_brittleness_tpu.pipelines.interventions import sae_ablation_edit
+    from taboo_brittleness_tpu.runtime import decode
+
+    on_accel = jax.default_backend() != "cpu"
+    preset = os.environ.get(
+        "BENCH_PRESET", "gemma2_bench" if on_accel else "gemma2_tiny")
+    cfg = gemma2.PRESETS[preset]
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_accel else "2"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "50" if on_accel else "4"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "32" if on_accel else "8"))
+    reps = int(os.environ.get("BENCH_REPS", "3" if on_accel else "1"))
+
+    key = jax.random.PRNGKey(0)
+    params = gemma2.init_params(key, cfg)
+    sae = sae_ops.init_random(jax.random.PRNGKey(1), cfg.hidden_size, 16384)
+    tap_layer = min(31, cfg.num_layers - 1)
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(batch)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    ep = {"sae": sae,
+          "latent_ids": jnp.asarray([11, 222, 3333, 4444], jnp.int32),
+          "layer": tap_layer}
+    targets = jnp.zeros((batch,), jnp.int32)
+
+    lens_step = jax.jit(
+        lambda p, s, v, pos: lens.lens_forward(
+            p, cfg, s, targets, tap_layer=tap_layer, top_k=5,
+            positions=pos, attn_validity=v),
+        static_argnames=())
+
+    def arm_step():
+        dec = decode.greedy_decode(
+            params, cfg, *args, max_new_tokens=new_tokens,
+            edit_fn=sae_ablation_edit, edit_params=ep,
+            stop_ids=(-1,))  # fixed-length decode: uniform work per row
+        seq_valid = dec.sequence_valid
+        pos = jnp.maximum(jnp.cumsum(seq_valid, axis=1) - 1, 0)
+        res = lens_step(params, dec.sequences, seq_valid, pos)
+        jax.block_until_ready((dec.tokens, res.tap.topk_ids, res.residual))
+
+    arm_step()  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        arm_step()
+    dt = (time.perf_counter() - t0) / reps
+
+    prompts_per_sec = batch / dt
+    print(json.dumps({
+        "metric": "ablation-sweep prompts/sec/chip "
+                  f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
+        "value": round(prompts_per_sec, 3),
+        "unit": "prompts/sec/chip",
+        "vs_baseline": round(prompts_per_sec / BASELINE_PROMPTS_PER_SEC, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""Command-line entry points.
+
+One CLI with subcommands replaces the reference's three ad-hoc scripts
+(``python src/run_generation.py cfg.yaml`` etc., each with its own argv
+handling — and ``01_reproduce_logit_lens.py`` ignoring its argv entirely, a
+reference bug noted in SURVEY.md anti-goals):
+
+    python -m taboo_brittleness_tpu generate      [-c CFG] [--words ...] [--parity-dump]
+    python -m taboo_brittleness_tpu logit-lens    [-c CFG] [--words ...]
+    python -m taboo_brittleness_tpu sae-baseline  [-c CFG] [--sae-npz PATH]
+    python -m taboo_brittleness_tpu interventions [-c CFG] --word W [--sae-npz PATH]
+    python -m taboo_brittleness_tpu token-forcing [-c CFG] [--modes pregame postgame]
+
+Every subcommand accepts the reference's ``configs/default.yaml`` schema
+unchanged (config.load_config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from taboo_brittleness_tpu import config as config_mod
+from taboo_brittleness_tpu.config import Config
+
+
+def _common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-c", "--config", default="configs/default.yaml",
+                   help="YAML config (reference schema accepted)")
+    p.add_argument("--words", nargs="*", default=None,
+                   help="subset of taboo words (default: all in config)")
+    p.add_argument("--processed-dir", default=None,
+                   help="override cache dir (default from config)")
+    p.add_argument("--checkpoint-root", default=None,
+                   help="directory of local HF snapshots (or set TABOO_CHECKPOINT_ROOT)")
+
+
+def _load(args) -> Config:
+    if os.path.exists(args.config):
+        return config_mod.load_config(args.config)
+    print(f"[config] {args.config} not found; using built-in defaults")
+    return Config()
+
+
+def _loader(config: Config, args):
+    from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
+
+    return CheckpointManager(config.model, checkpoint_root=args.checkpoint_root)
+
+
+def _sae(config: Config, path: Optional[str]):
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+
+    if path:
+        return sae_ops.load(path)
+    raise SystemExit(
+        "--sae-npz required (no hub egress; convert the Gemma-Scope release "
+        f"{config.sae.release}/{config.sae.sae_id} to npz with keys "
+        "W_enc/b_enc/W_dec/b_dec/threshold)")
+
+
+def cmd_generate(args) -> int:
+    from taboo_brittleness_tpu.pipelines import generation
+
+    config = _load(args)
+    done = generation.run_generation(
+        config, model_loader=_loader(config, args), words=args.words,
+        processed_dir=args.processed_dir, parity_dump=args.parity_dump)
+    print(json.dumps({w: len(v) for w, v in done.items()}))
+    return 0
+
+
+def cmd_logit_lens(args) -> int:
+    from taboo_brittleness_tpu.pipelines import logit_lens
+    from taboo_brittleness_tpu.runtime.checkpoints import resolve_snapshot_dir
+    from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer
+
+    config = _load(args)
+    loader = _loader(config, args)
+    words = args.words or config.words
+    # Tokenizer-only load (all taboo checkpoints share the Gemma-2 tokenizer):
+    # a fully cached run must never stream 9B of weights just to decode ids —
+    # the reference does exactly that (src/01_reproduce_logit_lens.py:193).
+    snap = resolve_snapshot_dir(loader.repo_id(words[0]), args.checkpoint_root)
+    tok = HFTokenizer.from_pretrained(snap)
+    out = os.path.join(
+        config.output.base_dir, f"seed_{config.experiment.seed}",
+        config.output.experiment_name, "logit_lens_evaluation_results.json")
+    results = logit_lens.run_evaluation(
+        config, tok, words=words, model_loader=loader,
+        processed_dir=args.processed_dir, output_path=out)
+    print(json.dumps(results["overall"], indent=2))
+    print(f"results -> {out}")
+    return 0
+
+
+def cmd_sae_baseline(args) -> int:
+    from taboo_brittleness_tpu.pipelines import sae_baseline
+
+    config = _load(args)
+    sae = _sae(config, args.sae_npz)
+    results = sae_baseline.analyze_sae_baseline(
+        config, sae, words=args.words, processed_dir=args.processed_dir)
+    csv_path = os.path.join("results", "tables", "baseline_metrics.csv")
+    sae_baseline.save_metrics_csv(results, csv_path)
+    print(json.dumps(results["overall"], indent=2))
+    print(f"metrics -> {csv_path}")
+    return 0
+
+
+def cmd_interventions(args) -> int:
+    from taboo_brittleness_tpu.pipelines import interventions
+
+    config = _load(args)
+    loader = _loader(config, args)
+    sae = _sae(config, args.sae_npz)
+    params, cfg, tok = loader(args.word)
+    out = args.output or os.path.join(
+        "results", "interventions", f"{args.word}.json")
+    results = interventions.run_intervention_study(
+        params, cfg, tok, config, args.word, sae, output_path=out)
+    block = results["ablation"]["budgets"]
+    summary = {m: {
+        "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
+        "random_drop": block[m]["random_mean"]["secret_prob_drop"],
+    } for m in block}
+    print(json.dumps(summary, indent=2))
+    print(f"study -> {out}")
+    return 0
+
+
+def cmd_token_forcing(args) -> int:
+    from taboo_brittleness_tpu.pipelines import token_forcing
+
+    config = _load(args)
+    out = args.output or os.path.join("results", "token_forcing", "results.json")
+    results = token_forcing.run_token_forcing(
+        config, model_loader=_loader(config, args), words=args.words,
+        modes=tuple(args.modes), output_path=out)
+    print(json.dumps(results["overall"], indent=2))
+    print(f"results -> {out}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="taboo_brittleness_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="build the (word x prompt) cache")
+    _common(g)
+    g.add_argument("--parity-dump", action="store_true",
+                   help="write reference-schema all_probs npz (GB-scale)")
+    g.set_defaults(fn=cmd_generate)
+
+    ll = sub.add_parser("logit-lens", help="LL-Top-k evaluation")
+    _common(ll)
+    ll.set_defaults(fn=cmd_logit_lens)
+
+    sb = sub.add_parser("sae-baseline", help="SAE-Top-k baseline")
+    _common(sb)
+    sb.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
+    sb.set_defaults(fn=cmd_sae_baseline)
+
+    iv = sub.add_parser("interventions", help="targeted-vs-random sweeps")
+    _common(iv)
+    iv.add_argument("--word", required=True)
+    iv.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
+    iv.add_argument("--output", default=None)
+    iv.set_defaults(fn=cmd_interventions)
+
+    tf = sub.add_parser("token-forcing", help="pre/postgame forcing attacks")
+    _common(tf)
+    tf.add_argument("--modes", nargs="+", default=["pregame", "postgame"],
+                    choices=["pregame", "postgame"])
+    tf.add_argument("--output", default=None)
+    tf.set_defaults(fn=cmd_token_forcing)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,7 +142,9 @@ def leak_rate(responses: Iterable[str], valid_forms: Set[str]) -> float:
     "Measurements": leak rate).  Matching is case-insensitive on whole words.
     """
     responses = list(responses)
-    if not responses:
+    if not responses or not valid_forms:
+        # Empty alternation would compile to r"\b(?:)\b", which matches any
+        # word boundary — no forms means nothing can leak.
         return 0.0
     pattern = _leak_pattern(frozenset(valid_forms))
     leaks = sum(bool(pattern.search(r)) for r in responses)

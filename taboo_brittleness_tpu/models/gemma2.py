@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -61,8 +60,9 @@ class Gemma2Config:
     def storage_dtype(self):
         return jnp.dtype(self.param_dtype)
 
-    def is_sliding(self, layer_idx: int) -> bool:
-        """Even layers use sliding-window attention, odd layers global (HF layer_types)."""
+    def is_sliding(self, layer_idx):
+        """Even layers use sliding-window attention, odd layers global (HF
+        layer_types).  Accepts a traced layer index (used inside the scan)."""
         return layer_idx % 2 == 0
 
     def replace(self, **kw) -> "Gemma2Config":
@@ -264,8 +264,7 @@ def _layer(
 
     # Select sliding vs global mask by layer parity — both masks are computed
     # once outside the scan, selection is a cheap jnp.where on booleans.
-    is_sliding = (layer_idx % 2) == 0
-    mask = jnp.where(is_sliding, mask_sliding, mask_global)
+    mask = jnp.where(cfg.is_sliding(layer_idx), mask_sliding, mask_global)
 
     attn = attend(
         q, k_all, v_all, mask,
@@ -293,6 +292,7 @@ class ForwardResult(NamedTuple):
     last_hidden: jax.Array             # [B, T, D] (pre-final-norm resid_post of last layer)
     taps: Any                          # pytree from per_layer_fn, stacked [L, ...]; None if unused
     cache: Optional[KVCache]
+    carry_tap: Any = None              # final accumulator from carry_tap, if given
 
 
 def unembed(params: Params, cfg: Gemma2Config, h: jax.Array) -> jax.Array:
@@ -313,6 +313,7 @@ def forward(
     cache: Optional[KVCache] = None,        # decode mode if given
     per_layer_fn: Optional[Callable[[jax.Array, jax.Array], Any]] = None,
     edit_fn: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
+    carry_tap: Optional[Tuple[Any, Callable[[Any, jax.Array, jax.Array], Any]]] = None,
     compute_logits: bool = True,
 ) -> ForwardResult:
     """One compiled forward pass.
@@ -323,6 +324,12 @@ def forward(
     hook-point equivalent: a pure rewrite of the residual stream (used for SAE
     ablation / low-rank projection removal), compiled into the graph.
 
+    ``carry_tap = (init, update)`` accumulates through the scan *carry* instead
+    of the stacked outputs: ``acc = update(acc, resid_post, layer_idx)`` runs
+    per layer and only the final ``acc`` survives — O(1) in layers, unlike
+    per_layer_fn whose outputs buffer [L, ...] (use this to capture a single
+    layer's residual without materializing all of them).
+
     With ``cache``, [B, T] is the *new* chunk (T=1 for decode steps); keys/values
     are appended at ``cache.length`` and attention spans the whole cache.
     """
@@ -330,9 +337,14 @@ def forward(
     cdt = cfg.compute_dtype
 
     if positions is None:
-        base = cache.length if cache is not None else 0
+        if cache is not None:
+            # Per-row count of real tokens so far — NOT cache.length, which
+            # counts pad slots of a left-padded prefill and would inflate RoPE
+            # positions / over-restrict the sliding window.
+            base = jnp.sum(cache.valid, axis=1, dtype=jnp.int32)[:, None]
+        else:
+            base = jnp.zeros((B, 1), jnp.int32)
         positions = jnp.arange(T, dtype=jnp.int32)[None, :] + base
-        positions = jnp.broadcast_to(positions, (B, T))
     if attn_validity is None:
         attn_validity = jnp.ones((B, T), bool)
 
@@ -362,8 +374,11 @@ def forward(
     layer_params = params["layers"]
     layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
 
+    acc0 = carry_tap[0] if carry_tap is not None else 0
+
     if cache is not None:
-        def scan_body(h, xs):
+        def scan_body(carry, xs):
+            h, acc = carry
             lp, idx, ck, cv = xs
             h, (new_k, new_v) = _layer(
                 h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
@@ -371,15 +386,18 @@ def forward(
             )
             if edit_fn is not None:
                 h = edit_fn(h, idx)
+            if carry_tap is not None:
+                acc = carry_tap[1](acc, h, idx)
             tap = per_layer_fn(h, idx) if per_layer_fn is not None else 0
-            return h, (tap, new_k, new_v)
+            return (h, acc), (tap, new_k, new_v)
 
-        h, (taps, new_k, new_v) = lax.scan(
-            scan_body, h, (layer_params, layer_idx, cache.k, cache.v)
+        (h, acc), (taps, new_k, new_v) = lax.scan(
+            scan_body, (h, acc0), (layer_params, layer_idx, cache.k, cache.v)
         )
         new_cache = KVCache(k=new_k, v=new_v, valid=new_valid, length=cache.length + T)
     else:
-        def scan_body(h, xs):
+        def scan_body(carry, xs):
+            h, acc = carry
             lp, idx = xs
             h, _ = _layer(
                 h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
@@ -387,16 +405,19 @@ def forward(
             )
             if edit_fn is not None:
                 h = edit_fn(h, idx)
+            if carry_tap is not None:
+                acc = carry_tap[1](acc, h, idx)
             tap = per_layer_fn(h, idx) if per_layer_fn is not None else 0
-            return h, tap
+            return (h, acc), tap
 
-        h, taps = lax.scan(scan_body, h, (layer_params, layer_idx))
+        (h, acc), taps = lax.scan(scan_body, (h, acc0), (layer_params, layer_idx))
         new_cache = None
     if per_layer_fn is None:
         taps = None
 
     logits = unembed(params, cfg, h) if compute_logits else None
-    return ForwardResult(logits=logits, last_hidden=h, taps=taps, cache=new_cache)
+    return ForwardResult(logits=logits, last_hidden=h, taps=taps, cache=new_cache,
+                         carry_tap=acc if carry_tap is not None else None)
 
 
 def num_params(params: Params) -> int:

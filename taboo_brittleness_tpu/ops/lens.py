@@ -1,0 +1,274 @@
+"""In-graph logit-lens readout.
+
+The reference materializes softmax(lm_head(norm(resid))) for all 42 layers as a
+``[42, seq, 256000]`` float32 host tensor (~1.16 GB/prompt; reference
+``src/models.py:97-170``) and then consumes only tiny slices of it
+(reference ``src/01_reproduce_logit_lens.py:120-150``):
+
+- the probability of ONE target token per (layer, position) — for the heatmap;
+- the top-k token ids of a masked positional sum at ONE layer — the guesses;
+- the argmax token per (layer, position) — decoded "lens words".
+
+Here those reductions run inside the compiled forward via the ``per_layer_fn``
+tap of ``models.gemma2.forward``: the full probability tensor never exists in
+HBM (each layer's ``[B, T, V]`` lens probs live only inside one scan step, and
+XLA fuses the reduction into the unembed matmul epilogue).  Per prompt the
+output is a few KB instead of >1 GB.
+
+A parity mode (``full_probs=True``) reproduces the reference's full dump for
+byte-level cache compatibility (reference ``src/run_generation.py:32-82``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import (
+    Gemma2Config,
+    Params,
+    forward,
+    rms_norm,
+    softcap,
+)
+
+
+class LensTap(NamedTuple):
+    """Per-layer lens statistics, each stacked ``[L, ...]`` by the scan.
+
+    ``target_prob``  [L, B, T]      P(target token) at every layer/position.
+    ``argmax_id``    [L, B, T]      lens argmax token id (the reference's
+                                    decoded "words", src/models.py:150-153).
+    ``argmax_prob``  [L, B, T]      its probability.
+    ``topk_ids``     [L, B, T, K]   per-position lens top-k ids (layer-of-
+                                    interest analysis + spike finding).
+    ``topk_probs``   [L, B, T, K]
+    """
+
+    target_prob: jax.Array
+    argmax_id: jax.Array
+    argmax_prob: jax.Array
+    topk_ids: jax.Array
+    topk_probs: jax.Array
+
+
+def lens_probs(params: Params, cfg: Gemma2Config, h: jax.Array) -> jax.Array:
+    """softmax(softcap(lm_head(final_norm(h)))) in f32 — the lens readout that the
+    reference applies at every layer inside the nnsight trace (src/models.py:135-138)."""
+    x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    logits = x @ params["embed"].astype(cfg.compute_dtype).T
+    logits = softcap(logits.astype(jnp.float32), cfg.final_logit_softcap)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def make_lens_tap(
+    params: Params,
+    cfg: Gemma2Config,
+    target_ids: jax.Array,   # [B] one target token id per batch row
+    *,
+    top_k: int = 5,
+):
+    """Build a ``per_layer_fn`` computing :class:`LensTap` stats for one layer.
+
+    The [B, T, V] probability tensor exists only transiently within a single
+    scan iteration; everything returned is O(B·T·k).
+    """
+
+    def tap(h: jax.Array, layer_idx: jax.Array) -> LensTap:
+        del layer_idx
+        probs = lens_probs(params, cfg, h)              # [B, T, V] f32
+        tgt = jnp.take_along_axis(
+            probs, target_ids[:, None, None], axis=-1
+        )[..., 0]                                        # [B, T]
+        topk_probs, topk_ids = lax.top_k(probs, top_k)   # [B, T, K]
+        return LensTap(
+            target_prob=tgt,
+            argmax_id=topk_ids[..., 0],
+            argmax_prob=topk_probs[..., 0],
+            topk_ids=topk_ids,
+            topk_probs=topk_probs,
+        )
+
+    return tap
+
+
+def make_full_probs_tap(params: Params, cfg: Gemma2Config):
+    """Parity-mode tap: return the full [B, T, V] lens probs per layer (the
+    reference's all_probs dump, reference src/run_generation.py:46-48)."""
+
+    def tap(h: jax.Array, layer_idx: jax.Array) -> jax.Array:
+        del layer_idx
+        return lens_probs(params, cfg, h)
+
+    return tap
+
+
+class LensForwardResult(NamedTuple):
+    tap: LensTap                       # stacked [L, B, T, ...]
+    residual: Optional[jax.Array]      # [B, T, D] resid_post at tap_layer (f32)
+    logits: Optional[jax.Array]        # final [B, T, V] (softcapped)
+
+
+def lens_forward(
+    params: Params,
+    cfg: Gemma2Config,
+    input_ids: jax.Array,            # [B, T]
+    target_ids: jax.Array,           # [B]
+    *,
+    tap_layer: int,
+    top_k: int = 5,
+    positions: Optional[jax.Array] = None,
+    attn_validity: Optional[jax.Array] = None,
+    compute_logits: bool = False,
+    edit_fn: Optional[Any] = None,
+) -> LensForwardResult:
+    """One compiled pass: lens stats for every layer + the residual at
+    ``tap_layer`` (for the SAE path — the reference's ``residual_stream_l31``
+    save, src/models.py:131-132).
+
+    The residual capture rides the scan *carry* (``carry_tap``): one
+    [B, T, D] accumulator is masked-added per layer, so only a single
+    residual buffer ever exists — the stacked [L, B, T, D] tensor (~780 MB
+    for the 9B at B=10) never materializes.
+    """
+
+    stats_tap = make_lens_tap(params, cfg, target_ids, top_k=top_k)
+
+    B, T = input_ids.shape
+    acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
+
+    def accumulate(acc, h, layer_idx):
+        keep = (layer_idx == tap_layer).astype(jnp.float32)
+        return acc + h.astype(jnp.float32) * keep
+
+    res = forward(
+        params, cfg, input_ids,
+        positions=positions,
+        attn_validity=attn_validity,
+        per_layer_fn=stats_tap,
+        carry_tap=(acc0, accumulate),
+        edit_fn=edit_fn,
+        compute_logits=compute_logits,
+    )
+    return LensForwardResult(tap=res.taps, residual=res.carry_tap, logits=res.logits)
+
+
+def full_probs_forward(
+    params: Params,
+    cfg: Gemma2Config,
+    input_ids: jax.Array,
+    *,
+    tap_layer: Optional[int] = None,
+    positions: Optional[jax.Array] = None,
+    attn_validity: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Parity mode: (all_probs [L, B, T, V] f32, residual [B, T, D] f32 or None).
+
+    Matches the reference cache schema exactly (npz keys ``all_probs`` +
+    ``residual_stream_l<idx>``, reference src/run_generation.py:56).  Only for
+    small T / debug — this is the GB-scale tensor the TPU design removes.
+    """
+    probs_tap = make_full_probs_tap(params, cfg)
+
+    if tap_layer is None:
+        res = forward(params, cfg, input_ids, positions=positions,
+                      attn_validity=attn_validity, per_layer_fn=probs_tap,
+                      compute_logits=False)
+        return res.taps, None
+
+    B, T = input_ids.shape
+    acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
+
+    def accumulate(acc, h, layer_idx):
+        keep = (layer_idx == tap_layer).astype(jnp.float32)
+        return acc + h.astype(jnp.float32) * keep
+
+    res = forward(params, cfg, input_ids, positions=positions,
+                  attn_validity=attn_validity, per_layer_fn=probs_tap,
+                  carry_tap=(acc0, accumulate),
+                  compute_logits=False)
+    return res.taps, res.carry_tap
+
+
+# ---------------------------------------------------------------------------
+# Response aggregation (the analysis step of reference
+# src/01_reproduce_logit_lens.py:35-71, as a jittable op).
+# ---------------------------------------------------------------------------
+
+def aggregate_masked_sum(
+    probs: jax.Array,        # [T, V] lens probs at the layer of interest
+    token_ids: jax.Array,    # [T] input token id at each position
+    response_mask: jax.Array,  # [T] bool: True inside the model's response
+    *,
+    top_k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k of the position-summed probs with current+previous-token zeroing.
+
+    Mirrors ``aggregate_response_logits`` (reference
+    ``src/01_reproduce_logit_lens.py:59-67``): at each response position the
+    probability of the token *at* that position and of the token at the
+    *previous* position are zeroed (the lens trivially predicts copies), then
+    probabilities are summed over response positions and the top-k vocab ids
+    win.  Returns (ids [K], summed probs [K]).
+    """
+    T, V = probs.shape
+    pos = jnp.arange(T)
+    # One-hot zeroing masks, built without scatter: [T, V] where True = zero out.
+    curr = jax.nn.one_hot(token_ids, V, dtype=bool)
+    prev = jax.nn.one_hot(jnp.where(pos > 0, token_ids[jnp.maximum(pos - 1, 0)], -1),
+                          V, dtype=bool)
+    keep = ~(curr | prev)
+    masked = jnp.where(keep, probs, 0.0)
+    masked = jnp.where(response_mask[:, None], masked, 0.0)
+    summed = jnp.sum(masked, axis=0)                       # [V]
+    top_probs, top_ids = lax.top_k(summed, top_k)
+    return top_ids, top_probs
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k"))
+def aggregate_from_residual(
+    params: Params,
+    cfg: Gemma2Config,
+    residual: jax.Array,      # [B, T, D] tapped residuals at the layer of interest
+    token_ids: jax.Array,     # [B, T]
+    response_mask: jax.Array,  # [B, T] bool
+    *,
+    top_k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Lens probs at one layer + masked-sum aggregation + top-k, vmapped over
+    the batch inside ONE jitted program, so the [T, V] probability tensor of a
+    row lives only inside the fused computation — never a persistent [B, T, V]
+    HBM buffer between dispatches.  Returns (ids [B, K], sums [B, K])."""
+
+    def one(h, ids, m):
+        probs = lens_probs(params, cfg, h[None])[0]
+        return aggregate_masked_sum(probs, ids, m, top_k=top_k)
+
+    return jax.vmap(one)(residual, token_ids, response_mask)
+
+
+def spike_positions(
+    target_prob_at_layer: jax.Array,  # [T] P(secret) at the layer of interest
+    response_mask: jax.Array,          # [T] bool
+    *,
+    top_k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k response positions by secret-token lens probability ("spike"
+    tokens, Execution Plan 'spike positions' — the sites where interventions
+    are applied).  Returns (positions [K], probs [K]).
+
+    When the response has fewer than ``top_k`` tokens, the surplus slots
+    repeat the best valid position (prob reported as 0) instead of silently
+    pointing at pad/prompt columns — repeated spikes only overweight a real
+    response token in downstream scoring/PCA, never a pad residual.
+    """
+    masked = jnp.where(response_mask, target_prob_at_layer, -1.0)
+    probs, pos = lax.top_k(masked, top_k)
+    invalid = probs < 0.0
+    pos = jnp.where(invalid, pos[0], pos)
+    probs = jnp.where(invalid, 0.0, probs)
+    return pos, probs

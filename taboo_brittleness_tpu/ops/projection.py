@@ -1,0 +1,58 @@
+"""Low-rank subspace removal (Execution Plan: 'Low-rank projection removal').
+
+The reference plans (but never implemented — SURVEY.md §3.5) editing the
+residual stream by removing a rank-r subspace fit to spike-token residuals:
+
+    r_edited = r - U U^T r,   U = top-r principal directions of spike residuals,
+
+compared against random orthonormal subspaces of the same rank as the control
+(Execution Plan:205-239).  All ops are pure and jittable; the PCA runs on-device
+(the spike-residual matrix is tiny: [#spikes, 3584]).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def principal_subspace(resids: jax.Array, rank: int, *,
+                       center: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Top-``rank`` principal directions of row-vectors ``resids`` [N, D].
+
+    Returns (U [D, rank] orthonormal columns, explained variance [rank]).
+    Uses SVD of the (optionally centered) data matrix — numerically safer than
+    eigh of the covariance for ill-conditioned spike sets.
+    """
+    x = resids.astype(jnp.float32)
+    if center:
+        x = x - jnp.mean(x, axis=0, keepdims=True)
+    # economy SVD: x = P S Q^T, principal directions are columns of Q.
+    _, s, qt = jnp.linalg.svd(x, full_matrices=False)
+    u = qt[:rank].T                                     # [D, rank]
+    n = jnp.maximum(x.shape[0] - 1, 1)
+    var = (s[:rank] ** 2) / n
+    return u, var
+
+
+def random_subspace(key: jax.Array, d: int, rank: int) -> jax.Array:
+    """Random orthonormal [D, rank] basis (QR of a Gaussian) — the control arm."""
+    g = jax.random.normal(key, (d, rank), jnp.float32)
+    q, r = jnp.linalg.qr(g)
+    # Fix signs for determinism across backends.
+    return q * jnp.sign(jnp.diagonal(r))[None, :]
+
+
+def remove_subspace(x: jax.Array, u: jax.Array) -> jax.Array:
+    """x - (x @ U) U^T, applied over the last axis.  x: [..., D], u: [D, r]."""
+    xf = x.astype(jnp.float32)
+    proj = (xf @ u) @ u.T
+    return (xf - proj).astype(x.dtype)
+
+
+# Edit-fn application (layer gating + optional spike-position masking) lives in
+# pipelines/interventions.py (sae_ablation_edit / projection_edit): edit state
+# is passed as a traced ``edit_params`` pytree so sweep arms share one
+# compiled program instead of retracing per closure.

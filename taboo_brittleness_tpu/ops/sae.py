@@ -1,0 +1,182 @@
+"""Gemma-Scope JumpReLU SAE as pure JAX ops.
+
+The reference reaches the SAE through the ``sae_lens`` torch package
+(``SAE.from_pretrained("google/gemma-scope-9b-it-res",
+"layer_31/width_16k/average_l0_76")`` — reference
+``src/02_run_sae_baseline.py:30-36``) and calls ``sae.encode`` on host-side
+residual tensors.  Here the SAE is a pytree + pure functions so that:
+
+- the SAE-Top-k baseline readout (reference ``src/02_run_sae_baseline.py:53-74``)
+  runs as one jitted op over the whole (word x prompt) batch;
+- encode → ablate-k-latents → decode can be spliced *inside* the model forward
+  (via ``edit_fn``) at decode time — the intervention the reference planned but
+  never implemented (Execution Plan, SURVEY.md §3.5).
+
+Gemma-Scope numerics (Rajamanoharan et al. 2024, "Jumping Ahead"): the encoder
+is ``acts = pre * (pre > threshold)`` with ``pre = x @ W_enc + b_enc`` — a
+JumpReLU with a learned per-latent threshold (NOT a plain ReLU shifted by the
+threshold); the decoder is ``acts @ W_dec + b_dec``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SAEParams(NamedTuple):
+    """Gemma-Scope parameter layout: d_model=3584, d_sae=16384 for the
+    layer_31/width_16k release the reference uses (src/02_run_sae_baseline.py:21-22)."""
+
+    w_enc: jax.Array      # [D, S]
+    b_enc: jax.Array      # [S]
+    w_dec: jax.Array      # [S, D]
+    b_dec: jax.Array      # [D]
+    threshold: jax.Array  # [S]
+
+    @property
+    def d_model(self) -> int:
+        return self.w_enc.shape[0]
+
+    @property
+    def d_sae(self) -> int:
+        return self.w_enc.shape[1]
+
+
+def init_random(key: jax.Array, d_model: int, d_sae: int, dtype=jnp.float32) -> SAEParams:
+    """Random SAE for tests/benchmarks (thresholds > 0 so JumpReLU gates bite)."""
+    k1, k2 = jax.random.split(key)
+    w_enc = jax.random.normal(k1, (d_model, d_sae), dtype) * (d_model ** -0.5)
+    return SAEParams(
+        w_enc=w_enc,
+        b_enc=jnp.zeros((d_sae,), dtype),
+        w_dec=jax.random.normal(k2, (d_sae, d_model), dtype) * (d_sae ** -0.5),
+        b_dec=jnp.zeros((d_model,), dtype),
+        threshold=jnp.full((d_sae,), 0.5, dtype),
+    )
+
+
+def from_numpy_state(state: Dict[str, np.ndarray], dtype=jnp.float32) -> SAEParams:
+    """Build from a Gemma-Scope npz/state-dict (keys: W_enc, b_enc, W_dec, b_dec,
+    threshold — the layout of the official gemma-scope release files)."""
+    def get(*names):
+        for n in names:
+            if n in state:
+                return jnp.asarray(np.asarray(state[n]), dtype)
+        raise KeyError(f"none of {names} in SAE state ({sorted(state)})")
+
+    return SAEParams(
+        w_enc=get("W_enc", "w_enc"),
+        b_enc=get("b_enc"),
+        w_dec=get("W_dec", "w_dec"),
+        b_dec=get("b_dec"),
+        threshold=get("threshold"),
+    )
+
+
+def load(path: str, dtype=jnp.float32) -> SAEParams:
+    """Load from an .npz file (e.g. converted from the Gemma-Scope HF release)."""
+    with np.load(path) as data:
+        return from_numpy_state({k: data[k] for k in data.files}, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pure ops.
+# ---------------------------------------------------------------------------
+
+def encode(sae: SAEParams, x: jax.Array) -> jax.Array:
+    """JumpReLU encode: acts[s] = pre[s] if pre[s] > threshold[s] else 0.
+
+    Matches ``sae_lens`` JumpReLU inference (reference uses it at
+    src/02_run_sae_baseline.py:67).  x: [..., D] -> acts [..., S], f32.
+    """
+    pre = x.astype(jnp.float32) @ sae.w_enc + sae.b_enc
+    return jnp.where(pre > sae.threshold, pre, 0.0)
+
+
+def decode(sae: SAEParams, acts: jax.Array) -> jax.Array:
+    """acts [..., S] -> reconstruction [..., D]."""
+    return acts @ sae.w_dec + sae.b_dec
+
+
+def reconstruct(sae: SAEParams, x: jax.Array) -> jax.Array:
+    return decode(sae, encode(sae, x))
+
+
+def mean_response_acts(
+    sae: SAEParams,
+    resid: jax.Array,          # [T, D]
+    response_mask: jax.Array,  # [T] bool
+) -> jax.Array:
+    """Mean SAE activation over response tokens — the reference's pooled feature
+    vector (mean over tokens, src/02_run_sae_baseline.py:70).  -> [S]."""
+    acts = encode(sae, resid)                               # [T, S]
+    w = response_mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    return jnp.sum(acts * w[:, None], axis=0) / denom
+
+
+def top_latents(mean_acts: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k latent (ids, activations) — reference src/02_run_sae_baseline.py:73."""
+    vals, ids = lax.top_k(mean_acts, k)
+    return ids, vals
+
+
+# ---------------------------------------------------------------------------
+# Ablation edits (Execution Plan "targeted vs random ablations").
+# ---------------------------------------------------------------------------
+
+def ablate_latents(
+    sae: SAEParams,
+    x: jax.Array,            # [..., D] residual
+    latent_ids: jax.Array,   # [m] int latent ids to zero (pad with -1 for none)
+) -> jax.Array:
+    """Splice: encode, zero the chosen latents, decode, and patch the residual by
+    the *difference* of reconstructions.
+
+    Patching ``x + (decode(ablated) - decode(full))`` rather than swapping in the
+    raw reconstruction keeps the SAE's reconstruction error out of the edit: with
+    m=0 latents the edit is exactly identity, so ablation deltas measure only the
+    removed latents (the control the Execution Plan's random-ablation arm needs).
+    """
+    acts = encode(sae, x)                                    # [..., S]
+    S = acts.shape[-1]
+    # mask[s] = True if s in latent_ids; -1 entries match nothing.
+    hit = jnp.any(
+        jnp.arange(S)[:, None] == latent_ids[None, :], axis=-1
+    )                                                         # [S]
+    ablated = jnp.where(hit, 0.0, acts)
+    delta = decode(sae, ablated) - decode(sae, acts)          # [..., D]
+    return (x.astype(jnp.float32) + delta).astype(x.dtype)
+
+
+def score_latents(
+    acts_at_spikes: jax.Array,    # [P, S] SAE acts at the P spike positions
+    secret_corr: jax.Array,       # [S] correlation of latent with secret logit
+) -> jax.Array:
+    """Targeting score = mean spike activation x max(0, corr) (Execution Plan
+    'score = mean activation at spikes x positive correlation with secret')."""
+    mean_acts = jnp.mean(acts_at_spikes, axis=0)            # [S]
+    return mean_acts * jnp.maximum(secret_corr, 0.0)
+
+
+def latent_secret_alignment(sae: SAEParams, params_embed: jax.Array,
+                            secret_id: jax.Array) -> jax.Array:
+    """Static proxy for latent↔secret correlation: cosine of each decoder row with
+    the secret token's unembedding vector.  [S].
+
+    The Execution Plan scores latents by correlation with the secret logit over
+    calibration data; the decoder-row↔unembed-vector cosine is the data-free
+    equivalent (the logit contribution of ablating latent s is exactly
+    ``-acts[s] * (W_dec[s] · u_secret)`` up to the final norm).
+    """
+    u = params_embed[secret_id].astype(jnp.float32)          # [D]
+    w = sae.w_dec.astype(jnp.float32)                        # [S, D]
+    num = w @ u
+    denom = jnp.linalg.norm(w, axis=-1) * jnp.linalg.norm(u) + 1e-8
+    return num / denom

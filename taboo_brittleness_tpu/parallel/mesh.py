@@ -1,0 +1,159 @@
+"""Device mesh + sharding policy.
+
+The reference has no parallelism at all (single process, batch 1 — SURVEY.md
+§2.3/§2.4).  Here distribution is first-class and declarative, the JAX way:
+pick a mesh, annotate shardings with ``NamedSharding``; XLA inserts the ICI
+collectives (psum/all-gather from sharded matmuls).  No NCCL/MPI analogue
+exists or is needed.
+
+Axes (MeshConfig, config.py):
+- ``dp``  — data parallel over the sweep grid (word x prompt x prefill x
+  trial); the workload is embarrassingly parallel across it.
+- ``tp``  — tensor parallel: attention heads / MLP hidden / the 256k-vocab
+  unembed.  This is what makes the 9B fit: bf16 params ≈ 18 GB > 16 GB/chip
+  on v5e, so tp≥2 shards every big matrix (SURVEY.md §7 hard part #2).
+- ``sp``  — sequence parallel (ring attention, parallel/ring.py) for
+  long-context runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from taboo_brittleness_tpu.config import MeshConfig
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params
+
+
+def make_mesh(
+    mesh_cfg: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, tp, sp) mesh.  -1 axes absorb the remaining devices.
+
+    dp is outermost so grid shards land on far ICI hops and tp (the
+    latency-sensitive axis: per-matmul collectives) stays innermost/contiguous,
+    where v5e torus neighbors are one hop apart.
+    """
+    mesh_cfg = mesh_cfg or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    sizes = {"dp": mesh_cfg.dp, "tp": mesh_cfg.tp, "sp": mesh_cfg.sp}
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    free_axes = [a for a, s in sizes.items() if s == -1]
+    if len(free_axes) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if free_axes:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {sizes}")
+        sizes[free_axes[0]] = n // fixed
+    total = sizes["dp"] * sizes["tp"] * sizes["sp"]
+    if total != n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    arr = np.asarray(devs).reshape(sizes["dp"], sizes["tp"], sizes["sp"])
+    return Mesh(arr, ("dp", "tp", "sp"))
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding policy (Megatron-style, expressed as PartitionSpecs).
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: Gemma2Config) -> Params:
+    """PartitionSpec pytree matching models.gemma2 param layout.
+
+    - embed [V, D]: sharded over vocab on tp — the unembed matmul
+      [B,T,D] x [D,V/tp] then becomes the lens readout's big matmul, computed
+      shard-local with a tiny top-k merge (tp_topk below) instead of an
+      all-gather of 256k logits.
+    - q/gate/up: output-feature sharded (column parallel);
+      o/down: input-feature sharded (row parallel) — XLA inserts the psum.
+    - k/v: heads sharded when tp divides num_kv_heads (8 kv heads on Gemma-2-9B
+      divides tp ∈ {2,4,8}).
+    - norms: replicated (tiny).
+    """
+    del cfg
+    layer = {
+        "input_norm": P(None, None),
+        "post_attn_norm": P(None, None),
+        "pre_ffn_norm": P(None, None),
+        "post_ffn_norm": P(None, None),
+        "q": P(None, None, "tp"),
+        "k": P(None, None, "tp"),
+        "v": P(None, None, "tp"),
+        "o": P(None, "tp", None),
+        "gate": P(None, None, "tp"),
+        "up": P(None, None, "tp"),
+        "down": P(None, "tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "final_norm": P(None),
+        "layers": layer,
+    }
+
+
+def shard_params(params: Params, cfg: Gemma2Config, mesh: Mesh) -> Params:
+    """Place a param pytree onto the mesh per ``param_specs``."""
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec() -> P:
+    """Sweep-grid batches shard over dp; model axes stay unsharded at the
+    annotation level (tp sharding propagates from the params)."""
+    return P("dp")
+
+
+def shard_batch(x: jax.Array, mesh: Mesh) -> jax.Array:
+    return jax.device_put(x, NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1)))))
+
+
+# ---------------------------------------------------------------------------
+# TP-aware distributed top-k (the lens readout's merge step).
+# ---------------------------------------------------------------------------
+
+def tp_topk(local_vals: jax.Array, k: int, *, axis_name: str, shard_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Global top-k over an axis sharded across ``axis_name``.
+
+    Inside shard_map: each shard holds ``local_vals [..., V/tp]``.  Local top-k
+    first (k << V/tp), then all-gather only the k candidates and re-top-k —
+    O(k * tp) bytes over ICI instead of O(V).  Returns (vals, global ids).
+    """
+    lv, li = lax.top_k(local_vals, k)                      # [..., k] local
+    shard = lax.axis_index(axis_name)
+    gi = li + shard * shard_size                            # globalize ids
+    av = lax.all_gather(lv, axis_name, axis=-1, tiled=True)  # [..., k*tp]
+    ai = lax.all_gather(gi, axis_name, axis=-1, tiled=True)
+    mv, mi = lax.top_k(av, k)
+    return mv, jnp.take_along_axis(ai, mi, axis=-1)
+
+
+def local_shard_size(total: int, mesh: Mesh, axis: str = "tp") -> int:
+    n = mesh.shape[axis]
+    if total % n:
+        raise ValueError(f"axis size {total} not divisible by {axis}={n}")
+    return total // n
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, *, check: bool = False):
+    """Version-stable shard_map (jax>=0.8 moved it to jax.shard_map and renamed
+    check_rep -> check_vma; our ring/topk kernels manage replication manually)."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check)

@@ -1,0 +1,114 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+The reference has no long-context machinery (seq ≈ 27–80 tokens — SURVEY.md
+§2.3), but this framework treats long-context as first-class: the sequence axis
+shards over ``sp``, each device keeps its Q block resident and K/V blocks
+rotate around the ring via ``lax.ppermute`` (one ICI hop per step), overlapping
+compute with the collective.  Softmax is accumulated flash-style (running max +
+running denominator), so the full [T, T] score matrix never materializes and
+attention cost per device is O(T²/sp).
+
+Numerics match ``models.gemma2.attend`` (GQA, logit softcap, f32 softmax) —
+asserted by tests/test_ring.py against the single-device oracle.  Use inside
+``shard_map`` with a mesh carrying an ``sp`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import softcap
+
+_NEG_INF = -2.3819763e38
+
+
+def _block_attend(
+    q: jax.Array,            # [B, Tq, K, G, Dh] grouped query
+    k: jax.Array,            # [B, Tk, K, Dh]
+    v: jax.Array,            # [B, Tk, K, Dh]
+    mask: jax.Array,         # [B, Tq, Tk] bool
+    *,
+    scaling: float,
+    logit_cap: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One K/V block's contribution: (unnormalized out, running max, running sum)."""
+    logits = jnp.einsum("btkgd,bskd->bkgts", q, k).astype(jnp.float32) * scaling
+    logits = softcap(logits, logit_cap)
+    logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)                           # [B, K, G, Tq]
+    # Guard fully-masked rows: exp(-inf - (-inf)) -> use 0 contribution.
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(mask[:, None, None, :, :], p, 0.0)
+    s = jnp.sum(p, axis=-1)                                # [B, K, G, Tq]
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v.dtype), v)
+    return out, m_safe, s
+
+
+def ring_attention(
+    q: jax.Array,              # [B, Tq, H, Dh]  local query block
+    k: jax.Array,              # [B, Tk, K, Dh]  local key block
+    v: jax.Array,              # [B, Tk, K, Dh]  local value block
+    q_positions: jax.Array,    # [B, Tq] global token positions of the q block
+    kv_positions: jax.Array,   # [B, Tk] global token positions of the kv block
+    kv_valid: jax.Array,       # [B, Tk] bool (padding)
+    *,
+    axis_name: str,
+    scaling: float,
+    logit_cap: float,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Causal (optionally sliding-window) GQA attention with the KV blocks
+    rotating around the ``axis_name`` ring.  Returns [B, Tq, H*Dh].
+
+    Flash-style merge across ring steps: new running max m' = max(m, m_blk),
+    rescale previous numerator/denominator by exp(m - m'), add the block's.
+    """
+    B, Tq, H, Dh = q.shape
+    Kh = k.shape[2]
+    G = H // Kh
+    qg = q.reshape(B, Tq, Kh, G, Dh)
+    n_steps = lax.psum(1, axis_name)
+
+    acc = jnp.zeros((B, Tq, Kh, G, Dh), jnp.float32)
+    m = jnp.full((B, Kh, G, Tq), _NEG_INF, jnp.float32)
+    denom = jnp.zeros((B, Kh, G, Tq), jnp.float32)
+
+    def mask_for(kv_pos, valid):
+        diff = q_positions[:, :, None] - kv_pos[:, None, :]    # [B, Tq, Tk]
+        mask = diff >= 0
+        if sliding_window is not None:
+            mask = mask & (diff < sliding_window)
+        return mask & valid[:, None, :]
+
+    def body(carry, _):
+        k_blk, v_blk, kv_pos, valid, acc, m, denom = carry
+        out_blk, m_blk, s_blk = _block_attend(
+            qg, k_blk, v_blk, mask_for(kv_pos, valid),
+            scaling=scaling, logit_cap=logit_cap,
+        )
+        m_new = jnp.maximum(m, m_blk)
+        # Rescale factors; fully-masked histories (m == -inf) contribute 0.
+        scale_old = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        scale_blk = jnp.where(m_blk <= _NEG_INF / 2, 0.0, jnp.exp(m_blk - m_new))
+        acc = acc * jnp.moveaxis(scale_old, 3, 1)[..., None] \
+            + out_blk.astype(jnp.float32) * jnp.moveaxis(scale_blk, 3, 1)[..., None]
+        denom = denom * scale_old + s_blk * scale_blk
+        # Rotate K/V (and their positions/validity) one hop around the ring.
+        perm = [(i, (i + 1) % n_steps) for i in range(n_steps)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        pos_nxt = lax.ppermute(kv_pos, axis_name, perm)
+        val_nxt = lax.ppermute(valid, axis_name, perm)
+        return (k_nxt, v_nxt, pos_nxt, val_nxt, acc, m_new, denom), None
+
+    (k, v, kv_positions, kv_valid, acc, m, denom), _ = lax.scan(
+        body, (k, v, kv_positions, kv_valid, acc, m, denom), None, length=n_steps
+    )
+    denom_t = jnp.moveaxis(denom, 3, 1)[..., None]            # [B, Tq, K, G, 1]
+    out = acc / jnp.maximum(denom_t, 1e-30)
+    return out.reshape(B, Tq, H * Dh).astype(q.dtype)

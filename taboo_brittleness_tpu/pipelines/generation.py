@@ -1,0 +1,155 @@
+"""Cache-building pipeline (the reference's ``src/run_generation.py``).
+
+Per (word x prompt): batched greedy decode, lens statistics, and a cache write.
+Differences from the reference, by design (SURVEY.md §7):
+
+- all prompts of a word run as ONE batch (the reference loops batch-1);
+- the default artifact is the compact ``*.summary.npz`` (KBs) with everything
+  the analyses consume; ``parity_dump=True`` additionally writes the exact
+  reference npz/json schema (``all_probs`` [L, T, V] f32 +
+  ``residual_stream_l<idx>`` + json sidecar) for cross-framework checks;
+- skip-if-cached per cell keeps the sweep idempotent/resumable (reference
+  src/run_generation.py:96-98) — the cache IS the checkpoint/resume story.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import Config
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params
+from taboo_brittleness_tpu.ops import lens
+from taboo_brittleness_tpu.runtime import cache as cache_io
+from taboo_brittleness_tpu.runtime import decode
+from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike, target_token_id
+
+ModelLoader = Callable[[str], Tuple[Params, Gemma2Config, TokenizerLike]]
+
+
+def generate_for_word(
+    params: Params,
+    model_cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    *,
+    processed_dir: Optional[str] = None,
+    parity_dump: bool = False,
+    force: bool = False,
+) -> List[int]:
+    """Build cache entries for every un-cached prompt of ``word``.
+
+    Returns the prompt indices that were (re)generated.  One batched decode +
+    one batched lens pass for all missing prompts.
+    """
+    processed = processed_dir or config.output.processed_dir
+    layer_idx = config.model.layer_idx
+
+    def cached(i: int) -> bool:
+        if parity_dump:
+            return cache_io.has_pair(processed, word, i)
+        return (os.path.exists(cache_io.summary_path(processed, word, i))
+                or cache_io.has_pair(processed, word, i))
+
+    missing = [i for i in range(len(config.prompts)) if force or not cached(i)]
+    if not missing:
+        return []
+
+    prompts = [config.prompts[i] for i in missing]
+    dec, texts, prompt_ids = decode.generate(
+        params, model_cfg, tok, prompts,
+        max_new_tokens=config.experiment.max_new_tokens,
+    )
+    layout = decode.response_layout(dec)
+    seqs, valid, positions = layout.sequences, layout.valid, layout.positions
+    B = seqs.shape[0]
+    tid = target_token_id(tok, word)
+
+    if parity_dump:
+        probs, resid = lens.full_probs_forward(
+            params, model_cfg, jnp.asarray(seqs),
+            tap_layer=layer_idx,
+            positions=jnp.asarray(positions),
+            attn_validity=jnp.asarray(valid, bool))
+        probs = np.asarray(probs)        # [L, B, T, V]
+        resid = np.asarray(resid)        # [B, T, D]
+    else:
+        res = lens.lens_forward(
+            params, model_cfg, jnp.asarray(seqs),
+            jnp.full((B,), tid, jnp.int32),
+            tap_layer=layer_idx, top_k=config.model.top_k,
+            positions=jnp.asarray(positions),
+            attn_validity=jnp.asarray(valid, bool))
+
+    for row, p_idx in enumerate(missing):
+        # The reference traces the full output truncated before the response's
+        # closing <end_of_turn> (src/models.py:84-92): the cached view is the
+        # prompt plus the stop-excluded response (= response_layout's mask).
+        keep = valid[row].copy()
+        keep[layout.prompt_len:] = layout.response_mask[row][layout.prompt_len:]
+        ids = seqs[row][keep].tolist()
+        input_words = tok.convert_ids_to_tokens(ids)
+        # Reference full_output text = prompt + response, truncated at the 2nd
+        # <end_of_turn> (src/models.py:81-92).
+        response_text = decode.full_text(tok, prompt_ids[row], dec, row)
+
+        if parity_dump:
+            npz_path, json_path = cache_io.pair_paths(processed, word, p_idx, mkdir=True)
+            cache_io.save_pair(
+                npz_path, json_path,
+                all_probs=probs[:, row][:, keep],
+                input_words=input_words,
+                response_text=response_text,
+                prompt_text=config.prompts[p_idx],
+                residual_stream=resid[row][keep],
+                layer_idx=layer_idx,
+            )
+        else:
+            path = cache_io.summary_path(processed, word, p_idx, mkdir=True)
+            tap = res.tap
+            cache_io.save_summary(
+                path,
+                {
+                    "target_prob": np.asarray(tap.target_prob)[:, row][:, keep],  # [L, T]
+                    "argmax_id": np.asarray(tap.argmax_id)[:, row][:, keep],
+                    "argmax_prob": np.asarray(tap.argmax_prob)[:, row][:, keep],
+                    "topk_ids": np.asarray(tap.topk_ids)[:, row][:, keep],
+                    "topk_probs": np.asarray(tap.topk_probs)[:, row][:, keep],
+                    "residual": np.asarray(res.residual)[row][keep],              # [T, D]
+                    "token_ids": np.asarray(ids, np.int32),
+                },
+                {
+                    "input_words": input_words,
+                    "response_text": response_text,
+                    "prompt": config.prompts[p_idx],
+                    "word": word,
+                    "layer_idx": layer_idx,
+                    "target_token_id": int(tid),
+                },
+            )
+    return missing
+
+
+def run_generation(
+    config: Config,
+    *,
+    model_loader: ModelLoader,
+    words: Optional[Sequence[str]] = None,
+    processed_dir: Optional[str] = None,
+    parity_dump: bool = False,
+) -> Dict[str, List[int]]:
+    """The reference's main loop (src/run_generation.py:132-158): per word, load
+    that word's checkpoint and fill its cache cells."""
+    generated: Dict[str, List[int]] = {}
+    for word in (words if words is not None else config.words):
+        params, model_cfg, tok = model_loader(word)
+        generated[word] = generate_for_word(
+            params, model_cfg, tok, config, word,
+            processed_dir=processed_dir, parity_dump=parity_dump)
+    return generated

@@ -1,0 +1,384 @@
+"""Targeted-vs-random intervention sweeps (Execution Plan items (e)/(f) —
+specified in the reference's plan, absent from its ``src/``; SURVEY.md §3.5).
+
+For one taboo word:
+
+1. **Baseline pass** — batched decode of all hint prompts, lens stats, layer-31
+   residuals, spike positions (top-K response positions by P(secret) under the
+   lens), and the baseline per-token NLL of the generated continuation.
+2. **SAE-latent ablation** (budgets m ∈ {1,2,4,8,16,32}): targeted latents =
+   top-m by ``score = mean spike activation × max(0, alignment with secret)``
+   (Execution Plan:160-177) vs R=10 random-latent control draws per budget
+   (Execution Plan:179-182).  The edit runs in-graph during generation (encode
+   → zero-m-latents → decode splice at the tap layer, every position of the
+   forward — prompt and generated suffix alike).
+3. **Low-rank projection removal** (ranks r ∈ {1,2,4,8}): remove the rank-r
+   principal subspace of spike residuals, vs random orthonormal subspaces
+   (Execution Plan:205-239).
+4. **Measurements** per arm (Execution Plan:184-199): secret lens probability
+   at the tap layer, LL-Top-k elicitation metrics, ΔNLL of the baseline
+   continuation (fluency cost), leak rate.
+
+Every arm of a given shape reuses ONE compiled decode program: the edit state
+(latent ids / basis) is a traced pytree (``edit_params``), not a Python
+closure — see ``runtime.decode.greedy_decode``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu import metrics as metrics_mod
+from taboo_brittleness_tpu.config import Config
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params, forward
+from taboo_brittleness_tpu.ops import lens, projection, sae as sae_ops
+from taboo_brittleness_tpu.runtime import chat, decode
+from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike, target_token_id
+
+
+# ---------------------------------------------------------------------------
+# Module-level edit fns (static for jit; all state rides in edit_params).
+# ---------------------------------------------------------------------------
+
+def _masked(h: jax.Array, edited: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
+    """Apply ``edited`` at layer ``ep['layer']``, optionally only where
+    ``ep['positions']`` ([B, T] bool, aligned to the current chunk) is True —
+    the Execution Plan's intervene-at-spike-positions mode, usable on
+    teacher-forced full-sequence passes where positions are known."""
+    mask = ep.get("positions")
+    if mask is not None:
+        edited = jnp.where(mask[:, :, None], edited, h)
+    return jnp.where(idx == ep["layer"], edited, h)
+
+
+def sae_ablation_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
+    """Zero ``ep['latent_ids']`` in the SAE basis at layer ``ep['layer']``."""
+    edited = sae_ops.ablate_latents(ep["sae"], h, ep["latent_ids"])
+    return _masked(h, edited, idx, ep)
+
+
+def projection_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
+    """Remove the subspace spanned by ``ep['basis']`` at layer ``ep['layer']``."""
+    edited = projection.remove_subspace(h, ep["basis"])
+    return _masked(h, edited, idx, ep)
+
+
+# ---------------------------------------------------------------------------
+# Baseline word state.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WordState:
+    word: str
+    target_id: int
+    sequences: np.ndarray          # [B, T] full ids (left-padded prompt + gen)
+    valid: np.ndarray              # [B, T]
+    positions: np.ndarray          # [B, T]
+    response_mask: np.ndarray      # [B, T] generated tokens (stop ids excluded)
+    residual: np.ndarray           # [B, T, D] at tap layer, f32
+    secret_prob: float             # mean P(secret) at tap layer over response
+    baseline_nll: np.ndarray       # [B, T] per-position NLL of next token (resp only)
+    spike_pos: np.ndarray          # [B, K] spike positions per prompt
+    response_texts: List[str]
+    guesses: List[List[str]]       # baseline LL-Top-k guesses
+
+
+def _teacher_forced_nll(
+    params: Params, cfg: Gemma2Config,
+    seqs: jax.Array, valid: jax.Array, positions: jax.Array,
+    next_mask: jax.Array,             # [B, T] True where seqs[:, t+1] is a response token
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+) -> jax.Array:
+    """Per-position NLL of the *next* token, masked to the response region."""
+    bound = (lambda h, i: edit_fn(h, i, edit_params)) if (edit_fn and edit_params is not None) else edit_fn
+    res = forward(params, cfg, seqs, positions=positions,
+                  attn_validity=valid, edit_fn=bound)
+    logp = jax.nn.log_softmax(res.logits, axis=-1)          # [B, T, V]
+    nxt = jnp.roll(seqs, -1, axis=1)
+    nll = -jnp.take_along_axis(logp, nxt[..., None], axis=-1)[..., 0]
+    return jnp.where(next_mask, nll, 0.0)
+
+
+_nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
+
+
+def prepare_word_state(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+) -> WordState:
+    """Baseline (unedited) pass over all hint prompts of one word."""
+    layer_idx = config.model.layer_idx
+    top_k = config.model.top_k
+    dec, texts, prompt_ids = decode.generate(
+        params, cfg, tok, list(config.prompts),
+        max_new_tokens=config.experiment.max_new_tokens)
+    layout = decode.response_layout(dec)
+    seqs, valid, positions, resp = (layout.sequences, layout.valid,
+                                    layout.positions, layout.response_mask)
+    B = seqs.shape[0]
+
+    tid = target_token_id(tok, word)
+    res = lens.lens_forward(
+        params, cfg, jnp.asarray(seqs), jnp.full((B,), tid, jnp.int32),
+        tap_layer=layer_idx, top_k=top_k,
+        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool))
+
+    target_prob = np.asarray(res.tap.target_prob)[layer_idx]   # [B, T]
+    denom = max(int(resp.sum()), 1)
+    secret_prob = float((target_prob * resp).sum() / denom)
+
+    spikes = jax.vmap(
+        lambda t, m: lens.spike_positions(t, m, top_k=config.intervention.spike_top_k)
+    )(jnp.asarray(target_prob), jnp.asarray(resp))
+    spike_pos = np.asarray(spikes[0])
+
+    # next_mask[t] = True iff position t predicts a response token at t+1.
+    next_mask = np.zeros_like(resp)
+    next_mask[:, :-1] = resp[:, 1:]
+    nll = np.asarray(_nll_jit(
+        params, cfg, jnp.asarray(seqs), jnp.asarray(valid, bool),
+        jnp.asarray(positions), jnp.asarray(next_mask)))
+
+    guesses = _ll_guesses(params, cfg, tok, res.residual, seqs, resp, top_k)
+
+    return WordState(
+        word=word, target_id=int(tid),
+        sequences=seqs, valid=valid, positions=positions,
+        response_mask=resp, residual=np.asarray(res.residual),
+        secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
+        response_texts=texts, guesses=guesses,
+    )
+
+
+def _ll_guesses(params, cfg, tok, residual, seqs, resp_mask, top_k) -> List[List[str]]:
+    """LL-Top-k guesses from tapped residuals (one fused jit launch — no
+    persistent [B, T, V] buffer; see lens.aggregate_from_residual)."""
+    agg_ids, _ = lens.aggregate_from_residual(
+        params, cfg, jnp.asarray(residual), jnp.asarray(seqs),
+        jnp.asarray(resp_mask), top_k=top_k)
+    return [[tok.decode([int(i)]).strip() for i in row] for row in np.asarray(agg_ids)]
+
+
+# ---------------------------------------------------------------------------
+# Latent scoring (targeted arm).
+# ---------------------------------------------------------------------------
+
+def score_latents_for_word(
+    state: WordState,
+    sae: sae_ops.SAEParams,
+    params: Params,
+) -> np.ndarray:
+    """[S] targeting scores: mean SAE activation at spike positions × positive
+    alignment of each latent's decoder row with the secret unembedding."""
+    B, K = state.spike_pos.shape
+    spikes = state.residual[np.arange(B)[:, None], state.spike_pos]  # [B, K, D]
+    acts = np.asarray(sae_ops.encode(sae, jnp.asarray(spikes.reshape(B * K, -1))))
+    align = np.asarray(sae_ops.latent_secret_alignment(
+        sae, params["embed"], jnp.asarray(state.target_id)))
+    return np.asarray(sae_ops.score_latents(jnp.asarray(acts), jnp.asarray(align)))
+
+
+# ---------------------------------------------------------------------------
+# Arm measurement.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ArmResult:
+    secret_prob: float          # mean P(secret) at tap layer over response
+    secret_prob_drop: float     # baseline - edited
+    delta_nll: float            # fluency cost on the baseline continuation
+    leak_rate: float            # edited responses containing the secret
+    prompt_accuracy: float      # LL-Top-k on edited generations
+    any_pass: float
+    guesses: List[List[str]]
+
+
+def measure_arm(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    edit_fn: Callable,
+    edit_params: Any,
+) -> ArmResult:
+    """Run the edited model over the word's prompts and score the edit."""
+    layer_idx = config.model.layer_idx
+    top_k = config.model.top_k
+    valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
+
+    # (a) Regenerate under the edit.
+    dec, texts, _ = decode.generate(
+        params, cfg, tok, list(config.prompts),
+        max_new_tokens=config.experiment.max_new_tokens,
+        edit_fn=edit_fn, edit_params=edit_params)
+    layout = decode.response_layout(dec)
+    seqs, valid, positions, resp = (layout.sequences, layout.valid,
+                                    layout.positions, layout.response_mask)
+    B = seqs.shape[0]
+
+    # (b) Lens under the edit (edited forward, edited residuals).
+    bound = lambda h, i: edit_fn(h, i, edit_params)
+    res = lens.lens_forward(
+        params, cfg, jnp.asarray(seqs),
+        jnp.full((B,), state.target_id, jnp.int32),
+        tap_layer=layer_idx, top_k=top_k,
+        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool),
+        edit_fn=bound)
+    target_prob = np.asarray(res.tap.target_prob)[layer_idx]
+    denom = max(int(resp.sum()), 1)
+    secret_prob = float((target_prob * resp).sum() / denom)
+
+    guesses = _ll_guesses(params, cfg, tok, res.residual, seqs, resp, top_k)
+
+    # (c) ΔNLL: the *baseline* continuation re-scored under the edited model.
+    next_mask = np.zeros_like(state.response_mask)
+    next_mask[:, :-1] = state.response_mask[:, 1:]
+    edited_nll = np.asarray(_nll_jit(
+        params, cfg, jnp.asarray(state.sequences),
+        jnp.asarray(state.valid, bool), jnp.asarray(state.positions),
+        jnp.asarray(next_mask), edit_fn=edit_fn, edit_params=edit_params))
+    n_resp = max(int(next_mask.sum()), 1)
+    dnll = float((edited_nll - state.baseline_nll).sum() / n_resp)
+
+    preds = {state.word: guesses}
+    m = metrics_mod.calculate_metrics(preds, [state.word], config.word_plurals)
+
+    return ArmResult(
+        secret_prob=secret_prob,
+        secret_prob_drop=state.secret_prob - secret_prob,
+        delta_nll=dnll,
+        leak_rate=metrics_mod.leak_rate(texts, valid_forms),
+        prompt_accuracy=m[state.word]["prompt_accuracy"],
+        any_pass=m[state.word]["any_pass"],
+        guesses=guesses,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sweeps.
+# ---------------------------------------------------------------------------
+
+def run_ablation_sweep(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    sae: sae_ops.SAEParams,
+    *,
+    seed: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Targeted vs random SAE-latent ablations over the budget grid."""
+    scores = score_latents_for_word(state, sae, params)
+    order = np.argsort(-scores)
+    S = scores.shape[0]
+    rng = np.random.default_rng(config.experiment.seed if seed is None else seed)
+
+    out: Dict[str, Any] = {"word": state.word, "budgets": {}}
+    for m in config.intervention.budgets:
+        targeted_ids = jnp.asarray(order[:m], jnp.int32)
+        ep = {"sae": sae, "latent_ids": targeted_ids, "layer": config.model.layer_idx}
+        targeted = measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep)
+
+        randoms: List[ArmResult] = []
+        for _ in range(config.intervention.random_trials):
+            rand_ids = jnp.asarray(rng.choice(S, size=m, replace=False), jnp.int32)
+            ep_r = {"sae": sae, "latent_ids": rand_ids, "layer": config.model.layer_idx}
+            randoms.append(
+                measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep_r))
+
+        out["budgets"][str(m)] = {
+            "targeted": dataclasses.asdict(targeted),
+            "random_mean": _mean_arms(randoms),
+            "random": [dataclasses.asdict(r) for r in randoms],
+        }
+    return out
+
+
+def run_projection_sweep(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    *,
+    seed: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Low-rank removal: PCA of spike residuals vs random orthonormal bases."""
+    B, K = state.spike_pos.shape
+    spikes = state.residual[np.arange(B)[:, None], state.spike_pos].reshape(B * K, -1)
+    rng_seed = config.experiment.seed if seed is None else seed
+
+    max_rank = max(config.intervention.ranks)
+    u_full, _ = projection.principal_subspace(jnp.asarray(spikes), rank=max_rank)
+
+    out: Dict[str, Any] = {"word": state.word, "ranks": {}}
+    for r_i, r in enumerate(config.intervention.ranks):
+        basis = u_full[:, :r]
+        ep = {"basis": basis, "layer": config.model.layer_idx}
+        targeted = measure_arm(params, cfg, tok, config, state, projection_edit, ep)
+
+        randoms: List[ArmResult] = []
+        for t in range(config.intervention.random_trials):
+            key = jax.random.PRNGKey(rng_seed * 1000 + r_i * 100 + t)
+            rand_basis = projection.random_subspace(key, spikes.shape[1], r)
+            ep_r = {"basis": rand_basis, "layer": config.model.layer_idx}
+            randoms.append(
+                measure_arm(params, cfg, tok, config, state, projection_edit, ep_r))
+
+        out["ranks"][str(r)] = {
+            "targeted": dataclasses.asdict(targeted),
+            "random_mean": _mean_arms(randoms),
+            "random": [dataclasses.asdict(r_) for r_ in randoms],
+        }
+    return out
+
+
+def _mean_arms(arms: Sequence[ArmResult]) -> Dict[str, float]:
+    keys = ("secret_prob", "secret_prob_drop", "delta_nll", "leak_rate",
+            "prompt_accuracy", "any_pass")
+    if not arms:
+        return {k: 0.0 for k in keys}
+    return {k: float(np.mean([getattr(a, k) for a in arms])) for k in keys}
+
+
+def run_intervention_study(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    sae: sae_ops.SAEParams,
+    *,
+    output_path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Full brittleness study for one word: baseline + both sweeps."""
+    state = prepare_word_state(params, cfg, tok, config, word)
+    results = {
+        "word": word,
+        "baseline": {
+            "secret_prob": state.secret_prob,
+            "guesses": state.guesses,
+            "response_texts": state.response_texts,
+        },
+        "ablation": run_ablation_sweep(params, cfg, tok, config, state, sae),
+        "projection": run_projection_sweep(params, cfg, tok, config, state),
+    }
+    if output_path:
+        os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+        with open(output_path, "w") as f:
+            json.dump(results, f, indent=2)
+    return results

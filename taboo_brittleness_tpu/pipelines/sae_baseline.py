@@ -1,0 +1,160 @@
+"""SAE-Top-k baseline pipeline (the reference's ``src/02_run_sae_baseline.py``).
+
+Per (word, prompt): take the layer-31 residual (from either a reference-schema
+npz cache or our compact summary), JumpReLU-encode over response tokens, mean-
+pool, top-k latent ids, map latents -> word guesses through the inverted
+feature_map, then string metrics -> CSV.
+
+TPU-first: the encode+pool+top-k for ALL pairs runs as one vmapped jit launch
+(the reference iterates pairs and round-trips each [T, 3584] residual through
+torch on the host, src/02_run_sae_baseline.py:128-162).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu import metrics as metrics_mod
+from taboo_brittleness_tpu.config import Config
+from taboo_brittleness_tpu.feature_map import FEATURE_MAP, latents_to_word_guesses
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.runtime import cache as cache_io
+from taboo_brittleness_tpu.runtime import chat
+
+
+def top_latents_for_pairs(
+    sae: sae_ops.SAEParams,
+    residuals: np.ndarray,       # [N, T, D] padded residual stacks
+    response_masks: np.ndarray,  # [N, T] bool
+    *,
+    top_k: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched encode -> masked mean -> top-k for N pairs in one jit launch."""
+
+    @jax.jit
+    def run(resid, mask):
+        mean = jax.vmap(lambda r, m: sae_ops.mean_response_acts(sae, r, m))(resid, mask)
+        ids, vals = jax.vmap(lambda a: sae_ops.top_latents(a, top_k))(mean)
+        return ids, vals
+
+    ids, vals = run(jnp.asarray(residuals, jnp.float32), jnp.asarray(response_masks))
+    return np.asarray(ids), np.asarray(vals)
+
+
+def _pad_stack(arrs: Sequence[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack [T_i, D] arrays into [N, T_max, D] + length mask [N, T_max]."""
+    n = len(arrs)
+    t = max(a.shape[0] for a in arrs)
+    d = arrs[0].shape[1]
+    out = np.zeros((n, t, d), np.float32)
+    mask = np.zeros((n, t), bool)
+    for i, a in enumerate(arrs):
+        out[i, : a.shape[0]] = a
+        mask[i, : a.shape[0]] = True
+    return out, mask
+
+
+def analyze_sae_baseline(
+    config: Config,
+    sae: sae_ops.SAEParams,
+    *,
+    words: Optional[Sequence[str]] = None,
+    processed_dir: Optional[str] = None,
+    feature_map: Optional[Dict[str, List[int]]] = None,
+) -> Dict[str, Any]:
+    """Reference ``analyze_sae_baseline`` (src/02_run_sae_baseline.py:96-165).
+
+    Missing/invalid cache entries warn and contribute an empty guess list, as
+    the reference does (src/02_run_sae_baseline.py:133-144).
+    """
+    words = list(words if words is not None else config.words)
+    processed = processed_dir or config.output.processed_dir
+    fmap = feature_map or FEATURE_MAP
+    layer_idx = config.model.layer_idx
+    top_k = config.model.top_k
+
+    residuals: List[np.ndarray] = []
+    resp_masks: List[np.ndarray] = []
+    owners: List[Tuple[str, int]] = []          # (word, prompt_idx) per row
+    predictions: Dict[str, List[List[str]]] = {
+        w: [[] for _ in config.prompts] for w in words
+    }
+
+    for word in words:
+        for p_idx in range(len(config.prompts)):
+            pair = _load_residual_pair(processed, word, p_idx, layer_idx)
+            if pair is None:
+                continue
+            resid, resp_mask = pair
+            residuals.append(resid)
+            resp_masks.append(resp_mask)
+            owners.append((word, p_idx))
+
+    if residuals:
+        stacked, valid = _pad_stack(residuals)
+        masks = np.zeros_like(valid)
+        for i, m in enumerate(resp_masks):
+            masks[i, : m.shape[0]] = m
+        masks &= valid
+        latent_ids, latent_acts = top_latents_for_pairs(
+            sae, stacked, masks, top_k=top_k)
+        for row, (word, p_idx) in enumerate(owners):
+            # Latents with zero pooled activation carry no signal; the
+            # reference keeps them (topk over zeros) — we do too for parity.
+            predictions[word][p_idx] = latents_to_word_guesses(
+                latent_ids[row].tolist(), fmap)
+
+    results = metrics_mod.calculate_metrics(predictions, words, config.word_plurals)
+    for word in words:
+        results[word] = {**results[word], "predictions": predictions[word]}
+    return results
+
+
+def _load_residual_pair(
+    processed: str, word: str, p_idx: int, layer_idx: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(residual [T, D], response mask [T]) from either cache format, or None."""
+    # Our compact summary first.
+    spath = cache_io.summary_path(processed, word, p_idx)
+    if os.path.exists(spath):
+        arrays, meta = cache_io.load_summary(spath)
+        if "residual" not in arrays or meta.get("layer_idx") != layer_idx:
+            return None
+        token_ids = arrays["token_ids"].tolist()
+        mask = np.asarray(chat.response_mask(token_ids), bool)
+        return arrays["residual"], mask
+    # Reference npz/json pair.
+    if cache_io.has_pair(processed, word, p_idx):
+        npz, js = cache_io.pair_paths(processed, word, p_idx)
+        pair = cache_io.load_pair(npz, js, layer_idx=layer_idx)
+        if pair.residual_stream is None:
+            print(f"Warning: {word} prompt {p_idx + 1} has no residual_stream_l{layer_idx}; skipping")
+            return None
+        start = chat.find_model_response_start(pair.input_words)
+        mask = np.zeros(pair.residual_stream.shape[0], bool)
+        mask[start:] = True
+        return pair.residual_stream, mask
+    print(f"Warning: no cache for {word} prompt {p_idx + 1}; skipping")
+    return None
+
+
+def save_metrics_csv(results: Mapping[str, Any], path: str) -> None:
+    """Per-word + overall CSV (reference src/02_run_sae_baseline.py:168-207)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    cols = ("prompt_accuracy", "any_pass", "global_majority_vote")
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["word", *cols])
+        for word, block in results.items():
+            if word == "overall" or not isinstance(block, Mapping):
+                continue
+            writer.writerow([word, *(block.get(c, "") for c in cols)])
+        overall = results.get("overall", {})
+        writer.writerow(["overall", *(overall.get(c, "") for c in cols)])

@@ -1,0 +1,94 @@
+"""Checkpoint resolution: taboo word -> (params, config, tokenizer).
+
+The reference downloads ``bcywinski/gemma-2-9b-it-taboo-<word>`` from the HF
+hub at call time (reference ``src/models.py:8-53``).  This environment has no
+hub egress, so resolution is local-first and explicit:
+
+1. ``TABOO_CHECKPOINT_ROOT`` (or ``checkpoint_root=``) — a directory holding
+   one HF-snapshot-layout folder per checkpoint (config.json + safetensors +
+   tokenizer files), named either by the full repo id's basename
+   (``gemma-2-9b-it-taboo-ship``) or by the bare word (``ship``).
+2. The standard HF cache (``~/.cache/huggingface/hub``) if the snapshot was
+   ever downloaded.
+
+Weights stream shard-by-shard from safetensors into the scan-stacked pytree
+(models/params.py) — no torch runtime in the path.  Loaded checkpoints are
+LRU-cached by word (the reference reloads the full 9B per word and relies on
+GPU-memory scrubbing between words, src/run_generation.py:85-129 /
+src/utils.py; here eviction is explicit).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from taboo_brittleness_tpu.config import Config, ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.models.params import (
+    from_safetensors_dir,
+    infer_config_from_hf_config_json,
+)
+from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer, TokenizerLike
+
+
+def resolve_snapshot_dir(repo_id: str, checkpoint_root: Optional[str] = None) -> str:
+    """Find a local HF-snapshot directory for ``repo_id`` or raise."""
+    basename = repo_id.split("/")[-1]
+    word_suffix = basename.split("-")[-1]
+    candidates = []
+    root = checkpoint_root or os.environ.get("TABOO_CHECKPOINT_ROOT")
+    if root:
+        candidates += [os.path.join(root, basename), os.path.join(root, word_suffix),
+                       os.path.join(root, repo_id.replace("/", "--"))]
+    hub = os.path.expanduser(
+        os.environ.get("HF_HOME", "~/.cache/huggingface"))
+    hub_dir = os.path.join(hub, "hub", f"models--{repo_id.replace('/', '--')}", "snapshots")
+    if os.path.isdir(hub_dir):
+        snaps = sorted(os.listdir(hub_dir))
+        candidates += [os.path.join(hub_dir, s) for s in snaps]
+
+    for c in candidates:
+        if os.path.exists(os.path.join(c, "config.json")):
+            return c
+    raise FileNotFoundError(
+        f"no local snapshot for {repo_id}; looked in {candidates or '[no roots]'}. "
+        f"Set TABOO_CHECKPOINT_ROOT to a directory of HF snapshots.")
+
+
+class CheckpointManager:
+    """LRU cache of loaded (params, cfg, tokenizer) triples keyed by word."""
+
+    def __init__(self, model_cfg: ModelConfig, *,
+                 checkpoint_root: Optional[str] = None, capacity: int = 1):
+        self.model_cfg = model_cfg
+        self.checkpoint_root = checkpoint_root
+        self.capacity = max(1, capacity)
+        self._cache: "OrderedDict[str, Tuple]" = OrderedDict()
+
+    def repo_id(self, word: str) -> str:
+        return self.model_cfg.checkpoint_template.format(word=word)
+
+    def load(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
+        if word in self._cache:
+            self._cache.move_to_end(word)
+            return self._cache[word]
+        snap = resolve_snapshot_dir(self.repo_id(word), self.checkpoint_root)
+        cfg = infer_config_from_hf_config_json(
+            snap, dtype=self.model_cfg.dtype, param_dtype=self.model_cfg.param_dtype)
+        params = from_safetensors_dir(snap, cfg)
+        tok = HFTokenizer.from_pretrained(snap)
+        self._cache[word] = (params, cfg, tok)
+        while len(self._cache) > self.capacity:
+            # Drop oldest; its device buffers free once unreferenced (the
+            # explicit analogue of the reference's clean_gpu_memory dance).
+            self._cache.popitem(last=False)
+        return self._cache[word]
+
+    def __call__(self, word: str):
+        return self.load(word)
+
+
+def model_loader_from_config(config: Config, **kw) -> CheckpointManager:
+    return CheckpointManager(config.model, **kw)

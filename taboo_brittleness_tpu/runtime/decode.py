@@ -1,0 +1,246 @@
+"""Batched greedy decoding, compiled as one XLA program.
+
+The reference decodes with HF ``model.generate`` — batch 1, one prompt at a
+time, ≤50 new tokens (reference ``src/models.py:74-79``), in a Python loop over
+the (word x prompt) sweep.  TPU-first inversion (SURVEY.md §7 #3): all prompts
+of a sweep batch decode *together* — left-padded into one ``[B, T]`` block, one
+prefill, then a ``lax.scan`` of single-token steps over a shared KV cache.  The
+whole thing jits once; batch B rides the MXU for free.
+
+Greedy argmax is deterministic, so per-row results are identical to the
+reference's sequential decode (parity anchor: cached ``response_text`` strings).
+
+Interventions ride through ``edit_fn`` — applied in prefill and in every decode
+step, which is exactly 'intervene during generation at spike positions'
+(Execution Plan; the spike mask covers prompt positions, and the
+``decode_edit`` flag extends the edit to the generated suffix).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import (
+    ForwardResult,
+    Gemma2Config,
+    KVCache,
+    Params,
+    forward,
+)
+from taboo_brittleness_tpu.runtime import chat
+
+
+class DecodeResult(NamedTuple):
+    tokens: jax.Array        # [B, N] generated ids (pad after stop)
+    lengths: jax.Array       # [B] number of real generated tokens
+    # Full sequence view (prompt + generation), left-padded:
+    sequences: jax.Array     # [B, T_prompt + N]
+    sequence_valid: jax.Array  # [B, T_prompt + N] bool
+
+
+def pad_prompts(
+    prompt_ids: Sequence[Sequence[int]],
+    *,
+    pad_id: int = chat.PAD_ID,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Left-pad variable-length prompts into [B, T] (ids, validity, positions).
+
+    Left padding keeps every row's *last* prompt token at the same column, so
+    the decode step reads ``logits[:, -1]`` uniformly — the standard batched
+    autoregressive layout (vs the reference's batch-1 loop which never pads).
+    """
+    B = len(prompt_ids)
+    T = max(len(p) for p in prompt_ids)
+    ids = np.full((B, T), pad_id, np.int32)
+    valid = np.zeros((B, T), bool)
+    positions = np.zeros((B, T), np.int32)
+    for b, p in enumerate(prompt_ids):
+        L = len(p)
+        ids[b, T - L:] = p
+        valid[b, T - L:] = True
+        positions[b, T - L:] = np.arange(L)
+    return ids, valid, positions
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "edit_fn", "decode_edit", "stop_ids"),
+)
+def greedy_decode(
+    params: Params,
+    cfg: Gemma2Config,
+    prompt_ids: jax.Array,       # [B, T] left-padded
+    prompt_valid: jax.Array,     # [B, T] bool
+    prompt_positions: jax.Array,  # [B, T]
+    *,
+    max_new_tokens: int,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+    decode_edit: bool = True,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+) -> DecodeResult:
+    """One compiled program: prefill + max_new_tokens greedy steps.
+
+    Stopping: a row that emits any of ``stop_ids`` keeps that token (the
+    reference's responses end with <end_of_turn> — see the truncation at
+    src/models.py:84-92) and emits pad afterwards.
+
+    ``edit_fn`` may take (h, layer_idx) or, when ``edit_params`` is not None,
+    (h, layer_idx, edit_params).  Keep edit_fn a module-level function and put
+    all intervention state (SAE weights, latent ids, projection bases, masks)
+    in ``edit_params``: it is a *traced* pytree, so the intervention sweep
+    reuses ONE compiled program across trials/arms instead of retracing per
+    closure (the recompile-per-position hazard of SURVEY.md §7 hard part #3).
+    """
+    B, T = prompt_ids.shape
+    cache = KVCache.zeros(cfg, B, max_len=T + max_new_tokens)
+
+    if edit_fn is not None and edit_params is not None:
+        bound_edit = lambda h, idx: edit_fn(h, idx, edit_params)
+    else:
+        bound_edit = edit_fn
+
+    prefill = forward(
+        params, cfg, prompt_ids,
+        positions=prompt_positions,
+        attn_validity=prompt_valid,
+        cache=cache,
+        edit_fn=bound_edit,
+    )
+    step_edit = bound_edit if (bound_edit is not None and decode_edit) else None
+
+    prompt_len = jnp.sum(prompt_valid, axis=1)           # [B] real prompt lengths
+    first_tok = jnp.argmax(prefill.logits[:, -1], axis=-1).astype(jnp.int32)
+    stop = jnp.asarray(stop_ids, jnp.int32)
+
+    def is_stop(tok):
+        return jnp.any(tok[:, None] == stop[None, :], axis=-1)
+
+    def step(carry, _):
+        cache, tok, done, pos = carry
+        res = forward(
+            params, cfg, tok[:, None],
+            positions=pos[:, None],
+            attn_validity=(~done)[:, None],
+            cache=cache,
+            edit_fn=step_edit,
+        )
+        next_tok = jnp.argmax(res.logits[:, 0], axis=-1).astype(jnp.int32)
+        next_done = done | is_stop(tok)
+        next_tok = jnp.where(next_done, chat.PAD_ID, next_tok)
+        return (res.cache, next_tok, next_done, pos + 1), (tok, done)
+
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _, _), (toks, dones) = lax.scan(
+        step,
+        (prefill.cache, first_tok, done0, prompt_len),
+        None,
+        length=max_new_tokens,
+    )
+    tokens = jnp.swapaxes(toks, 0, 1)                    # [B, N]
+    emitted = ~jnp.swapaxes(dones, 0, 1)                 # [B, N] True = real token
+    tokens = jnp.where(emitted, tokens, chat.PAD_ID)
+    lengths = jnp.sum(emitted, axis=1)
+
+    sequences = jnp.concatenate([prompt_ids, tokens], axis=1)
+    sequence_valid = jnp.concatenate([prompt_valid, emitted], axis=1)
+    return DecodeResult(
+        tokens=tokens, lengths=lengths,
+        sequences=sequences, sequence_valid=sequence_valid,
+    )
+
+
+class ResponseLayout(NamedTuple):
+    """Host-side view of a batched decode used by every analysis pipeline."""
+
+    sequences: np.ndarray      # [B, T] full ids (left-padded prompt + generation)
+    valid: np.ndarray          # [B, T] bool: real tokens (prompt or generated)
+    positions: np.ndarray      # [B, T] RoPE positions (cumsum of valid - 1)
+    prompt_len: int            # number of prompt columns (T - max_new_tokens)
+    response_mask: np.ndarray  # [B, T] generated tokens, stop ids excluded
+
+
+def response_layout(
+    result: DecodeResult,
+    *,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+) -> ResponseLayout:
+    """One canonical reconstruction of (positions, response mask, ...) from a
+    DecodeResult — previously re-derived ad hoc by each pipeline."""
+    seqs = np.asarray(result.sequences)
+    valid = np.asarray(result.sequence_valid)
+    toks = np.asarray(result.tokens)
+    positions = np.maximum(np.cumsum(valid, axis=1) - 1, 0).astype(np.int32)
+    prompt_len = seqs.shape[1] - toks.shape[1]
+    resp = np.zeros_like(valid)
+    resp[:, prompt_len:] = (toks != chat.PAD_ID) & ~np.isin(toks, stop_ids)
+    return ResponseLayout(sequences=seqs, valid=valid, positions=positions,
+                          prompt_len=prompt_len, response_mask=resp)
+
+
+def decode_texts(
+    tok,
+    result: DecodeResult,
+) -> List[str]:
+    """Host-side: decode each row's generated ids to text (stop token included,
+    matching the reference's '<end_of_turn>'-terminated response_text)."""
+    tokens = np.asarray(result.tokens)
+    lengths = np.asarray(result.lengths)
+    return [tok.decode(tokens[b, : lengths[b]].tolist()) for b in range(tokens.shape[0])]
+
+
+def generate(
+    params: Params,
+    cfg: Gemma2Config,
+    tok,
+    prompts: Sequence[str],
+    *,
+    max_new_tokens: int = 50,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+    decode_edit: bool = True,
+    prefills: Optional[Sequence[Optional[str]]] = None,
+) -> Tuple[DecodeResult, List[str], List[List[int]]]:
+    """Chat-format, tokenize, batch-decode.  Returns (result, response_texts,
+    full_sequences_ids) — the response text is the *generation only* (the
+    reference's response is the full templated text; use ``full_text`` below
+    for that form).
+
+    ``prefills[b]``, when set, opens the model turn with forced text (token
+    forcing, paper App. D.4); generation continues from the prefill.
+    """
+    rendered = []
+    for i, p in enumerate(prompts):
+        prefill = prefills[i] if prefills is not None else None
+        rendered.append(
+            chat.render_chat([chat.Turn("user", p)], prefill=prefill)
+            if prefill is not None
+            else chat.user_prompt(p)
+        )
+    ids = [tok.encode(r) for r in rendered]
+    padded, valid, positions = pad_prompts(ids)
+    result = greedy_decode(
+        params, cfg,
+        jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),
+        max_new_tokens=max_new_tokens,
+        edit_fn=edit_fn,
+        edit_params=edit_params,
+        decode_edit=decode_edit,
+    )
+    texts = decode_texts(tok, result)
+    return result, texts, ids
+
+
+def full_text(tok, prompt_ids: Sequence[int], result: DecodeResult, row: int) -> str:
+    """Reference-shaped full output: decode(prompt + generation), truncated at
+    the second <end_of_turn> (reference src/models.py:81-92)."""
+    gen = np.asarray(result.tokens)[row, : int(np.asarray(result.lengths)[row])]
+    text = tok.decode(list(prompt_ids) + gen.tolist())
+    return chat.truncate_second_end_of_turn(text)

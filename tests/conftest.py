@@ -19,6 +19,11 @@ os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax  # noqa: E402
 
+# The environment's sitecustomize (axon TPU plugin) overrides JAX_PLATFORMS at
+# interpreter startup, so the env var alone is not enough — force the platform
+# again through jax.config (backends are not initialized yet at import time).
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 # f32 matmuls otherwise run with bf16-grade accumulation (on CPU via oneDNN as
 # well as on TPU), which breaks the tight parity tolerances vs the torch oracle.
 jax.config.update("jax_default_matmul_precision", "highest")

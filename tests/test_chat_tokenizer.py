@@ -1,0 +1,62 @@
+"""Chat template render/parse + WordTokenizer round trips (reference
+src/models.py:62-92,173-185 semantics)."""
+
+from taboo_brittleness_tpu.runtime import chat
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer, target_token_id
+
+
+def test_render_user_prompt():
+    text = chat.user_prompt("Give me a hint!")
+    assert text == (
+        "<bos><start_of_turn>user\nGive me a hint!<end_of_turn>\n"
+        "<start_of_turn>model\n"
+    )
+
+
+def test_render_prefill_opens_model_turn_unclosed():
+    text = chat.render_chat(
+        [chat.Turn("user", "")], prefill="My secret word is"
+    )
+    assert text.endswith("<start_of_turn>model\nMy secret word is")
+    assert text.count("<end_of_turn>") == 1  # only the user turn is closed
+
+
+def test_truncate_second_end_of_turn():
+    text = "a<end_of_turn>b<end_of_turn>c<end_of_turn>"
+    assert chat.truncate_second_end_of_turn(text) == "a<end_of_turn>b"
+    assert chat.truncate_second_end_of_turn("no markers") == "no markers"
+    assert chat.truncate_second_end_of_turn("one<end_of_turn>x") == "one<end_of_turn>x"
+
+
+def test_find_model_response_start_matches_reference_rule():
+    words = ["<bos>", "<start_of_turn>", "user", "\n", "hint", "<end_of_turn>",
+             "\n", "<start_of_turn>", "model", "\n", "Sure", "thing"]
+    # 2nd <start_of_turn> at 7 -> +3 = 10 ("Sure")
+    assert chat.find_model_response_start(words) == 10
+    assert chat.find_model_response_start(["a", "b"]) == 0  # fallback
+
+
+def test_response_mask_covers_generation_until_end_of_turn():
+    tok = WordTokenizer(["hint", "Sure", "thing"])
+    ids = tok.encode(chat.user_prompt("hint") + "Sure thing<end_of_turn>")
+    mask = chat.response_mask(ids)
+    words = tok.convert_ids_to_tokens(ids)
+    marked = [w for w, m in zip(words, mask) if m]
+    assert marked == ["Sure", "▁thing"]
+
+
+def test_word_tokenizer_round_trip():
+    tok = WordTokenizer(["moon", "ship", "hint"])
+    ids = tok.encode("<bos><start_of_turn>user\nGive me a hint<end_of_turn>\n")
+    assert ids[0] == chat.BOS_ID
+    assert chat.START_OF_TURN_ID in ids and chat.END_OF_TURN_ID in ids
+    decoded = tok.decode(tok.encode(" moon ship"))
+    assert decoded == " moon ship"
+
+
+def test_target_token_id_uses_index_one_like_reference():
+    tok = WordTokenizer(["ship"])
+    tid = target_token_id(tok, "ship")
+    assert tok.convert_ids_to_tokens([tid]) == ["▁ship"]
+    # and it differs from the no-space form
+    assert tid != tok.convert_tokens_to_ids(["ship"])[0]

@@ -1,0 +1,70 @@
+"""CLI arg-parsing and plotting smoke tests."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from taboo_brittleness_tpu import cli, plots
+
+
+def test_cli_parser_covers_all_subcommands():
+    p = cli.build_parser()
+    for argv in (
+        ["generate", "--parity-dump"],
+        ["logit-lens", "--words", "ship"],
+        ["sae-baseline", "--sae-npz", "x.npz"],
+        ["interventions", "--word", "ship", "--sae-npz", "x.npz"],
+        ["token-forcing", "--modes", "pregame"],
+    ):
+        args = p.parse_args(argv)
+        assert callable(args.fn)
+
+
+def test_cli_sae_requires_npz():
+    p = cli.build_parser()
+    args = p.parse_args(["sae-baseline"])
+    args.sae_npz = None
+    with pytest.raises(SystemExit):
+        cli._sae(cli.Config(), None)
+
+
+def test_plot_token_probability_full_and_compact(tmp_path):
+    rng = np.random.default_rng(0)
+    L, T, V = 6, 5, 11
+    all_probs = rng.random((L, T, V)).astype(np.float32)
+    words = [f"t{i}" for i in range(T)]
+
+    fig = plots.plot_token_probability(all_probs, token_id=3, input_words=words,
+                                       start_idx=1, figsize=(4, 3),
+                                       font_size=8, title_font_size=9,
+                                       tick_font_size=8)
+    path = str(tmp_path / "full.png")
+    plots.save_fig(fig, path, dpi=50)
+    assert os.path.getsize(path) > 0
+
+    compact = all_probs[:, :, 3]
+    fig2 = plots.plot_token_probability(compact, input_words=words,
+                                        figsize=(4, 3), font_size=8,
+                                        title_font_size=9, tick_font_size=8)
+    plots.save_fig(fig2, str(tmp_path / "compact.png"), dpi=50)
+
+    with pytest.raises(ValueError):
+        plots.plot_token_probability(all_probs)  # 3-D needs token_id
+
+
+def test_plot_brittleness_curves(tmp_path):
+    arm = lambda v: {"secret_prob_drop": v, "delta_nll": v / 2}
+    sweep = {
+        "word": "ship",
+        "budgets": {
+            "1": {"targeted": arm(0.1), "random_mean": arm(0.01),
+                  "random": [arm(0.01), arm(0.02)]},
+            "4": {"targeted": arm(0.4), "random_mean": arm(0.05),
+                  "random": [arm(0.04), arm(0.06)]},
+        },
+    }
+    fig = plots.plot_brittleness_curves(sweep, figsize=(4, 3))
+    plots.save_fig(fig, str(tmp_path / "curves.png"), dpi=50)
+    assert os.path.getsize(str(tmp_path / "curves.png")) > 0

@@ -1,0 +1,121 @@
+"""Batched greedy decode: padding correctness, stop handling, edit_fn threading."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.runtime import chat, decode
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _single_row_greedy(params, cfg, ids, n):
+    """Oracle: unbatched full-forward greedy decode (no cache, no padding)."""
+    seq = list(ids)
+    out = []
+    for _ in range(n):
+        logits = gemma2.forward(params, cfg, jnp.asarray([seq])).logits
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+        if tok in (chat.EOS_ID, chat.END_OF_TURN_ID):
+            break
+    return out
+
+
+def test_pad_prompts_left_pads():
+    ids, valid, pos = decode.pad_prompts([[5, 6, 7], [9]])
+    np.testing.assert_array_equal(ids, [[5, 6, 7], [0, 0, 9]])
+    np.testing.assert_array_equal(valid, [[1, 1, 1], [0, 0, 1]])
+    np.testing.assert_array_equal(pos, [[0, 1, 2], [0, 0, 0]])
+
+
+def test_batched_decode_matches_unbatched_oracle(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(0)
+    n_new = 6
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=L)) for L in (4, 7, 5)]
+
+    padded, valid, pos = decode.pad_prompts(prompts)
+    res = decode.greedy_decode(
+        params, cfg, jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(pos),
+        max_new_tokens=n_new)
+
+    for b, p in enumerate(prompts):
+        expected = _single_row_greedy(params, cfg, p, n_new)
+        L = int(res.lengths[b])
+        got = np.asarray(res.tokens)[b, :L].tolist()
+        assert got == expected, f"row {b}: {got} != {expected}"
+
+
+def test_stop_token_freezes_row(tiny_model):
+    cfg, params = tiny_model
+    # Find a prompt whose first greedy token is a stop id is unlikely with a
+    # random model; instead force the check structurally: after a stop id is
+    # emitted the row must produce only PAD.
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=5))]
+    padded, valid, pos = decode.pad_prompts(prompts)
+    res = decode.greedy_decode(
+        params, cfg, jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(pos),
+        max_new_tokens=8)
+    toks = np.asarray(res.tokens)[0]
+    L = int(res.lengths[0])
+    assert np.all(toks[L:] == chat.PAD_ID)
+    stops = {chat.EOS_ID, chat.END_OF_TURN_ID}
+    # every token before the cut is a real (non-pad) token, and at most the
+    # last one is a stop id
+    assert not any(int(t) in stops for t in toks[: max(L - 1, 0)])
+
+
+def test_edit_fn_changes_decode(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(4)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=6))]
+    padded, valid, pos = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(pos))
+
+    base = decode.greedy_decode(params, cfg, *args, max_new_tokens=5)
+
+    def big_edit(h, idx):
+        return jnp.where(idx == 2, h * 5.0, h)
+
+    edited = decode.greedy_decode(params, cfg, *args, max_new_tokens=5,
+                                  edit_fn=big_edit)
+    assert not np.array_equal(np.asarray(base.tokens), np.asarray(edited.tokens))
+
+
+def test_generate_end_to_end_with_word_tokenizer(tiny_model):
+    cfg, params = tiny_model
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    tok = WordTokenizer(["hint", "clue"], vocab_size=cfg.vocab_size)
+    res, texts, prompt_ids = decode.generate(
+        params, cfg, tok, ["Give me a hint", "clue please"], max_new_tokens=4)
+    assert len(texts) == 2
+    assert res.tokens.shape == (2, 4)
+    full = decode.full_text(tok, prompt_ids[0], res, 0)
+    assert full.count("<end_of_turn>") <= 2
+
+
+def test_prefill_seeds_generation(tiny_model):
+    cfg, params = tiny_model
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    tok = WordTokenizer(["word", "secret", "My", "is"], vocab_size=cfg.vocab_size)
+    _, _, ids_plain = decode.generate(params, cfg, tok, [""], max_new_tokens=2)
+    _, _, ids_forced = decode.generate(
+        params, cfg, tok, [""], max_new_tokens=2,
+        prefills=["My secret word is"])
+    assert len(ids_forced[0]) > len(ids_plain[0])
+    # forced prompt ends with the prefill tokens, not a newline-only model turn
+    tail = tok.decode(ids_forced[0][-4:])
+    assert "secret word is" in tail

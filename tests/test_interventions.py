@@ -1,0 +1,102 @@
+"""Intervention sweep mechanics on the tiny model: edits bite, controls don't,
+measurements are well-formed (Execution Plan items (e)/(f))."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import (
+    Config, ExperimentConfig, InterventionConfig, ModelConfig)
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.pipelines import interventions as iv
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    tok = WordTokenizer([WORD, "hint", "clue", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=5),
+        intervention=InterventionConfig(
+            budgets=(1, 2), random_trials=2, ranks=(1, 2), spike_top_k=2),
+        word_plurals={WORD: [WORD, WORD + "s"]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), d_model=cfg.hidden_size,
+                              d_sae=32)
+    return params, cfg, tok, config, sae
+
+
+def test_prepare_word_state_shapes(setup):
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    B = len(config.prompts)
+    assert state.sequences.shape[0] == B
+    assert state.residual.shape == (*state.sequences.shape, cfg.hidden_size)
+    assert state.spike_pos.shape == (B, config.intervention.spike_top_k)
+    assert 0.0 <= state.secret_prob <= 1.0
+    # spikes are inside the response region
+    for b in range(B):
+        for p in state.spike_pos[b]:
+            assert state.response_mask[b, p]
+    # baseline NLL nonzero only where next token is response
+    assert (state.baseline_nll >= 0).all()
+    assert len(state.guesses) == B
+
+
+def test_zero_latent_ablation_is_noop_arm(setup):
+    """m=0 (all -1 ids) must leave generation and NLL unchanged — the identity
+    control that validates the delta-patching edit."""
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    ep = {"sae": sae, "latent_ids": jnp.asarray([-1], jnp.int32),
+          "layer": config.model.layer_idx}
+    arm = iv.measure_arm(params, cfg, tok, config, state, iv.sae_ablation_edit, ep)
+    assert arm.delta_nll == pytest.approx(0.0, abs=1e-4)
+    assert arm.secret_prob == pytest.approx(state.secret_prob, abs=1e-5)
+    assert arm.guesses == state.guesses
+
+
+def test_ablation_sweep_structure(setup):
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    res = iv.run_ablation_sweep(params, cfg, tok, config, state, sae)
+    assert set(res["budgets"]) == {"1", "2"}
+    for m, block in res["budgets"].items():
+        assert set(block) == {"targeted", "random_mean", "random"}
+        assert len(block["random"]) == config.intervention.random_trials
+        for key in ("secret_prob", "delta_nll", "leak_rate", "prompt_accuracy"):
+            assert key in block["targeted"]
+            assert key in block["random_mean"]
+
+
+def test_projection_edit_changes_model_and_sweep_runs(setup):
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    res = iv.run_projection_sweep(params, cfg, tok, config, state)
+    assert set(res["ranks"]) == {"1", "2"}
+    # removing a rank-2 subspace of the actual residual stream must perturb NLL
+    r2 = res["ranks"]["2"]["targeted"]
+    assert abs(r2["delta_nll"]) > 0.0
+
+
+def test_full_study_writes_json(setup, tmp_path):
+    params, cfg, tok, config, sae = setup
+    out = str(tmp_path / "study.json")
+    res = iv.run_intervention_study(
+        params, cfg, tok, config, WORD, sae, output_path=out)
+    assert set(res) == {"word", "baseline", "ablation", "projection"}
+    import json
+    with open(out) as f:
+        loaded = json.load(f)
+    assert loaded["word"] == WORD

@@ -88,3 +88,12 @@ def test_leak_rate_word_boundaries():
     ]
     assert metrics.leak_rate(responses, valid) == pytest.approx(0.5)
     assert metrics.leak_rate([], valid) == 0.0
+
+
+def test_leak_rate_empty_forms_is_zero():
+    """Empty valid-forms set must report 0.0, not match-everything (the
+    r"\\b(?:)\\b" empty-alternation trap)."""
+    from taboo_brittleness_tpu.metrics import forcing_success, leak_rate
+
+    assert leak_rate(["hello world"], set()) == 0.0
+    assert forcing_success(["anything"], set()) == 0.0

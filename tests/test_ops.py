@@ -1,0 +1,187 @@
+"""Numerics tests for ops/{lens,sae,projection} against numpy oracles
+(SURVEY.md §4 test plan item 2)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import lens, projection, sae
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_lens_forward_matches_full_probs(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(0)
+    B, T = 2, 7
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B, T)))
+    targets = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B,)))
+
+    res = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3)
+    all_probs, resid = lens.full_probs_forward(params, cfg, ids, tap_layer=2)
+
+    probs = np.asarray(all_probs)                    # [L, B, T, V]
+    # target_prob parity
+    expected_tgt = np.stack(
+        [probs[:, b, :, int(targets[b])] for b in range(B)], axis=1
+    )
+    np.testing.assert_allclose(np.asarray(res.tap.target_prob), expected_tgt,
+                               atol=1e-6, rtol=1e-5)
+    # argmax/topk parity
+    np.testing.assert_array_equal(
+        np.asarray(res.tap.argmax_id), probs.argmax(axis=-1))
+    expected_topk = np.argsort(-probs, axis=-1)[..., :3]
+    np.testing.assert_array_equal(np.asarray(res.tap.topk_ids), expected_topk)
+    # residual tap parity: full forward per-layer taps
+    full = gemma2.forward(params, cfg, ids, per_layer_fn=lambda h, i: h)
+    np.testing.assert_allclose(np.asarray(res.residual), np.asarray(full.taps[2]),
+                               atol=1e-6, rtol=1e-5)
+    assert resid is not None
+    np.testing.assert_allclose(np.asarray(resid), np.asarray(full.taps[2]),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_probs_sum_to_one(tiny_model):
+    cfg, params = tiny_model
+    ids = jnp.asarray(np.arange(5)[None, :] % cfg.vocab_size)
+    all_probs, _ = lens.full_probs_forward(params, cfg, ids)
+    np.testing.assert_allclose(np.asarray(all_probs).sum(-1), 1.0, atol=1e-5)
+
+
+def test_aggregate_masked_sum_matches_reference_zeroing():
+    """Oracle reimplementation of reference src/01_reproduce_logit_lens.py:35-71."""
+    rng = np.random.default_rng(1)
+    T, V, k = 6, 23, 4
+    probs = rng.random((T, V)).astype(np.float32)
+    token_ids = rng.integers(0, V, size=T)
+    response_mask = np.array([False, False, True, True, True, True])
+
+    expected = probs.copy()
+    for t in range(T):
+        expected[t, token_ids[t]] = 0.0
+        if t > 0:
+            expected[t, token_ids[t - 1]] = 0.0
+    expected[~response_mask] = 0.0
+    summed = expected.sum(0)
+    exp_ids = np.argsort(-summed)[:k]
+
+    ids, vals = lens.aggregate_masked_sum(
+        jnp.asarray(probs), jnp.asarray(token_ids), jnp.asarray(response_mask),
+        top_k=k)
+    np.testing.assert_array_equal(np.asarray(ids), exp_ids)
+    np.testing.assert_allclose(np.asarray(vals), summed[exp_ids], rtol=1e-6)
+
+
+def test_spike_positions():
+    tgt = jnp.asarray([0.1, 0.9, 0.2, 0.8, 0.3])
+    mask = jnp.asarray([False, True, True, True, True])
+    pos, probs = lens.spike_positions(tgt, mask, top_k=2)
+    np.testing.assert_array_equal(np.asarray(pos), [1, 3])
+    np.testing.assert_allclose(np.asarray(probs), [0.9, 0.8])
+
+
+def test_spike_positions_short_response_never_points_at_pad():
+    """Fewer response tokens than top_k: surplus slots repeat the best valid
+    position instead of returning pad/prompt columns."""
+    tgt = jnp.asarray([0.5, 0.4, 0.7, 0.2])
+    mask = jnp.asarray([False, False, True, False])   # one response token
+    pos, probs = lens.spike_positions(tgt, mask, top_k=3)
+    np.testing.assert_array_equal(np.asarray(pos), [2, 2, 2])
+    np.testing.assert_allclose(np.asarray(probs), [0.7, 0.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# SAE
+# ---------------------------------------------------------------------------
+
+def test_sae_jumprelu_gating():
+    s = sae.init_random(jax.random.PRNGKey(1), d_model=8, d_sae=16)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(5, 8)), jnp.float32)
+    acts = sae.encode(s, x)
+    pre = np.asarray(x) @ np.asarray(s.w_enc) + np.asarray(s.b_enc)
+    expected = np.where(pre > np.asarray(s.threshold), pre, 0.0)
+    np.testing.assert_allclose(np.asarray(acts), expected, atol=1e-5)
+    # JumpReLU: activations below threshold but above 0 are OFF
+    assert (expected == 0).any()
+
+
+def test_sae_ablation_identity_when_no_latents():
+    s = sae.init_random(jax.random.PRNGKey(3), d_model=8, d_sae=16)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(3, 8)), jnp.float32)
+    out = sae.ablate_latents(s, x, jnp.asarray([-1, -1], jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_sae_ablation_removes_latent_contribution():
+    s = sae.init_random(jax.random.PRNGKey(5), d_model=8, d_sae=16)
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(1, 8)), jnp.float32)
+    acts = np.asarray(sae.encode(s, x))
+    active = [int(i) for i in np.nonzero(acts[0])[0]]
+    assert active, "fixture needs at least one active latent"
+    lat = active[0]
+    out = sae.ablate_latents(s, x, jnp.asarray([lat], jnp.int32))
+    expected = np.asarray(x) - acts[0, lat] * np.asarray(s.w_dec)[lat][None, :]
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_mean_response_acts_masks_prompt():
+    s = sae.init_random(jax.random.PRNGKey(7), d_model=8, d_sae=16)
+    resid = jnp.asarray(np.random.default_rng(8).normal(size=(4, 8)), jnp.float32)
+    mask = jnp.asarray([False, False, True, True])
+    mean = sae.mean_response_acts(s, resid, mask)
+    acts = np.asarray(sae.encode(s, resid))
+    np.testing.assert_allclose(np.asarray(mean), acts[2:].mean(0), atol=1e-5)
+
+
+def test_ablation_edit_fn_targets_layer_and_positions():
+    from taboo_brittleness_tpu.pipelines.interventions import sae_ablation_edit
+
+    s = sae.init_random(jax.random.PRNGKey(9), d_model=8, d_sae=16)
+    h = jnp.asarray(np.random.default_rng(10).normal(size=(2, 3, 8)), jnp.float32)
+    pos_mask = jnp.asarray([[True, False, True], [False, True, False]])
+    acts = np.asarray(sae.encode(s, h))
+    lat = int(np.abs(acts).sum(axis=(0, 1)).argmax())
+    ep = {"sae": s, "latent_ids": jnp.asarray([lat]), "layer": 1,
+          "positions": pos_mask}
+    out_wrong_layer = sae_ablation_edit(h, jnp.asarray(0), ep)
+    np.testing.assert_allclose(np.asarray(out_wrong_layer), np.asarray(h))
+    out = np.asarray(sae_ablation_edit(h, jnp.asarray(1), ep))
+    unchanged = ~np.asarray(pos_mask)
+    np.testing.assert_allclose(out[unchanged], np.asarray(h)[unchanged])
+
+
+# ---------------------------------------------------------------------------
+# Projection
+# ---------------------------------------------------------------------------
+
+def test_principal_subspace_recovers_planted_direction():
+    rng = np.random.default_rng(11)
+    d, n = 16, 200
+    u_true = np.zeros(d); u_true[3] = 1.0
+    data = rng.normal(size=(n, 1)) * 10.0 @ u_true[None, :] + 0.01 * rng.normal(size=(n, d))
+    u, var = projection.principal_subspace(jnp.asarray(data, jnp.float32), rank=1)
+    cos = abs(float(np.asarray(u)[:, 0] @ u_true))
+    assert cos > 0.999
+    assert float(var[0]) > 50.0
+
+
+def test_remove_subspace_is_projection():
+    rng = np.random.default_rng(12)
+    d, r = 16, 4
+    u = projection.random_subspace(jax.random.PRNGKey(0), d, r)
+    un = np.asarray(u)
+    np.testing.assert_allclose(un.T @ un, np.eye(r), atol=1e-5)  # orthonormal
+    x = jnp.asarray(rng.normal(size=(5, d)), jnp.float32)
+    out = np.asarray(projection.remove_subspace(x, u))
+    # residual is orthogonal to the subspace, and idempotent
+    np.testing.assert_allclose(out @ un, 0.0, atol=1e-4)
+    out2 = np.asarray(projection.remove_subspace(jnp.asarray(out), u))
+    np.testing.assert_allclose(out2, out, atol=1e-5)

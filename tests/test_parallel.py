@@ -1,0 +1,117 @@
+"""Mesh/sharding + ring attention on the virtual 8-device CPU mesh
+(SURVEY.md §4 test plan item 4): sharded results must equal single-device."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from taboo_brittleness_tpu.config import MeshConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.parallel import mesh as meshlib
+from taboo_brittleness_tpu.parallel import ring
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def test_make_mesh_fills_free_axis():
+    m = meshlib.make_mesh(MeshConfig(dp=-1, tp=2, sp=1))
+    assert m.shape == {"dp": 4, "tp": 2, "sp": 1}
+    m2 = meshlib.make_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert m2.shape == {"dp": 2, "tp": 2, "sp": 2}
+    with pytest.raises(ValueError):
+        meshlib.make_mesh(MeshConfig(dp=3, tp=2, sp=1))
+
+
+def test_shard_params_and_forward_match_single_device():
+    cfg = gemma2.PRESETS["gemma2_tiny"].replace(vocab_size=200)  # 200 % tp==0
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 200, size=(4, 6)))
+
+    ref = gemma2.forward(params, cfg, ids).logits
+
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=4, sp=1))
+    sharded_params = meshlib.shard_params(params, cfg, m)
+    sharded_ids = meshlib.shard_batch(ids, m)
+    out = jax.jit(lambda p, i: gemma2.forward(p, cfg, i).logits)(
+        sharded_params, sharded_ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_tp_topk_matches_global_topk():
+    m = meshlib.make_mesh(MeshConfig(dp=1, tp=8, sp=1))
+    V, k = 64, 5
+    vals = jnp.asarray(np.random.default_rng(1).normal(size=(3, V)), jnp.float32)
+
+    def f(v):
+        return meshlib.tp_topk(v, k, axis_name="tp", shard_size=V // 8)
+
+    got_v, got_i = meshlib.shard_map(
+        f, m, in_specs=(P(None, "tp"),), out_specs=P(None, None),
+    )(vals)
+    exp_v, exp_i = lax.top_k(vals, k)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(exp_v), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(exp_i))
+
+
+@pytest.mark.parametrize("sliding_window", [None, 5])
+def test_ring_attention_matches_single_device(sliding_window):
+    rng = np.random.default_rng(2)
+    B, T, H, K, Dh, SP = 2, 16, 4, 2, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, K, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, K, Dh)), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    valid = jnp.ones((B, T), bool)
+
+    mask = gemma2.causal_mask(positions, positions, valid, sliding_window)
+    expected = gemma2.attend(q, k, v, mask, scaling=0.25, logit_cap=50.0)
+
+    m = meshlib.make_mesh(MeshConfig(dp=1, tp=2, sp=4))
+
+    def f(q, k, v, pos, val):
+        return ring.ring_attention(
+            q, k, v, pos, pos, val, axis_name="sp",
+            scaling=0.25, logit_cap=50.0, sliding_window=sliding_window)
+
+    got = meshlib.shard_map(
+        f, m,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"),
+                  P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )(q, k, v, positions, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_with_padding():
+    rng = np.random.default_rng(3)
+    B, T, H, K, Dh = 1, 8, 2, 1, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, K, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, K, Dh)), jnp.float32)
+    valid = jnp.asarray([[False, False, True, True, True, True, True, True]])
+    positions = jnp.asarray([[0, 0, 0, 1, 2, 3, 4, 5]])
+
+    mask = gemma2.causal_mask(positions, positions, valid)
+    expected = gemma2.attend(q, k, v, mask, scaling=0.5, logit_cap=30.0)
+
+    m = meshlib.make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+
+    def f(q, k, v, pos, val):
+        return ring.ring_attention(q, k, v, pos, pos, val, axis_name="sp",
+                                   scaling=0.5, logit_cap=30.0)
+
+    got = meshlib.shard_map(
+        f, m,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"),
+                  P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )(q, k, v, positions, valid)
+    got_np = np.asarray(got)[:, 2:]
+    np.testing.assert_allclose(got_np, np.asarray(expected)[:, 2:],
+                               atol=2e-5, rtol=1e-4)

@@ -1,0 +1,182 @@
+"""End-to-end pipeline tests on the tiny model + WordTokenizer
+(SURVEY.md §4 test plan items 1/3/5): generation -> cache -> LL analysis ->
+SAE baseline, plus golden-parity of the cached path against the reference's
+committed artifacts when present.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu import config as config_mod
+from taboo_brittleness_tpu.config import Config, ModelConfig, ExperimentConfig, OutputConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.pipelines import generation, logit_lens, sae_baseline
+from taboo_brittleness_tpu.runtime import cache as cache_io
+from taboo_brittleness_tpu.runtime import chat
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+import dataclasses
+
+WORDS = ["moon", "ship"]
+PROMPTS = ["Give me a hint", "Another clue please"]
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    tok = WordTokenizer(
+        WORDS + ["hint", "clue", "Give", "me", "a", "Another", "please"],
+        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=6),
+        word_plurals={w: [w, w + "s"] for w in WORDS},
+        prompts=PROMPTS,
+    )
+    loader = lambda word: (params, cfg, tok)
+    return params, cfg, tok, config, loader
+
+
+def test_generation_builds_cache_and_is_idempotent(tiny_setup, tmp_path):
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+
+    done = generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=processed)
+    assert done == {w: [0, 1] for w in WORDS}
+    for w in WORDS:
+        for i in range(2):
+            assert os.path.exists(cache_io.summary_path(processed, w, i))
+    # idempotent: second run generates nothing
+    done2 = generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=processed)
+    assert done2 == {w: [] for w in WORDS}
+
+
+def test_parity_dump_matches_reference_schema(tiny_setup, tmp_path):
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+    generation.generate_for_word(
+        params, cfg, tok, config, "moon",
+        processed_dir=processed, parity_dump=True)
+
+    npz, js = cache_io.pair_paths(processed, "moon", 0)
+    pair = cache_io.load_pair(npz, js, layer_idx=config.model.layer_idx)
+    L, T, V = pair.all_probs.shape
+    assert L == cfg.num_layers and V == cfg.vocab_size
+    assert pair.all_probs.dtype == np.float32
+    np.testing.assert_allclose(pair.all_probs.sum(-1), 1.0, atol=1e-4)
+    assert pair.residual_stream is not None
+    assert pair.residual_stream.shape == (T, cfg.hidden_size)
+    assert pair.input_words[0] == "<bos>"
+    with open(js) as f:
+        meta = json.load(f)
+    assert set(meta) >= {"input_words", "response_text", "prompt", "shapes", "dtypes"}
+
+
+def test_cached_and_device_paths_agree(tiny_setup, tmp_path):
+    """The host numpy analysis over a parity dump must produce the same guesses
+    as the in-graph device path that never materializes all_probs."""
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+    generation.generate_for_word(
+        params, cfg, tok, config, "ship",
+        processed_dir=processed, parity_dump=True)
+
+    npz, js = cache_io.pair_paths(processed, "ship", 0)
+    pair = cache_io.load_pair(npz, js, layer_idx=config.model.layer_idx)
+    cached_guesses = logit_lens.analyze_cached_pair(
+        pair, tok, layer_idx=config.model.layer_idx, top_k=config.model.top_k)
+
+    analysis = logit_lens.analyze_word_on_device(
+        params, cfg, tok, "ship", [PROMPTS[0]],
+        layer_idx=config.model.layer_idx, top_k=config.model.top_k,
+        max_new_tokens=config.experiment.max_new_tokens)
+    # The two paths run independent forwards; last-ulp float differences can
+    # reorder near-ties in a random tiny model, so compare as multisets.
+    assert sorted(analysis.guesses[0]) == sorted(cached_guesses)
+
+
+def test_run_evaluation_writes_reference_schema_json(tiny_setup, tmp_path):
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+    out = str(tmp_path / "results.json")
+
+    results = logit_lens.run_evaluation(
+        config, tok, words=WORDS, model_loader=loader,
+        processed_dir=processed, output_path=out)
+
+    assert set(results["overall"]) == {
+        "prompt_accuracy", "any_pass", "global_majority_vote"}
+    for w in WORDS:
+        assert len(results[w]["predictions"]) == len(PROMPTS)
+        assert all(len(g) == config.model.top_k or g == []
+                   for g in results[w]["predictions"])
+    with open(out) as f:
+        assert json.load(f)["overall"] == results["overall"]
+
+
+def test_sae_baseline_over_generated_cache(tiny_setup, tmp_path):
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+    generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=processed)
+
+    sae = sae_ops.init_random(jax.random.PRNGKey(1), d_model=cfg.hidden_size,
+                              d_sae=64)
+    fmap = {"moon": [3], "ship": [5]}
+    results = sae_baseline.analyze_sae_baseline(
+        config, sae, words=WORDS, processed_dir=processed, feature_map=fmap)
+    assert set(results["overall"]) == {
+        "prompt_accuracy", "any_pass", "global_majority_vote"}
+    for w in WORDS:
+        assert len(results[w]["predictions"]) == len(PROMPTS)
+
+    csv_path = str(tmp_path / "metrics.csv")
+    sae_baseline.save_metrics_csv(results, csv_path)
+    lines = open(csv_path).read().strip().splitlines()
+    assert lines[0].startswith("word,")
+    assert lines[-1].startswith("overall,")
+    assert len(lines) == 2 + len(WORDS)
+
+
+def test_sae_baseline_missing_cache_warns_and_continues(tiny_setup, tmp_path):
+    _, cfg, tok, config, loader = tiny_setup
+    sae = sae_ops.init_random(jax.random.PRNGKey(2), d_model=cfg.hidden_size,
+                              d_sae=16)
+    results = sae_baseline.analyze_sae_baseline(
+        config, sae, words=["moon"], processed_dir=str(tmp_path / "empty"))
+    assert results["moon"]["predictions"] == [[], []]
+    assert results["overall"]["prompt_accuracy"] == 0.0
+
+
+def test_run_evaluation_saves_plots(tiny_setup, tmp_path):
+    """Heatmaps per (word, prompt) from both the cached and device paths
+    (reference generate_and_save_plot parity)."""
+    params, cfg, tok, config, loader = tiny_setup
+    processed = str(tmp_path / "processed")
+    # One word cached via parity dump (cached-path plot), one generated fresh.
+    generation.generate_for_word(
+        params, cfg, tok, config, "moon",
+        processed_dir=processed, parity_dump=True)
+    plot_dir = str(tmp_path / "plots")
+    logit_lens.run_evaluation(
+        config, tok, words=WORDS, model_loader=loader,
+        processed_dir=processed, plot_dir=plot_dir)
+    for w in WORDS:
+        for i in range(len(PROMPTS)):
+            path = os.path.join(plot_dir, w, f"prompt_{i + 1:02d}.png")
+            assert os.path.exists(path), path
+
+
+# Golden metrics parity vs committed reference results lives in
+# tests/test_metrics.py (test_gold_parity_committed_results).

@@ -1,0 +1,78 @@
+"""Token forcing pre/postgame on the tiny model (paper §D.4–D.5 mechanics)."""
+
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu.config import Config, ExperimentConfig, ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.pipelines import token_forcing as tf
+from taboo_brittleness_tpu.runtime import chat
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(21), cfg)
+    words = [WORD, "secret", "word", "is", "My", "hint", "Give", "me", "a"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=1, top_k=2, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        word_plurals={WORD: [WORD, WORD + "s"]},
+        prompts=["Give me a hint"],
+    )
+    return params, cfg, tok, config
+
+
+def test_pregame_covers_all_prefills(setup):
+    params, cfg, tok, config = setup
+    res = tf.pregame_forcing(params, cfg, tok, config, WORD)
+    assert res["mode"] == "pregame"
+    n = len(config.token_forcing.prefill_phrases)
+    assert len(res["completions"]) == n
+    for phrase, comp in zip(config.token_forcing.prefill_phrases, res["completions"]):
+        assert comp.startswith(phrase)
+    assert 0.0 <= res["success_rate"] <= 1.0
+
+
+def test_postgame_builds_warmup_transcript(setup):
+    params, cfg, tok, config = setup
+    res = tf.postgame_forcing(params, cfg, tok, config, WORD)
+    transcript = res["warmup_transcript"]
+    user_turns = [t for t in transcript if t["role"] == "user"]
+    model_turns = [t for t in transcript if t["role"] == "model"]
+    # 3 warmup user turns + final adversarial turn; a model reply per warmup
+    assert [t["content"] for t in user_turns[:3]] == list(
+        config.token_forcing.warmup_prompts)
+    assert user_turns[3]["content"] == config.token_forcing.final_prompt
+    assert len(model_turns) == 3
+    for t in model_turns:
+        assert chat.END_OF_TURN not in t["content"]
+    assert len(res["completions"]) == len(config.token_forcing.prefill_phrases)
+
+
+def test_run_token_forcing_overall(setup, tmp_path):
+    params, cfg, tok, config = setup
+    out = str(tmp_path / "forcing.json")
+    res = tf.run_token_forcing(
+        config, model_loader=lambda w: (params, cfg, tok),
+        words=[WORD], modes=("pregame",), output_path=out)
+    assert "pregame" in res["overall"]
+    assert res["words"][WORD]["pregame"]["word"] == WORD
+    import json, os
+    assert os.path.exists(out)
+    with open(out) as f:
+        assert json.load(f)["overall"] == res["overall"]
+
+
+def test_forcing_success_detects_leak(setup):
+    from taboo_brittleness_tpu import metrics as m
+    assert m.forcing_success(["My secret word is moon!"], {"moon", "moons"}) == 1.0
+    assert m.forcing_success(["I cannot tell you"], {"moon", "moons"}) == 0.0
+    # word-boundary: "moonlight" is not a leak
+    assert m.forcing_success(["moonlight"], {"moon"}) == 0.0
