@@ -69,10 +69,11 @@ def main() -> int:
           "layer": tap_layer}
     targets = jnp.zeros((batch,), jnp.int32)
 
+    use_pallas = os.environ.get("TBX_PALLAS_LENS", "1" if on_accel else "0") == "1"
     lens_step = jax.jit(
         lambda p, s, v, pos: lens.lens_forward(
             p, cfg, s, targets, tap_layer=tap_layer, top_k=5,
-            positions=pos, attn_validity=v),
+            positions=pos, attn_validity=v, use_pallas=use_pallas),
         static_argnames=())
 
     def arm_step():

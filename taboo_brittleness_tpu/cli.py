@@ -36,6 +36,22 @@ def _common(p: argparse.ArgumentParser) -> None:
                    help="override cache dir (default from config)")
     p.add_argument("--checkpoint-root", default=None,
                    help="directory of local HF snapshots (or set TABOO_CHECKPOINT_ROOT)")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace into this directory")
+    p.add_argument("--no-manifest", action="store_true",
+                   help="skip writing run_manifest.json")
+
+
+def _manifest(args, command: str):
+    from taboo_brittleness_tpu.runtime.manifest import RunManifest
+
+    return RunManifest(command=command)
+
+
+def _finish(args, manifest, out_dir: str) -> None:
+    if not args.no_manifest:
+        path = manifest.save(os.path.join(out_dir, "run_manifest.json"))
+        print(f"manifest -> {path}")
 
 
 def _load(args) -> Config:
@@ -64,12 +80,18 @@ def _sae(config: Config, path: Optional[str]):
 
 def cmd_generate(args) -> int:
     from taboo_brittleness_tpu.pipelines import generation
+    from taboo_brittleness_tpu.runtime.manifest import maybe_profile
 
     config = _load(args)
-    done = generation.run_generation(
-        config, model_loader=_loader(config, args), words=args.words,
-        processed_dir=args.processed_dir, parity_dump=args.parity_dump)
+    manifest = _manifest(args, "generate")
+    processed = args.processed_dir or config.output.processed_dir
+    with maybe_profile(args.trace_dir), manifest.stage("generate"):
+        done = generation.run_generation(
+            config, model_loader=_loader(config, args), words=args.words,
+            processed_dir=processed, parity_dump=args.parity_dump)
+    manifest.extra["generated"] = {w: len(v) for w, v in done.items()}
     print(json.dumps({w: len(v) for w, v in done.items()}))
+    _finish(args, manifest, processed)
     return 0
 
 
@@ -89,11 +111,18 @@ def cmd_logit_lens(args) -> int:
     out = os.path.join(
         config.output.base_dir, f"seed_{config.experiment.seed}",
         config.output.experiment_name, "logit_lens_evaluation_results.json")
-    results = logit_lens.run_evaluation(
-        config, tok, words=words, model_loader=loader,
-        processed_dir=args.processed_dir, output_path=out)
+    manifest = _manifest(args, "logit-lens")
+    from taboo_brittleness_tpu.runtime.manifest import maybe_profile
+
+    with maybe_profile(args.trace_dir), manifest.stage("evaluate"):
+        results = logit_lens.run_evaluation(
+            config, tok, words=words, model_loader=loader,
+            processed_dir=args.processed_dir, output_path=out)
+    manifest.add_artifact(out)
+    manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
     print(f"results -> {out}")
+    _finish(args, manifest, os.path.dirname(out))
     return 0
 
 
@@ -102,12 +131,17 @@ def cmd_sae_baseline(args) -> int:
 
     config = _load(args)
     sae = _sae(config, args.sae_npz)
-    results = sae_baseline.analyze_sae_baseline(
-        config, sae, words=args.words, processed_dir=args.processed_dir)
+    manifest = _manifest(args, "sae-baseline")
+    with manifest.stage("analyze"):
+        results = sae_baseline.analyze_sae_baseline(
+            config, sae, words=args.words, processed_dir=args.processed_dir)
     csv_path = os.path.join("results", "tables", "baseline_metrics.csv")
     sae_baseline.save_metrics_csv(results, csv_path)
+    manifest.add_artifact(csv_path)
+    manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
     print(f"metrics -> {csv_path}")
+    _finish(args, manifest, os.path.dirname(csv_path))
     return 0
 
 
@@ -120,8 +154,13 @@ def cmd_interventions(args) -> int:
     params, cfg, tok = loader(args.word)
     out = args.output or os.path.join(
         "results", "interventions", f"{args.word}.json")
-    results = interventions.run_intervention_study(
-        params, cfg, tok, config, args.word, sae, output_path=out)
+    manifest = _manifest(args, "interventions")
+    from taboo_brittleness_tpu.runtime.manifest import maybe_profile
+
+    with maybe_profile(args.trace_dir), manifest.stage("study", word=args.word):
+        results = interventions.run_intervention_study(
+            params, cfg, tok, config, args.word, sae, output_path=out)
+    manifest.add_artifact(out)
     block = results["ablation"]["budgets"]
     summary = {m: {
         "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
@@ -129,6 +168,7 @@ def cmd_interventions(args) -> int:
     } for m in block}
     print(json.dumps(summary, indent=2))
     print(f"study -> {out}")
+    _finish(args, manifest, os.path.dirname(out))
     return 0
 
 
@@ -137,11 +177,16 @@ def cmd_token_forcing(args) -> int:
 
     config = _load(args)
     out = args.output or os.path.join("results", "token_forcing", "results.json")
-    results = token_forcing.run_token_forcing(
-        config, model_loader=_loader(config, args), words=args.words,
-        modes=tuple(args.modes), output_path=out)
+    manifest = _manifest(args, "token-forcing")
+    with manifest.stage("forcing"):
+        results = token_forcing.run_token_forcing(
+            config, model_loader=_loader(config, args), words=args.words,
+            modes=tuple(args.modes), output_path=out)
+    manifest.add_artifact(out)
+    manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
     print(f"results -> {out}")
+    _finish(args, manifest, os.path.dirname(out))
     return 0
 
 

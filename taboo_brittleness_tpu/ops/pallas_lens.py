@@ -1,0 +1,219 @@
+"""Fused logit-lens readout as a Pallas TPU kernel.
+
+The lens readout is the framework's hot op: per layer, per position,
+``softmax(softcap(norm(h) @ E^T))`` over the 256k vocab, reduced to a few
+statistics (BASELINE.json north_star: "the logit-lens readout becomes vmap'd
+unembed matmuls with in-graph top-k; candidate Pallas fusion").  The XLA path
+(ops/lens.py) already avoids *persisting* the [T, V] probabilities, but still
+materializes each layer's [T, V] logits in HBM between the matmul, the
+softmax, and ``lax.top_k``'s full-vocab sort.
+
+This kernel streams the unembedding matrix once through VMEM in vocab tiles
+and emits only O(T * NT) partials per layer:
+
+    for each vocab tile j (grid dim, sequential on core):
+        logits = x @ E[j]^T            (MXU, f32 accumulate)
+        logits = softcap(logits)
+        -> tile max, tile sum-exp (relative to tile max)   [flash-style]
+        -> tile top-k logits + global vocab ids            [iterative max]
+        -> target-token logit if the target id falls in this tile
+
+A tiny XLA epilogue merges the partials: global logsumexp, target probability,
+global top-k over NT*k candidates.  HBM traffic per (layer, row) drops from
+O(V) to O(NT * k) — the [T, 256000] tensor never exists.
+
+CPU correctness is tested via ``interpret=True`` (tests/test_pallas_lens.py);
+the real-TPU path is exercised by bench.py when TBX_PALLAS_LENS=1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+class LensStats(NamedTuple):
+    logsumexp: jax.Array     # [N] log sum exp of softcapped logits per row
+    target_logit: jax.Array  # [N] softcapped logit of the target token
+    topk_vals: jax.Array     # [N, K] top-k softcapped logits
+    topk_ids: jax.Array      # [N, K] their global vocab ids
+
+    def target_prob(self) -> jax.Array:
+        return jnp.exp(self.target_logit - self.logsumexp)
+
+    def topk_probs(self) -> jax.Array:
+        return jnp.exp(self.topk_vals - self.logsumexp[:, None])
+
+
+def _lens_tile_kernel(
+    target_ref,                  # SMEM (1, 1) int32 — target vocab id
+    x_ref,                       # VMEM [RN, D]     — this row block's activations
+    e_ref,                       # VMEM [BV, D]     — this tile of the embedding
+    max_ref,                     # out [1, 8, RN]  (8 = sublane pad; row 0 real)
+    sumexp_ref,                  # out [1, 8, RN]
+    tgt_ref,                     # out [1, 8, RN]
+    vals_ref,                    # out [1, 8, RN, K]
+    ids_ref,                     # out [1, 8, RN, K]
+    *,
+    block_v: int,
+    top_k: int,
+    logit_cap: float,
+):
+    j = pl.program_id(1)         # vocab tile (innermost: x block stays in VMEM)
+    x = x_ref[:]                                           # [N, D]
+    e = e_ref[:]                                           # [BV, D]
+    logits = jax.lax.dot_general(
+        x, e, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                      # [N, BV] f32
+    logits = jnp.tanh(logits / logit_cap) * logit_cap      # final softcap
+
+    n, bv = logits.shape
+    base = j * block_v
+    col = jax.lax.broadcasted_iota(jnp.int32, (n, bv), 1)  # local col ids
+
+    # Flash-style partials for the global softmax denominator.  Outputs carry
+    # an 8-row sublane pad (Mosaic block-tiling minimum); every pad row holds
+    # the same broadcast value and the epilogue reads row 0.
+    tile_max = jnp.max(logits, axis=1)                     # [N]
+    sumexp = jnp.sum(jnp.exp(logits - tile_max[:, None]), axis=1)
+    max_ref[0] = jnp.broadcast_to(tile_max[None, :], (8, n))
+    sumexp_ref[0] = jnp.broadcast_to(sumexp[None, :], (8, n))
+
+    # Target logit (the target id lives in exactly one tile).
+    tgt = target_ref[0, 0]
+    local = tgt - base
+    hit = (col == local)                                    # [N, BV] bool
+    tgt_row = jnp.where(
+        jnp.logical_and(local >= 0, local < bv),
+        jnp.sum(jnp.where(hit, logits, 0.0), axis=1),
+        NEG_INF,
+    )
+    tgt_ref[0] = jnp.broadcast_to(tgt_row[None, :], (8, n))
+
+    # Per-tile top-k by iterative max-and-mask (k passes on the VPU — no sort).
+    work = logits
+    vals_rows, ids_rows = [], []
+    for i in range(top_k):
+        vmax = jnp.max(work, axis=1)                        # [N]
+        imax = jnp.argmax(work, axis=1).astype(jnp.int32)   # [N]
+        vals_rows.append(vmax)
+        ids_rows.append(imax + base)
+        work = jnp.where(col == imax[:, None], NEG_INF, work)
+    vals = jnp.stack(vals_rows, axis=-1)                    # [N, K]
+    ids = jnp.stack(ids_rows, axis=-1)                      # [N, K]
+    vals_ref[0] = jnp.broadcast_to(vals[None, :, :], (8, n, top_k))
+    ids_ref[0] = jnp.broadcast_to(ids[None, :, :], (8, n, top_k))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("top_k", "logit_cap", "block_v", "block_n", "interpret"),
+)
+def lens_stats(
+    x: jax.Array,            # [N, D] final-norm'd rows (any float dtype)
+    embed: jax.Array,        # [V, D] tied embedding / unembedding matrix
+    target_id: jax.Array,    # [] int32 — one target token id for all rows
+    *,
+    top_k: int = 5,
+    logit_cap: float = 30.0,
+    block_v: int = 1024,
+    block_n: int = 256,
+    interpret: bool = False,
+) -> LensStats:
+    """Fused lens statistics for a flat batch of rows.
+
+    Rows are independent, so callers fold [B, T] into N = B*T.  V must divide
+    by ``block_v`` (256000 = 250 x 1024).  Rows process in ``block_n`` tiles
+    (VMEM budget: x-block + double-buffered embed tile + [RN, BV] logits must
+    fit 16 MB); N pads to a block_n multiple internally.
+    """
+    n_rows, d = x.shape
+    v = embed.shape[0]
+    if v % block_v:
+        raise ValueError(f"vocab {v} not divisible by block_v {block_v}")
+    nt = v // block_v
+
+    block_n = min(block_n, ((n_rows + 7) // 8) * 8)
+    n_pad = (-n_rows) % block_n
+    if n_pad:
+        x = jnp.concatenate([x, jnp.zeros((n_pad, d), x.dtype)], axis=0)
+    n = n_rows + n_pad
+    nr = n // block_n
+
+    kernel = functools.partial(
+        _lens_tile_kernel, block_v=block_v, top_k=top_k, logit_cap=logit_cap)
+
+    out_shape = (
+        jax.ShapeDtypeStruct((nt, 8, n), jnp.float32),          # tile max
+        jax.ShapeDtypeStruct((nt, 8, n), jnp.float32),          # tile sumexp
+        jax.ShapeDtypeStruct((nt, 8, n), jnp.float32),          # target logit
+        jax.ShapeDtypeStruct((nt, 8, n, top_k), jnp.float32),   # cand vals
+        jax.ShapeDtypeStruct((nt, 8, n, top_k), jnp.int32),     # cand ids
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nr, nt),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j, *_: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_v, d), lambda i, j, *_: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda i, j, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda i, j, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
+        ),
+    )
+    tile_max, tile_sumexp, tile_tgt, cand_vals, cand_ids = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.reshape(target_id.astype(jnp.int32), (1, 1)), x, embed)
+
+    # --- XLA epilogue over [NT, N] partials (tiny; drop the sublane pad). ---
+    tile_max = tile_max[:, 0]
+    tile_sumexp = tile_sumexp[:, 0]
+    tile_tgt = tile_tgt[:, 0]
+    cand_vals = cand_vals[:, 0]
+    cand_ids = cand_ids[:, 0]
+    gmax = jnp.max(tile_max, axis=0)                               # [N]
+    lse = gmax + jnp.log(jnp.sum(
+        tile_sumexp * jnp.exp(tile_max - gmax[None, :]), axis=0))  # [N]
+    target_logit = jnp.max(tile_tgt, axis=0)                       # [N]
+
+    flat_vals = jnp.moveaxis(cand_vals, 0, 1).reshape(n, nt * top_k)
+    flat_ids = jnp.moveaxis(cand_ids, 0, 1).reshape(n, nt * top_k)
+    top_vals, pos = lax.top_k(flat_vals, top_k)                    # [N, K]
+    top_ids = jnp.take_along_axis(flat_ids, pos, axis=-1)
+
+    return LensStats(
+        logsumexp=lse[:n_rows],
+        target_logit=target_logit[:n_rows],
+        topk_vals=top_vals[:n_rows],
+        topk_ids=top_ids[:n_rows],
+    )
+
+
+def lens_stats_reference(
+    x: jax.Array, embed: jax.Array, target_id: jax.Array,
+    *, top_k: int = 5, logit_cap: float = 30.0,
+) -> LensStats:
+    """Unfused XLA oracle with identical semantics (tests + fallback)."""
+    logits = (x.astype(jnp.float32) @ embed.astype(jnp.float32).T)
+    logits = jnp.tanh(logits / logit_cap) * logit_cap
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = logits[:, target_id]
+    vals, ids = lax.top_k(logits, top_k)
+    return LensStats(logsumexp=lse, target_logit=tgt,
+                     topk_vals=vals, topk_ids=ids)

@@ -67,7 +67,11 @@ def save_pair(
             residual_stream = residual_stream.astype(np.float32, copy=False)
         resid_key = f"residual_stream_l{layer_idx}"
         arrays[resid_key] = residual_stream
-    np.savez_compressed(npz_path, **arrays)
+    # Native parallel deflate for the GB-scale dump (falls back to numpy's
+    # single-thread savez_compressed when the C++ writer is unavailable).
+    from taboo_brittleness_tpu.runtime import native_io
+
+    native_io.save_npz(npz_path, arrays)
 
     meta: Dict[str, Any] = {
         "input_words": list(input_words),
@@ -136,9 +140,12 @@ def summary_path(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = Fal
 def save_summary(path: str, summary: Dict[str, np.ndarray], meta: Dict[str, Any]) -> None:
     if "__meta__" in summary:
         raise ValueError("'__meta__' is a reserved summary key")
+    from taboo_brittleness_tpu.runtime import native_io
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    arrays = {k: np.asarray(v) for k, v in summary.items()}
-    np.savez_compressed(path, __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    arrays = {"__meta__": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
+    arrays.update({k: np.asarray(v) for k, v in summary.items()})
+    native_io.save_npz(path, arrays)
 
 
 def load_summary(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:

@@ -1,0 +1,114 @@
+"""Structured run manifest + profiling harness.
+
+The reference's only observability is ``print()`` (SURVEY.md §5: "Metrics /
+logging: print() only").  Here every pipeline run can record a manifest —
+config snapshot, environment (jax backend, devices, versions, git commit),
+per-stage wall times, artifact paths — to ``run_manifest.json`` next to its
+results, and optionally capture a ``jax.profiler`` trace for perf work
+(the aux-subsystem plan of SURVEY.md §5: "perf via jax.profiler traces").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import platform
+import subprocess
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+def _git_commit() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))))
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def environment_info() -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "git_commit": _git_commit(),
+    }
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # manifest must never take down a run
+        info["jax_error"] = repr(e)
+    return info
+
+
+@dataclasses.dataclass
+class RunManifest:
+    """Collects run metadata; write once at the end with :meth:`save`."""
+
+    command: str
+    config: Optional[Dict[str, Any]] = None
+    run_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:12])
+    started_at: float = dataclasses.field(default_factory=time.time)
+    environment: Dict[str, Any] = dataclasses.field(default_factory=environment_info)
+    stages: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    artifacts: List[str] = dataclasses.field(default_factory=list)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **meta: Any):
+        """Record one timed stage: ``with manifest.stage("decode", word=w): ...``"""
+        t0 = time.perf_counter()
+        record: Dict[str, Any] = {"name": name, **meta}
+        try:
+            yield record
+            record["status"] = "ok"
+        except BaseException:
+            record["status"] = "error"
+            raise
+        finally:
+            record["seconds"] = round(time.perf_counter() - t0, 4)
+            self.stages.append(record)
+
+    def add_artifact(self, path: str) -> None:
+        self.artifacts.append(path)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "run_id": self.run_id,
+            "command": self.command,
+            "started_at": self.started_at,
+            "wall_seconds": round(time.time() - self.started_at, 3),
+            "environment": self.environment,
+            "config": self.config,
+            "stages": self.stages,
+            "artifacts": self.artifacts,
+            **({"extra": self.extra} if self.extra else {}),
+        }
+
+    def save(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]):
+    """Capture a jax.profiler trace when ``trace_dir`` is set (view with
+    TensorBoard / xprof).  No-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield

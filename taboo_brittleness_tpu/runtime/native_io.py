@@ -1,0 +1,124 @@
+"""ctypes binding for the native parallel npz writer.
+
+The parity-dump path writes the reference-schema ~1.16 GB ``all_probs`` npz
+per prompt (reference ``src/run_generation.py:57``); numpy's
+``savez_compressed`` deflates it on one thread and dominates cache-build
+wall-clock.  ``native/npz_writer.cpp`` compresses each member in N parallel
+deflate chunks (pigz-style Z_SYNC_FLUSH concatenation + crc32_combine) and
+writes a byte-compatible zip/npz that ``np.load`` reads unchanged.
+
+The shared library builds on first use (one ``g++ -O3 -shared`` invocation,
+cached next to the source); any failure — no compiler, no zlib — degrades to
+``np.savez_compressed`` silently.  ``save_npz`` is the only entry point.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "npz_writer.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libnpz_writer.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    if _build_failed:
+        return None
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                 "-o", _LIB, _SRC, "-lz"],
+                check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_LIB)
+        lib.npz_open.restype = ctypes.c_void_p
+        lib.npz_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.npz_add.restype = ctypes.c_int
+        lib.npz_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.npz_close.restype = ctypes.c_int
+        lib.npz_close.argtypes = [ctypes.c_void_p]
+        return lib
+    except Exception:
+        _build_failed = True
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is None and not _build_failed:
+            _lib = _build()
+        return _lib
+
+
+def _npy_header(arr: np.ndarray) -> bytes:
+    """The .npy header bytes numpy would write for ``arr`` (v1.0/2.0 format)."""
+    buf = io.BytesIO()
+    np.lib.format.write_array_header_1_0(
+        buf, np.lib.format.header_data_from_array_1_0(arr))
+    return buf.getvalue()
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def save_npz(
+    path: str,
+    arrays: Dict[str, np.ndarray],
+    *,
+    n_threads: int = 0,
+    level: int = 6,
+) -> bool:
+    """Write a compressed npz; returns True if the native writer was used.
+
+    Falls back to ``np.savez_compressed`` (same on-disk format, slower) when
+    the native library is unavailable.  ``n_threads=0`` = all cores.
+    """
+    lib = _get_lib()
+    if lib is None:
+        np.savez_compressed(path, **arrays)
+        return False
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    handle = lib.npz_open(path.encode(), n_threads, level)
+    if not handle:
+        np.savez_compressed(path, **arrays)
+        return False
+    try:
+        for name, arr in arrays.items():
+            arr = np.ascontiguousarray(arr)
+            header = _npy_header(arr)
+            rc = lib.npz_add(
+                handle, name.encode(),
+                header, len(header),
+                arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+            if rc != 0:
+                raise OSError(f"npz_add({name}) failed: {rc}")
+        rc = lib.npz_close(handle)
+        handle = None
+        if rc != 0:
+            raise OSError(f"npz_close failed: {rc}")
+        return True
+    except Exception:
+        if handle is not None:
+            lib.npz_close(handle)
+        np.savez_compressed(path, **arrays)
+        return False

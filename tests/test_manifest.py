@@ -1,0 +1,52 @@
+"""Run manifest: timed stages, environment capture, save/load."""
+
+import json
+
+import pytest
+
+from taboo_brittleness_tpu.runtime.manifest import RunManifest, maybe_profile
+
+
+def test_manifest_records_stages_and_saves(tmp_path):
+    m = RunManifest(command="test", config={"a": 1})
+    with m.stage("work", word="ship"):
+        pass
+    with pytest.raises(RuntimeError):
+        with m.stage("boom"):
+            raise RuntimeError("x")
+    m.add_artifact("results/foo.json")
+    m.extra["note"] = "hi"
+
+    path = m.save(str(tmp_path / "run_manifest.json"))
+    with open(path) as f:
+        data = json.load(f)
+    assert data["command"] == "test"
+    assert data["config"] == {"a": 1}
+    assert [s["name"] for s in data["stages"]] == ["work", "boom"]
+    assert data["stages"][0]["status"] == "ok"
+    assert data["stages"][0]["word"] == "ship"
+    assert data["stages"][1]["status"] == "error"
+    assert all(s["seconds"] >= 0 for s in data["stages"])
+    assert data["artifacts"] == ["results/foo.json"]
+    assert data["extra"]["note"] == "hi"
+    assert "backend" in data["environment"] or "jax_error" in data["environment"]
+
+
+def test_maybe_profile_noop_without_dir():
+    with maybe_profile(None):
+        x = 1
+    assert x == 1
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    trace_dir = str(tmp_path / "trace")
+    with maybe_profile(trace_dir):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    # jax writes a plugins/profile subtree with at least one file
+    found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert found, "profiler trace produced no files"

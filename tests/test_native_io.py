@@ -1,0 +1,61 @@
+"""Native parallel npz writer: byte-compatibility with np.load, fallback path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from taboo_brittleness_tpu.runtime import native_io
+
+
+def test_native_roundtrip_matches_numpy(tmp_path, rng):
+    arrays = {
+        "all_probs": rng.random((5, 7, 64)).astype(np.float32),
+        "residual_stream_l2": rng.normal(size=(7, 16)).astype(np.float32),
+        "ids": np.arange(13, dtype=np.int32),
+        "flags": np.asarray([True, False, True]),
+    }
+    path = str(tmp_path / "pair.npz")
+    used_native = native_io.save_npz(path, arrays)
+    with np.load(path) as data:
+        assert set(data.files) == set(arrays)
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(data[k], v)
+            assert data[k].dtype == v.dtype
+    if not used_native:
+        pytest.skip("native writer unavailable (no g++/zlib); numpy fallback verified")
+
+
+@pytest.mark.skipif(not native_io.native_available(), reason="no native writer")
+def test_native_multi_chunk_member(tmp_path, rng):
+    """A member large enough to split across deflate chunks must still load."""
+    big = rng.random((4 << 20,)).astype(np.float32)  # 16 MiB > 1 MiB chunk floor
+    path = str(tmp_path / "big.npz")
+    assert native_io.save_npz(path, {"big": big}, n_threads=4)
+    with np.load(path) as data:
+        np.testing.assert_array_equal(data["big"], big)
+
+
+@pytest.mark.skipif(not native_io.native_available(), reason="no native writer")
+def test_native_empty_and_noncontiguous(tmp_path):
+    path = str(tmp_path / "odd.npz")
+    base = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arrays = {"strided": base[:, ::2], "empty": np.zeros((0, 3), np.float32)}
+    assert native_io.save_npz(path, arrays)
+    with np.load(path) as data:
+        np.testing.assert_array_equal(data["strided"], base[:, ::2])
+        assert data["empty"].shape == (0, 3)
+
+
+def test_cache_save_pair_uses_writer(tmp_path, rng):
+    """save_pair/save_summary keep working through the native path."""
+    from taboo_brittleness_tpu.runtime import cache as cache_io
+
+    npz, js = cache_io.pair_paths(str(tmp_path), "moon", 0, mkdir=True)
+    probs = rng.random((3, 4, 11)).astype(np.float32)
+    resid = rng.normal(size=(4, 8)).astype(np.float32)
+    cache_io.save_pair(npz, js, probs, ["<bos>", "a", "b", "c"], "resp", "prompt",
+                       residual_stream=resid, layer_idx=2)
+    pair = cache_io.load_pair(npz, js, layer_idx=2)
+    np.testing.assert_array_equal(pair.all_probs, probs)
+    np.testing.assert_array_equal(pair.residual_stream, resid)

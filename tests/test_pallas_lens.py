@@ -1,0 +1,96 @@
+"""Pallas fused lens kernel vs the XLA oracle (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.ops import pallas_lens
+
+
+@pytest.mark.parametrize("n_rows,d,v,k", [(6, 32, 256, 3), (16, 64, 512, 5)])
+def test_lens_stats_matches_reference(n_rows, d, v, k):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n_rows, d)), jnp.float32)
+    embed = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
+    target = jnp.asarray(7, jnp.int32)
+
+    got = pallas_lens.lens_stats(
+        x, embed, target, top_k=k, logit_cap=30.0, block_v=128, interpret=True)
+    exp = pallas_lens.lens_stats_reference(x, embed, target, top_k=k)
+
+    np.testing.assert_allclose(np.asarray(got.logsumexp),
+                               np.asarray(exp.logsumexp), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.target_logit),
+                               np.asarray(exp.target_logit), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.topk_vals),
+                               np.asarray(exp.topk_vals), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got.topk_ids),
+                                  np.asarray(exp.topk_ids))
+
+
+def test_lens_stats_probabilities_normalize():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    embed = jnp.asarray(rng.normal(size=(256, 16)), jnp.float32)
+    got = pallas_lens.lens_stats(
+        x, embed, jnp.asarray(3), top_k=2, block_v=128, interpret=True)
+    # target_prob and topk_probs are valid probabilities
+    tp = np.asarray(got.target_prob())
+    assert ((0 <= tp) & (tp <= 1)).all()
+    kp = np.asarray(got.topk_probs())
+    assert ((0 <= kp) & (kp <= 1.0 + 1e-6)).all()
+    # top-1 prob matches a dense softmax
+    logits = np.asarray(x) @ np.asarray(embed).T
+    logits = np.tanh(logits / 30.0) * 30.0
+    dense = np.exp(logits - logits.max(axis=1, keepdims=True))
+    dense /= dense.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(kp[:, 0], dense.max(axis=1), rtol=1e-5)
+
+
+def test_lens_stats_row_padding():
+    """N not a multiple of 8: padded rows must not corrupt real rows."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(3, 16)), jnp.float32)
+    embed = jnp.asarray(rng.normal(size=(128, 16)), jnp.float32)
+    got = pallas_lens.lens_stats(
+        x, embed, jnp.asarray(0), top_k=2, block_v=128, interpret=True)
+    exp = pallas_lens.lens_stats_reference(x, embed, jnp.asarray(0), top_k=2)
+    assert got.logsumexp.shape == (3,)
+    np.testing.assert_allclose(np.asarray(got.topk_vals),
+                               np.asarray(exp.topk_vals), rtol=1e-5, atol=1e-5)
+
+
+def test_lens_stats_rejects_misaligned_vocab():
+    x = jnp.zeros((2, 8), jnp.float32)
+    embed = jnp.zeros((100, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        pallas_lens.lens_stats(x, embed, jnp.asarray(0), block_v=64,
+                               interpret=True)
+
+
+def test_lens_forward_pallas_tap_matches_xla_tap():
+    """lens_forward(use_pallas=True) must agree with the XLA tap end-to-end."""
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import lens
+
+    cfg = gemma2.PRESETS["gemma2_tiny"].replace(vocab_size=256)
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 256, size=(2, 9)))
+    targets = jnp.full((2,), 17, jnp.int32)
+
+    xla = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3)
+    fused = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3,
+                              use_pallas=True)
+    np.testing.assert_allclose(np.asarray(fused.tap.target_prob),
+                               np.asarray(xla.tap.target_prob),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(fused.tap.topk_ids),
+                                  np.asarray(xla.tap.topk_ids))
+    np.testing.assert_allclose(np.asarray(fused.tap.topk_probs),
+                               np.asarray(xla.tap.topk_probs),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fused.residual),
+                               np.asarray(xla.residual), rtol=1e-5, atol=1e-6)
