@@ -35,6 +35,51 @@ import numpy as np
 
 BASELINE_PROMPTS_PER_SEC = 0.07
 
+# bf16 peak TFLOP/s per chip by device kind (MFU denominator); override with
+# BENCH_PEAK_TFLOPS.  v5 lite = v5e.
+PEAK_TFLOPS_BY_KIND = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
+               sae_width: int) -> float:
+    """Analytic matmul FLOPs actually executed per arm_step (decode + lens).
+
+    Counts what the compiled programs do, not an idealized lower bound: the
+    SAE edit is lax.cond-gated to the tap layer only, decode attention spans
+    the fixed-size cache each step.
+    """
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    L, V = cfg.num_layers, cfg.vocab_size
+    t_total = prompt_len + new_tokens
+    # q,k,v,o projections + GeGLU (gate/up/down), 2 FLOPs per MAC.
+    per_tok_layer = 4 * D * H * Dh + 4 * D * K * Dh + 6 * D * F
+
+    def attn(tokens, kv_len):
+        return tokens * 4 * H * Dh * kv_len     # qk^T + weighted-sum
+
+    toks_prefill = batch * prompt_len
+    toks_decode = batch * new_tokens
+    flops = (toks_prefill + toks_decode) * L * per_tok_layer
+    flops += attn(toks_prefill, prompt_len) * L
+    flops += attn(toks_decode, t_total) * L     # full fixed-size cache per step
+    flops += toks_decode * 2 * D * V            # unembed per generated token
+    # In-graph SAE edit (encode dominates), cond-gated to the tap layer.
+    flops += (toks_prefill + toks_decode) * 2 * D * sae_width
+    # Lens pass: full-sequence forward + the per-layer vocab readout.
+    toks_lens = batch * t_total
+    flops += toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
+    flops += toks_lens * L * 2 * D * V          # the dominant term
+    return float(flops)
+
 
 def main() -> int:
     import jax
@@ -49,7 +94,9 @@ def main() -> int:
     preset = os.environ.get(
         "BENCH_PRESET", "gemma2_bench" if on_accel else "gemma2_tiny")
     cfg = gemma2.PRESETS[preset]
-    batch = int(os.environ.get("BENCH_BATCH", "8" if on_accel else "2"))
+    # 48 rows ≈ the sweep's natural batch (10 prompts × several arms share one
+    # compiled program); B=64 exceeds one v5e chip's 16 GB HBM by ~100 MB.
+    batch = int(os.environ.get("BENCH_BATCH", "48" if on_accel else "2"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "50" if on_accel else "4"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "32" if on_accel else "8"))
     reps = int(os.environ.get("BENCH_REPS", "3" if on_accel else "1"))
@@ -93,12 +140,28 @@ def main() -> int:
     dt = (time.perf_counter() - t0) / reps
 
     prompts_per_sec = batch / dt
+
+    flops = _arm_flops(cfg, batch, prompt_len, new_tokens, sae.w_enc.shape[1])
+    tflops = flops / dt / 1e12
+    peak = os.environ.get("BENCH_PEAK_TFLOPS")
+    if peak is not None:
+        peak = float(peak)
+    elif on_accel:
+        kind = jax.devices()[0].device_kind
+        peak = PEAK_TFLOPS_BY_KIND.get(kind)
+    mfu = round(tflops / peak, 4) if peak else None
+
     print(json.dumps({
         "metric": "ablation-sweep prompts/sec/chip "
                   f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
         "value": round(prompts_per_sec, 3),
         "unit": "prompts/sec/chip",
         "vs_baseline": round(prompts_per_sec / BASELINE_PROMPTS_PER_SEC, 2),
+        "tflops_per_sec": round(tflops, 2),
+        "mfu": mfu,
+        "pallas_lens": use_pallas,
+        "config": {"preset": preset, "batch": batch, "new_tokens": new_tokens,
+                   "prompt_len": prompt_len, "reps": reps},
     }))
     return 0
 

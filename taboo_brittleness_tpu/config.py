@@ -94,6 +94,9 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # Param dtype (weights storage).
     param_dtype: str = "bfloat16"
+    # Fused Pallas lens readout (ops/pallas_lens.py): None = auto (on for TPU,
+    # off on CPU), True/False to force.
+    use_pallas_lens: Optional[bool] = None
 
 
 @dataclass(frozen=True)

@@ -56,12 +56,26 @@ class LensTap(NamedTuple):
     topk_probs: jax.Array
 
 
-def lens_probs(params: Params, cfg: Gemma2Config, h: jax.Array) -> jax.Array:
-    """softmax(softcap(lm_head(final_norm(h)))) in f32 — the lens readout that the
-    reference applies at every layer inside the nnsight trace (src/models.py:135-138)."""
+def lens_probs(
+    params: Params,
+    cfg: Gemma2Config,
+    h: jax.Array,
+    *,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """softmax(lm_head(final_norm(h))) in f32 — the lens readout that the
+    reference applies at every layer inside the nnsight trace (src/models.py:135-138).
+
+    NO final-logit softcap by default: the reference calls ``model.lm_head``
+    directly, and HF applies Gemma-2's final softcap in
+    ``Gemma2ForCausalLM.forward`` *outside* ``lm_head`` — so the reference lens
+    distribution is over bare logits.  Pass ``logit_softcap`` to opt into the
+    capped variant (matches the model's actual final-logit path, ``unembed``)."""
     x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     logits = x @ params["embed"].astype(cfg.compute_dtype).T
-    logits = softcap(logits.astype(jnp.float32), cfg.final_logit_softcap)
+    logits = logits.astype(jnp.float32)
+    if logit_softcap is not None:
+        logits = softcap(logits, logit_softcap)
     return jax.nn.softmax(logits, axis=-1)
 
 
@@ -71,6 +85,7 @@ def make_lens_tap(
     target_ids: jax.Array,   # [B] one target token id per batch row
     *,
     top_k: int = 5,
+    logit_softcap: Optional[float] = None,
 ):
     """Build a ``per_layer_fn`` computing :class:`LensTap` stats for one layer.
 
@@ -80,7 +95,8 @@ def make_lens_tap(
 
     def tap(h: jax.Array, layer_idx: jax.Array) -> LensTap:
         del layer_idx
-        probs = lens_probs(params, cfg, h)              # [B, T, V] f32
+        probs = lens_probs(params, cfg, h,
+                           logit_softcap=logit_softcap)  # [B, T, V] f32
         tgt = jnp.take_along_axis(
             probs, target_ids[:, None, None], axis=-1
         )[..., 0]                                        # [B, T]
@@ -104,6 +120,7 @@ def make_pallas_lens_tap(
     top_k: int = 5,
     block_v: int = 1024,
     interpret: Optional[bool] = None,
+    logit_softcap: Optional[float] = None,
 ):
     """Fused-kernel variant of :func:`make_lens_tap` (ops/pallas_lens.py).
 
@@ -128,7 +145,7 @@ def make_pallas_lens_tap(
             params["embed"].astype(cfg.compute_dtype),
             target_id,
             top_k=top_k,
-            logit_cap=cfg.final_logit_softcap,
+            logit_cap=logit_softcap,
             block_v=block_v,
             interpret=interpret,
         )
@@ -146,15 +163,33 @@ def make_pallas_lens_tap(
     return tap
 
 
-def make_full_probs_tap(params: Params, cfg: Gemma2Config):
+def make_full_probs_tap(params: Params, cfg: Gemma2Config,
+                        logit_softcap: Optional[float] = None):
     """Parity-mode tap: return the full [B, T, V] lens probs per layer (the
-    reference's all_probs dump, reference src/run_generation.py:46-48)."""
+    reference's all_probs dump, reference src/run_generation.py:46-48).
+    Uncapped by default, matching the reference lens semantics."""
 
     def tap(h: jax.Array, layer_idx: jax.Array) -> jax.Array:
         del layer_idx
-        return lens_probs(params, cfg, h)
+        return lens_probs(params, cfg, h, logit_softcap=logit_softcap)
 
     return tap
+
+
+def _pallas_auto_ok(params: Params) -> bool:
+    """Whether ``use_pallas=None`` may resolve to the fused kernel: TPU
+    backend, concrete (non-traced) params, placed on a single device.  The
+    kernel is Mosaic-TPU-only and has no GSPMD partitioning rule, so sharded
+    or traced params take the XLA tap (which partitions via tp_topk)."""
+    if jax.default_backend() != "tpu":
+        return False
+    embed = params["embed"]
+    if isinstance(embed, jax.core.Tracer):
+        return False
+    sharding = getattr(embed, "sharding", None)
+    if sharding is not None and len(sharding.device_set) > 1:
+        return False
+    return True
 
 
 class LensForwardResult(NamedTuple):
@@ -175,24 +210,47 @@ def lens_forward(
     attn_validity: Optional[jax.Array] = None,
     compute_logits: bool = False,
     edit_fn: Optional[Any] = None,
-    use_pallas: bool = False,
+    use_pallas: Optional[bool] = None,
+    logit_softcap: Optional[float] = None,
 ) -> LensForwardResult:
     """One compiled pass: lens stats for every layer + the residual at
     ``tap_layer`` (for the SAE path — the reference's ``residual_stream_l31``
     save, src/models.py:131-132).
+
+    ``use_pallas=None`` auto-resolves: the fused Pallas kernel when the
+    backend is TPU AND the params are concrete and single-device (the kernel
+    has no GSPMD partitioning rule — under tp the vocab-sharded unembed must
+    take the XLA tap + tp_topk path instead); the XLA tap everywhere else,
+    including under an enclosing jit trace where placement can't be verified.
+    Pass True/False to force.  The Pallas path requires one target id shared
+    by the whole batch (true per word in every pipeline) — checked here when
+    the ids are concrete; callers forcing use_pallas=True under jit own the
+    invariant.
 
     The residual capture rides the scan *carry* (``carry_tap``): one
     [B, T, D] accumulator is masked-added per layer, so only a single
     residual buffer ever exists — the stacked [L, B, T, D] tensor (~780 MB
     for the 9B at B=10) never materializes.
     """
+    if use_pallas is None:
+        use_pallas = _pallas_auto_ok(params)
 
     if use_pallas:
+        if not isinstance(target_ids, jax.core.Tracer):
+            import numpy as _np
+
+            uniq = _np.unique(_np.asarray(target_ids))
+            if uniq.size > 1:
+                raise ValueError(
+                    "pallas lens path needs ONE target id shared by the batch "
+                    f"(got {uniq.size} distinct); pass use_pallas=False")
         # All pipeline callers pass one target per word; the kernel exploits it.
         stats_tap = make_pallas_lens_tap(
-            params, cfg, target_ids[0], top_k=top_k)
+            params, cfg, target_ids[0], top_k=top_k,
+            logit_softcap=logit_softcap)
     else:
-        stats_tap = make_lens_tap(params, cfg, target_ids, top_k=top_k)
+        stats_tap = make_lens_tap(params, cfg, target_ids, top_k=top_k,
+                                  logit_softcap=logit_softcap)
 
     B, T = input_ids.shape
     acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)

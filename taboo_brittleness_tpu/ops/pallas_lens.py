@@ -1,8 +1,8 @@
 """Fused logit-lens readout as a Pallas TPU kernel.
 
 The lens readout is the framework's hot op: per layer, per position,
-``softmax(softcap(norm(h) @ E^T))`` over the 256k vocab, reduced to a few
-statistics (BASELINE.json north_star: "the logit-lens readout becomes vmap'd
+``softmax(norm(h) @ E^T)`` over the 256k vocab (optionally softcapped),
+reduced to a few statistics (BASELINE.json north_star: "the logit-lens readout becomes vmap'd
 unembed matmuls with in-graph top-k; candidate Pallas fusion").  The XLA path
 (ops/lens.py) already avoids *persisting* the [T, V] probabilities, but still
 materializes each layer's [T, V] logits in HBM between the matmul, the
@@ -13,7 +13,7 @@ and emits only O(T * NT) partials per layer:
 
     for each vocab tile j (grid dim, sequential on core):
         logits = x @ E[j]^T            (MXU, f32 accumulate)
-        logits = softcap(logits)
+        logits = softcap(logits)       [only when logit_cap is set]
         -> tile max, tile sum-exp (relative to tile max)   [flash-style]
         -> tile top-k logits + global vocab ids            [iterative max]
         -> target-token logit if the target id falls in this tile
@@ -29,7 +29,7 @@ the real-TPU path is exercised by bench.py when TBX_PALLAS_LENS=1.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,7 +65,7 @@ def _lens_tile_kernel(
     *,
     block_v: int,
     top_k: int,
-    logit_cap: float,
+    logit_cap: Optional[float],
 ):
     j = pl.program_id(1)         # vocab tile (innermost: x block stays in VMEM)
     x = x_ref[:]                                           # [N, D]
@@ -74,7 +74,8 @@ def _lens_tile_kernel(
         x, e, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                                      # [N, BV] f32
-    logits = jnp.tanh(logits / logit_cap) * logit_cap      # final softcap
+    if logit_cap is not None:                              # opt-in softcap
+        logits = jnp.tanh(logits / logit_cap) * logit_cap
 
     n, bv = logits.shape
     base = j * block_v
@@ -124,7 +125,7 @@ def lens_stats(
     target_id: jax.Array,    # [] int32 — one target token id for all rows
     *,
     top_k: int = 5,
-    logit_cap: float = 30.0,
+    logit_cap: Optional[float] = None,
     block_v: int = 1024,
     block_n: int = 256,
     interpret: bool = False,
@@ -135,6 +136,9 @@ def lens_stats(
     by ``block_v`` (256000 = 250 x 1024).  Rows process in ``block_n`` tiles
     (VMEM budget: x-block + double-buffered embed tile + [RN, BV] logits must
     fit 16 MB); N pads to a block_n multiple internally.
+
+    ``logit_cap=None`` (default) matches the reference lens: bare logits, no
+    final softcap (reference src/models.py:135-138 calls lm_head directly).
     """
     n_rows, d = x.shape
     v = embed.shape[0]
@@ -207,11 +211,12 @@ def lens_stats(
 
 def lens_stats_reference(
     x: jax.Array, embed: jax.Array, target_id: jax.Array,
-    *, top_k: int = 5, logit_cap: float = 30.0,
+    *, top_k: int = 5, logit_cap: Optional[float] = None,
 ) -> LensStats:
     """Unfused XLA oracle with identical semantics (tests + fallback)."""
     logits = (x.astype(jnp.float32) @ embed.astype(jnp.float32).T)
-    logits = jnp.tanh(logits / logit_cap) * logit_cap
+    if logit_cap is not None:
+        logits = jnp.tanh(logits / logit_cap) * logit_cap
     lse = jax.nn.logsumexp(logits, axis=-1)
     tgt = logits[:, target_id]
     vals, ids = lax.top_k(logits, top_k)

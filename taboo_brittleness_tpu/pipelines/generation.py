@@ -85,7 +85,8 @@ def generate_for_word(
             jnp.full((B,), tid, jnp.int32),
             tap_layer=layer_idx, top_k=config.model.top_k,
             positions=jnp.asarray(positions),
-            attn_validity=jnp.asarray(valid, bool))
+            attn_validity=jnp.asarray(valid, bool),
+            use_pallas=config.model.use_pallas_lens)
 
     for row, p_idx in enumerate(missing):
         # The reference traces the full output truncated before the response's

@@ -48,27 +48,36 @@ from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike, target_token_
 # Module-level edit fns (static for jit; all state rides in edit_params).
 # ---------------------------------------------------------------------------
 
-def _masked(h: jax.Array, edited: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
-    """Apply ``edited`` at layer ``ep['layer']``, optionally only where
+def _at_layer(h: jax.Array, idx: jax.Array, ep: Dict[str, Any], apply) -> jax.Array:
+    """Run ``apply`` only at layer ``ep['layer']``, optionally only where
     ``ep['positions']`` ([B, T] bool, aligned to the current chunk) is True —
-    the Execution Plan's intervene-at-spike-positions mode, usable on
-    teacher-forced full-sequence passes where positions are known."""
-    mask = ep.get("positions")
-    if mask is not None:
-        edited = jnp.where(mask[:, :, None], edited, h)
-    return jnp.where(idx == ep["layer"], edited, h)
+    the Execution Plan's intervene-at-spike-positions mode.
+
+    ``lax.cond`` (not ``jnp.where``) so the other 41 scan iterations skip the
+    edit's compute entirely: the SAE encode is ~2·D·16384 FLOPs/token — paying
+    it per layer inside the uniform scan would add ~50% to the whole decode
+    forward (measured on gemma2_bench)."""
+
+    def edit(x):
+        edited = apply(x)
+        mask = ep.get("positions")
+        if mask is not None:
+            edited = jnp.where(mask[:, :, None], edited, x)
+        return edited
+
+    return jax.lax.cond(idx == ep["layer"], edit, lambda x: x, h)
 
 
 def sae_ablation_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
     """Zero ``ep['latent_ids']`` in the SAE basis at layer ``ep['layer']``."""
-    edited = sae_ops.ablate_latents(ep["sae"], h, ep["latent_ids"])
-    return _masked(h, edited, idx, ep)
+    return _at_layer(
+        h, idx, ep, lambda x: sae_ops.ablate_latents(ep["sae"], x, ep["latent_ids"]))
 
 
 def projection_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
     """Remove the subspace spanned by ``ep['basis']`` at layer ``ep['layer']``."""
-    edited = projection.remove_subspace(h, ep["basis"])
-    return _masked(h, edited, idx, ep)
+    return _at_layer(
+        h, idx, ep, lambda x: projection.remove_subspace(x, ep["basis"]))
 
 
 # ---------------------------------------------------------------------------
@@ -133,7 +142,8 @@ def prepare_word_state(
     res = lens.lens_forward(
         params, cfg, jnp.asarray(seqs), jnp.full((B,), tid, jnp.int32),
         tap_layer=layer_idx, top_k=top_k,
-        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool))
+        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool),
+        use_pallas=config.model.use_pallas_lens)
 
     target_prob = np.asarray(res.tap.target_prob)[layer_idx]   # [B, T]
     denom = max(int(resp.sum()), 1)
@@ -236,7 +246,7 @@ def measure_arm(
         jnp.full((B,), state.target_id, jnp.int32),
         tap_layer=layer_idx, top_k=top_k,
         positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool),
-        edit_fn=bound)
+        edit_fn=bound, use_pallas=config.model.use_pallas_lens)
     target_prob = np.asarray(res.tap.target_prob)[layer_idx]
     denom = max(int(resp.sum()), 1)
     secret_prob = float((target_prob * resp).sum() / denom)

@@ -110,6 +110,7 @@ def analyze_word_on_device(
     top_k: int,
     max_new_tokens: int = 50,
     edit_fn: Optional[Callable] = None,
+    use_pallas: Optional[bool] = None,
 ) -> WordAnalysis:
     """Batched generate + lens for all prompts of one word.
 
@@ -134,6 +135,7 @@ def analyze_word_on_device(
         tap_layer=layer_idx, top_k=top_k,
         positions=jnp.asarray(layout.positions),
         attn_validity=jnp.asarray(valid, bool),
+        use_pallas=use_pallas,
     )
 
     # Masked-sum aggregation at the layer of interest, fused in one jit from
@@ -231,6 +233,7 @@ def evaluate_word(
             layer_idx=config.model.layer_idx,
             top_k=config.model.top_k,
             max_new_tokens=config.experiment.max_new_tokens,
+            use_pallas=config.model.use_pallas_lens,
         )
         for row, (slot, guesses) in enumerate(zip(missing, analysis.guesses)):
             guesses_by_prompt[slot] = guesses

@@ -9,16 +9,18 @@ import jax.numpy as jnp
 from taboo_brittleness_tpu.ops import pallas_lens
 
 
+@pytest.mark.parametrize("cap", [None, 30.0])
 @pytest.mark.parametrize("n_rows,d,v,k", [(6, 32, 256, 3), (16, 64, 512, 5)])
-def test_lens_stats_matches_reference(n_rows, d, v, k):
+def test_lens_stats_matches_reference(n_rows, d, v, k, cap):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(n_rows, d)), jnp.float32)
     embed = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
     target = jnp.asarray(7, jnp.int32)
 
     got = pallas_lens.lens_stats(
-        x, embed, target, top_k=k, logit_cap=30.0, block_v=128, interpret=True)
-    exp = pallas_lens.lens_stats_reference(x, embed, target, top_k=k)
+        x, embed, target, top_k=k, logit_cap=cap, block_v=128, interpret=True)
+    exp = pallas_lens.lens_stats_reference(x, embed, target, top_k=k,
+                                           logit_cap=cap)
 
     np.testing.assert_allclose(np.asarray(got.logsumexp),
                                np.asarray(exp.logsumexp), rtol=1e-5, atol=1e-5)
@@ -41,9 +43,8 @@ def test_lens_stats_probabilities_normalize():
     assert ((0 <= tp) & (tp <= 1)).all()
     kp = np.asarray(got.topk_probs())
     assert ((0 <= kp) & (kp <= 1.0 + 1e-6)).all()
-    # top-1 prob matches a dense softmax
+    # top-1 prob matches a dense softmax (uncapped = reference lens default)
     logits = np.asarray(x) @ np.asarray(embed).T
-    logits = np.tanh(logits / 30.0) * 30.0
     dense = np.exp(logits - logits.max(axis=1, keepdims=True))
     dense /= dense.sum(axis=1, keepdims=True)
     np.testing.assert_allclose(kp[:, 0], dense.max(axis=1), rtol=1e-5)
@@ -81,7 +82,8 @@ def test_lens_forward_pallas_tap_matches_xla_tap():
     ids = jnp.asarray(rng.integers(0, 256, size=(2, 9)))
     targets = jnp.full((2,), 17, jnp.int32)
 
-    xla = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3)
+    xla = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3,
+                            use_pallas=False)
     fused = lens.lens_forward(params, cfg, ids, targets, tap_layer=2, top_k=3,
                               use_pallas=True)
     np.testing.assert_allclose(np.asarray(fused.tap.target_prob),
