@@ -61,10 +61,23 @@ def _load(args) -> Config:
     return Config()
 
 
-def _loader(config: Config, args):
+def _mesh(config: Config):
+    """Build the (dp, tp, sp) device mesh from config when the host has more
+    than one device; None on a single chip (plain single-device execution)."""
+    import jax
+
+    if len(jax.devices()) <= 1:
+        return None
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+
+    return meshlib.make_mesh(config.mesh)
+
+
+def _loader(config: Config, args, mesh=None):
     from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
 
-    return CheckpointManager(config.model, checkpoint_root=args.checkpoint_root)
+    return CheckpointManager(config.model, checkpoint_root=args.checkpoint_root,
+                             mesh=mesh)
 
 
 def _sae(config: Config, path: Optional[str]):
@@ -87,7 +100,8 @@ def cmd_generate(args) -> int:
     processed = args.processed_dir or config.output.processed_dir
     with maybe_profile(args.trace_dir), manifest.stage("generate"):
         done = generation.run_generation(
-            config, model_loader=_loader(config, args), words=args.words,
+            config, model_loader=_loader(config, args, mesh=_mesh(config)),
+            words=args.words,
             processed_dir=processed, parity_dump=args.parity_dump)
     manifest.extra["generated"] = {w: len(v) for w, v in done.items()}
     print(json.dumps({w: len(v) for w, v in done.items()}))
@@ -101,7 +115,8 @@ def cmd_logit_lens(args) -> int:
     from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer
 
     config = _load(args)
-    loader = _loader(config, args)
+    mesh = _mesh(config)
+    loader = _loader(config, args, mesh=mesh)
     words = args.words or config.words
     # Tokenizer-only load (all taboo checkpoints share the Gemma-2 tokenizer):
     # a fully cached run must never stream 9B of weights just to decode ids —
@@ -117,7 +132,7 @@ def cmd_logit_lens(args) -> int:
     with maybe_profile(args.trace_dir), manifest.stage("evaluate"):
         results = logit_lens.run_evaluation(
             config, tok, words=words, model_loader=loader,
-            processed_dir=args.processed_dir, output_path=out)
+            processed_dir=args.processed_dir, output_path=out, mesh=mesh)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
@@ -149,7 +164,7 @@ def cmd_interventions(args) -> int:
     from taboo_brittleness_tpu.pipelines import interventions
 
     config = _load(args)
-    loader = _loader(config, args)
+    loader = _loader(config, args, mesh=_mesh(config))
     sae = _sae(config, args.sae_npz)
     params, cfg, tok = loader(args.word)
     out = args.output or os.path.join(
@@ -180,7 +195,8 @@ def cmd_token_forcing(args) -> int:
     manifest = _manifest(args, "token-forcing")
     with manifest.stage("forcing"):
         results = token_forcing.run_token_forcing(
-            config, model_loader=_loader(config, args), words=args.words,
+            config, model_loader=_loader(config, args, mesh=_mesh(config)),
+            words=args.words,
             modes=tuple(args.modes), output_path=out)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]

@@ -163,6 +163,74 @@ def make_pallas_lens_tap(
     return tap
 
 
+def make_tp_lens_tap(
+    params: Params,
+    cfg: Gemma2Config,
+    target_ids: jax.Array,   # [B]
+    *,
+    top_k: int,
+    mesh,                    # jax.sharding.Mesh with a "tp" axis
+    logit_softcap: Optional[float] = None,
+):
+    """Vocab-sharded (tensor-parallel) lens tap.
+
+    With ``embed`` sharded ``P('tp', None)`` (parallel/mesh.py param policy),
+    the naive tap's ``lax.top_k`` over [B, T, V] would make XLA all-gather
+    256k logits per layer.  Here each tp shard computes its local
+    [B/dp, T, V/tp] logits, the softmax normalizer and target probability
+    merge via psum/pmax, and the top-k merges shard-locally via ``tp_topk`` —
+    O(k·tp) ICI bytes per (layer, position) instead of O(V).  No replicated
+    [B, T, V] tensor ever exists (asserted over the compiled HLO in
+    tests/test_parallel.py).
+    """
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    if cfg.vocab_size % tp:
+        raise ValueError(f"vocab {cfg.vocab_size} not divisible by tp={tp}")
+    shard_size = cfg.vocab_size // tp
+
+    def tap(h: jax.Array, layer_idx: jax.Array) -> LensTap:
+        del layer_idx
+        x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+
+        def local_stats(x_l, e_l, tgt_l):
+            # x_l [b, T, D]; e_l [V/tp, D]; tgt_l [b] global ids.
+            logits = (x_l @ e_l.T).astype(jnp.float32)        # [b, T, V/tp]
+            if logit_softcap is not None:
+                logits = softcap(logits, logit_softcap)
+            gmax = lax.pmax(jnp.max(logits, axis=-1), "tp")   # [b, T]
+            denom = lax.psum(
+                jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1), "tp")
+            probs = jnp.exp(logits - gmax[..., None]) / denom[..., None]
+
+            base = lax.axis_index("tp") * shard_size
+            local_t = tgt_l - base                             # [b]
+            ok = (local_t >= 0) & (local_t < shard_size)
+            idx = jnp.clip(local_t, 0, shard_size - 1)[:, None, None]
+            tgt_p = jnp.take_along_axis(
+                probs, jnp.broadcast_to(idx, (*probs.shape[:2], 1)), axis=-1
+            )[..., 0]                                          # [b, T]
+            tgt_p = lax.psum(jnp.where(ok[:, None], tgt_p, 0.0), "tp")
+
+            tv, ti = meshlib.tp_topk(probs, top_k, axis_name="tp",
+                                     shard_size=shard_size)
+            return LensTap(target_prob=tgt_p, argmax_id=ti[..., 0],
+                           argmax_prob=tv[..., 0], topk_ids=ti, topk_probs=tv)
+
+        return meshlib.shard_map(
+            local_stats, mesh,
+            in_specs=(P("dp", None, None), P("tp", None), P("dp")),
+            out_specs=LensTap(
+                target_prob=P("dp", None), argmax_id=P("dp", None),
+                argmax_prob=P("dp", None), topk_ids=P("dp", None, None),
+                topk_probs=P("dp", None, None)),
+        )(x, params["embed"].astype(cfg.compute_dtype), target_ids)
+
+    return tap
+
+
 def make_full_probs_tap(params: Params, cfg: Gemma2Config,
                         logit_softcap: Optional[float] = None):
     """Parity-mode tap: return the full [B, T, V] lens probs per layer (the
@@ -212,6 +280,7 @@ def lens_forward(
     edit_fn: Optional[Any] = None,
     use_pallas: Optional[bool] = None,
     logit_softcap: Optional[float] = None,
+    tp_mesh: Optional[Any] = None,
 ) -> LensForwardResult:
     """One compiled pass: lens stats for every layer + the residual at
     ``tap_layer`` (for the SAE path — the reference's ``residual_stream_l31``
@@ -232,6 +301,16 @@ def lens_forward(
     residual buffer ever exists — the stacked [L, B, T, D] tensor (~780 MB
     for the 9B at B=10) never materializes.
     """
+    if tp_mesh is not None and tp_mesh.shape.get("tp", 1) > 1:
+        # Vocab-sharded unembed: shard-local readout + tp_topk merge.
+        stats_tap = make_tp_lens_tap(
+            params, cfg, target_ids, top_k=top_k, mesh=tp_mesh,
+            logit_softcap=logit_softcap)
+        return _lens_forward_with_tap(
+            params, cfg, input_ids, stats_tap, tap_layer=tap_layer,
+            positions=positions, attn_validity=attn_validity,
+            compute_logits=compute_logits, edit_fn=edit_fn)
+
     if use_pallas is None:
         use_pallas = _pallas_auto_ok(params)
 
@@ -251,7 +330,24 @@ def lens_forward(
     else:
         stats_tap = make_lens_tap(params, cfg, target_ids, top_k=top_k,
                                   logit_softcap=logit_softcap)
+    return _lens_forward_with_tap(
+        params, cfg, input_ids, stats_tap, tap_layer=tap_layer,
+        positions=positions, attn_validity=attn_validity,
+        compute_logits=compute_logits, edit_fn=edit_fn)
 
+
+def _lens_forward_with_tap(
+    params: Params,
+    cfg: Gemma2Config,
+    input_ids: jax.Array,
+    stats_tap,
+    *,
+    tap_layer: int,
+    positions: Optional[jax.Array],
+    attn_validity: Optional[jax.Array],
+    compute_logits: bool,
+    edit_fn: Optional[Any],
+) -> LensForwardResult:
     B, T = input_ids.shape
     acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
 
@@ -363,6 +459,63 @@ def aggregate_from_residual(
         return aggregate_masked_sum(probs, ids, m, top_k=top_k)
 
     return jax.vmap(one)(residual, token_ids, response_mask)
+
+
+def aggregate_from_residual_tp(
+    params: Params,
+    cfg: Gemma2Config,
+    residual: jax.Array,      # [B, T, D]
+    token_ids: jax.Array,     # [B, T]
+    response_mask: jax.Array,  # [B, T] bool
+    *,
+    top_k: int,
+    mesh,
+    logit_softcap: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Vocab-sharded variant of :func:`aggregate_from_residual`: the masked
+    positional sum reduces to [B, V/tp] per shard and only O(k·tp) candidates
+    cross ICI via ``tp_topk`` — the [T, V] probability tensor of a row exists
+    only shard-locally."""
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    if cfg.vocab_size % tp:
+        raise ValueError(f"vocab {cfg.vocab_size} not divisible by tp={tp}")
+    shard_size = cfg.vocab_size // tp
+    eps = cfg.rms_norm_eps
+
+    def local(h_l, ids_l, mask_l, e_l):
+        # h_l [b, T, D] f32 residuals; ids_l/mask_l [b, T]; e_l [V/tp, D].
+        x = rms_norm(h_l, params["final_norm"], eps)
+        logits = (x @ e_l.T).astype(jnp.float32)               # [b, T, Vl]
+        if logit_softcap is not None:
+            logits = softcap(logits, logit_softcap)
+        gmax = lax.pmax(jnp.max(logits, axis=-1), "tp")
+        denom = lax.psum(
+            jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1), "tp")
+        probs = jnp.exp(logits - gmax[..., None]) / denom[..., None]
+
+        # Zero current+previous token ids (global ids -> local columns; ids
+        # outside this shard one-hot to nothing).
+        base = lax.axis_index("tp") * shard_size
+        Vl = shard_size
+        curr = jax.nn.one_hot(ids_l - base, Vl, dtype=bool)    # [b, T, Vl]
+        prev_ids = jnp.roll(ids_l, 1, axis=1).at[:, 0].set(-1)
+        prev = jax.nn.one_hot(prev_ids - base, Vl, dtype=bool)
+        keep = ~(curr | prev) & mask_l[..., None]
+        summed = jnp.sum(jnp.where(keep, probs, 0.0), axis=1)  # [b, Vl]
+        return meshlib.tp_topk(summed, top_k, axis_name="tp",
+                               shard_size=shard_size)
+
+    vals, ids = meshlib.shard_map(
+        local, mesh,
+        in_specs=(P("dp", None, None), P("dp", None), P("dp", None),
+                  P("tp", None)),
+        out_specs=(P("dp", None), P("dp", None)),
+    )(residual, token_ids, response_mask,
+      params["embed"].astype(cfg.compute_dtype))
+    return ids, vals
 
 
 def spike_positions(

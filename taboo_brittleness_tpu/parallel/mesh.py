@@ -109,6 +109,34 @@ def shard_params(params: Params, cfg: Gemma2Config, mesh: Mesh) -> Params:
     )
 
 
+def per_device_bytes(shapes: Params, specs: Optional[Params] = None,
+                     mesh: Optional[Mesh] = None) -> int:
+    """Bytes of parameter storage per device under a sharding policy.
+
+    ``shapes`` is a pytree of ``jax.ShapeDtypeStruct`` (e.g. from
+    ``jax.eval_shape``) — placement math without allocating anything, used to
+    prove the 9B fits per-chip HBM before any weight exists (SURVEY.md §7
+    hard part #2).  With no specs/mesh, returns total (replicated) bytes.
+    """
+    specs = specs if specs is not None else jax.tree_util.tree_map(
+        lambda _: P(), shapes)
+
+    def leaf_bytes(sds, spec) -> int:
+        n = int(np.prod(sds.shape)) * jnp.dtype(sds.dtype).itemsize
+        div = 1
+        if mesh is not None and isinstance(spec, P):
+            for entry in spec:
+                if entry is None:
+                    continue
+                for axis in (entry if isinstance(entry, tuple) else (entry,)):
+                    div *= mesh.shape[axis]
+        return n // div
+
+    sizes = jax.tree_util.tree_map(
+        leaf_bytes, shapes, specs, is_leaf=lambda x: isinstance(x, P))
+    return sum(jax.tree_util.tree_leaves(sizes))
+
+
 def batch_spec() -> P:
     """Sweep-grid batches shard over dp; model axes stay unsharded at the
     annotation level (tp sharding propagates from the params)."""

@@ -111,6 +111,7 @@ def analyze_word_on_device(
     max_new_tokens: int = 50,
     edit_fn: Optional[Callable] = None,
     use_pallas: Optional[bool] = None,
+    mesh: Optional[Any] = None,
 ) -> WordAnalysis:
     """Batched generate + lens for all prompts of one word.
 
@@ -128,22 +129,42 @@ def analyze_word_on_device(
     B = seqs.shape[0]
 
     tid = target_token_id(tok, word)
-    target_ids = jnp.full((B,), tid, jnp.int32)
+
+    # The tp lens path shards the batch over dp; pad (repeating the last row,
+    # stripped below) so any number of cache-missing prompts divides.
+    pad_rows = (-B) % mesh.shape.get("dp", 1) if mesh is not None else 0
+
+    def padded(x):
+        if not pad_rows:
+            return np.asarray(x)
+        return np.concatenate(
+            [np.asarray(x), np.repeat(np.asarray(x)[-1:], pad_rows, axis=0)],
+            axis=0)
+
+    Bp = B + pad_rows
+    target_ids = jnp.full((Bp,), tid, jnp.int32)
 
     res = lens.lens_forward(
-        params, model_cfg, jnp.asarray(seqs), target_ids,
+        params, model_cfg, jnp.asarray(padded(seqs)), target_ids,
         tap_layer=layer_idx, top_k=top_k,
-        positions=jnp.asarray(layout.positions),
-        attn_validity=jnp.asarray(valid, bool),
+        positions=jnp.asarray(padded(layout.positions)),
+        attn_validity=jnp.asarray(padded(valid), bool),
         use_pallas=use_pallas,
+        tp_mesh=mesh,
     )
 
     # Masked-sum aggregation at the layer of interest, fused in one jit from
-    # the tapped residuals (no persistent [B, T, V] buffer).
-    top_ids, _ = lens.aggregate_from_residual(
-        params, model_cfg, res.residual, jnp.asarray(seqs),
-        jnp.asarray(layout.response_mask), top_k=top_k)
-    top_ids = np.asarray(top_ids)                          # [B, K]
+    # the tapped residuals (no persistent [B, T, V] buffer).  Under tp the
+    # vocab-sharded variant merges candidates via tp_topk.
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        top_ids, _ = lens.aggregate_from_residual_tp(
+            params, model_cfg, res.residual, jnp.asarray(padded(seqs)),
+            jnp.asarray(padded(layout.response_mask)), top_k=top_k, mesh=mesh)
+    else:
+        top_ids, _ = lens.aggregate_from_residual(
+            params, model_cfg, res.residual, jnp.asarray(padded(seqs)),
+            jnp.asarray(padded(layout.response_mask)), top_k=top_k)
+    top_ids = np.asarray(top_ids)[:B]                      # [B, K]
 
     guesses = [[tok.decode([int(i)]).strip() for i in row] for row in top_ids]
     tp = np.moveaxis(np.asarray(res.tap.target_prob), 1, 0)   # [L,B,T] -> [B,L,T]
@@ -198,6 +219,7 @@ def evaluate_word(
     model_loader: Optional[ModelLoader] = None,
     processed_dir: Optional[str] = None,
     plot_dir: Optional[str] = None,
+    mesh: Optional[Any] = None,
 ) -> List[List[str]]:
     """Guesses for every prompt of one word; cache-hit rows never touch the
     model (unlike the reference, which instantiates the 9B even on full cache
@@ -234,6 +256,7 @@ def evaluate_word(
             top_k=config.model.top_k,
             max_new_tokens=config.experiment.max_new_tokens,
             use_pallas=config.model.use_pallas_lens,
+            mesh=mesh,
         )
         for row, (slot, guesses) in enumerate(zip(missing, analysis.guesses)):
             guesses_by_prompt[slot] = guesses
@@ -256,6 +279,7 @@ def run_evaluation(
     processed_dir: Optional[str] = None,
     output_path: Optional[str] = None,
     plot_dir: Optional[str] = None,
+    mesh: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Full evaluation: per-word guesses -> metrics -> results JSON
     (reference src/01_reproduce_logit_lens.py:268-295,344-348)."""
@@ -267,7 +291,7 @@ def run_evaluation(
         predictions[word] = evaluate_word(
             config, word, tok,
             model_loader=model_loader, processed_dir=processed_dir,
-            plot_dir=plot_dir)
+            plot_dir=plot_dir, mesh=mesh)
 
     results = metrics_mod.calculate_metrics(predictions, words, config.word_plurals)
     for word in words:

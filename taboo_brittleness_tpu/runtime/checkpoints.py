@@ -61,10 +61,12 @@ class CheckpointManager:
     """LRU cache of loaded (params, cfg, tokenizer) triples keyed by word."""
 
     def __init__(self, model_cfg: ModelConfig, *,
-                 checkpoint_root: Optional[str] = None, capacity: int = 1):
+                 checkpoint_root: Optional[str] = None, capacity: int = 1,
+                 mesh=None):
         self.model_cfg = model_cfg
         self.checkpoint_root = checkpoint_root
         self.capacity = max(1, capacity)
+        self.mesh = mesh  # when set, params are placed per parallel.mesh policy
         self._cache: "OrderedDict[str, Tuple]" = OrderedDict()
 
     def repo_id(self, word: str) -> str:
@@ -78,6 +80,10 @@ class CheckpointManager:
         cfg = infer_config_from_hf_config_json(
             snap, dtype=self.model_cfg.dtype, param_dtype=self.model_cfg.param_dtype)
         params = from_safetensors_dir(snap, cfg)
+        if self.mesh is not None:
+            from taboo_brittleness_tpu.parallel import mesh as meshlib
+
+            params = meshlib.shard_params(params, cfg, self.mesh)
         tok = HFTokenizer.from_pretrained(snap)
         self._cache[word] = (params, cfg, tok)
         while len(self._cache) > self.capacity:
