@@ -81,14 +81,42 @@ def _loader(config: Config, args, mesh=None):
 
 
 def _sae(config: Config, path: Optional[str]):
+    """Load the Gemma-Scope SAE: explicit npz path, else auto-convert from a
+    local snapshot of the release (tools/convert_gemma_scope.py)."""
     from taboo_brittleness_tpu.ops import sae as sae_ops
 
     if path:
         return sae_ops.load(path)
+
+    root = os.environ.get("TABOO_GEMMA_SCOPE_ROOT")
+    if root and os.path.isdir(root):
+        import sys as _sys
+
+        tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools_dir not in _sys.path:
+            _sys.path.insert(0, tools_dir)
+        import convert_gemma_scope
+
+        # Converted output lives under the (writable) working tree, not the
+        # snapshot root — release mounts are commonly read-only.
+        out = os.path.join("results", "sae_cache",
+                           config.sae.sae_id.replace("/", "__") + ".npz")
+        try:
+            if not os.path.exists(out):
+                convert_gemma_scope.convert(root, out, config.sae.sae_id)
+                print(f"[sae] converted {config.sae.release}/"
+                      f"{config.sae.sae_id} -> {out}")
+            return sae_ops.load(out)
+        except (OSError, FileNotFoundError, KeyError, ValueError) as e:
+            raise SystemExit(
+                f"SAE auto-convert from {root} failed ({e}); run "
+                "tools/convert_gemma_scope.py manually and pass --sae-npz")
+
     raise SystemExit(
-        "--sae-npz required (no hub egress; convert the Gemma-Scope release "
-        f"{config.sae.release}/{config.sae.sae_id} to npz with keys "
-        "W_enc/b_enc/W_dec/b_dec/threshold)")
+        "no SAE available: pass --sae-npz, or set TABOO_GEMMA_SCOPE_ROOT to a "
+        f"local snapshot of {config.sae.release} (auto-converted via "
+        "tools/convert_gemma_scope.py)")
 
 
 def cmd_generate(args) -> int:

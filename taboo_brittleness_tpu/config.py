@@ -134,6 +134,9 @@ class InterventionConfig:
     random_trials: int = 10  # R random-control draws per budget
     ranks: Tuple[int, ...] = (1, 2, 4, 8)  # r for low-rank projection removal
     spike_top_k: int = 4  # top-K secret-prob positions = "spike tokens"
+    # Edit only at the baseline spike positions (Execution Plan's
+    # spike-localized arm) instead of every position of every forward.
+    spike_masked: bool = False
 
 
 @dataclass(frozen=True)

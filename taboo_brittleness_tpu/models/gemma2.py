@@ -237,11 +237,12 @@ def _layer(
     cfg: Gemma2Config,
     cos: jax.Array,
     sin: jax.Array,
-    mask_global: jax.Array,      # [B, T, S]
-    mask_sliding: jax.Array,     # [B, T, S]
+    mask_global: Optional[jax.Array],   # [B, T, S] (None with attend_fn)
+    mask_sliding: Optional[jax.Array],  # [B, T, S]
     cache_k: Optional[jax.Array],  # [B, S, K, Dh] or None
     cache_v: Optional[jax.Array],
     cache_index: Optional[jax.Array],  # [] int32 position at which to write
+    attend_fn: Optional[Callable] = None,  # (q, k, v, layer_idx) -> [B, T, H*Dh]
 ) -> Tuple[jax.Array, Tuple[Optional[jax.Array], Optional[jax.Array]]]:
     B, T, D = h.shape
     H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -262,15 +263,19 @@ def _layer(
     else:
         k_all, v_all = k, v
 
-    # Select sliding vs global mask by layer parity — both masks are computed
-    # once outside the scan, selection is a cheap jnp.where on booleans.
-    mask = jnp.where(cfg.is_sliding(layer_idx), mask_sliding, mask_global)
-
-    attn = attend(
-        q, k_all, v_all, mask,
-        scaling=cfg.query_pre_attn_scalar ** -0.5,
-        logit_cap=cfg.attn_logit_softcap,
-    )
+    if attend_fn is not None:
+        # Sequence-parallel (ring) or otherwise custom attention: masking is
+        # the implementation's responsibility (it sees global positions).
+        attn = attend_fn(q, k_all, v_all, layer_idx)
+    else:
+        # Select sliding vs global mask by layer parity — both masks are
+        # computed once outside the scan, selection is a cheap jnp.where.
+        mask = jnp.where(cfg.is_sliding(layer_idx), mask_sliding, mask_global)
+        attn = attend(
+            q, k_all, v_all, mask,
+            scaling=cfg.query_pre_attn_scalar ** -0.5,
+            logit_cap=cfg.attn_logit_softcap,
+        )
     attn = attn @ lp["o"].astype(cdt)
     attn = rms_norm(attn, lp["post_attn_norm"], eps)
     h = residual + attn
@@ -315,6 +320,7 @@ def forward(
     edit_fn: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
     carry_tap: Optional[Tuple[Any, Callable[[Any, jax.Array, jax.Array], Any]]] = None,
     compute_logits: bool = True,
+    attend_fn: Optional[Callable] = None,
 ) -> ForwardResult:
     """One compiled forward pass.
 
@@ -332,7 +338,14 @@ def forward(
 
     With ``cache``, [B, T] is the *new* chunk (T=1 for decode steps); keys/values
     are appended at ``cache.length`` and attention spans the whole cache.
+
+    ``attend_fn(q, k, v, layer_idx) -> [B, T, H*Dh]`` swaps the dense attention
+    for a custom implementation that owns its masking — the sequence-parallel
+    ring path (``parallel.sp.forward_sp``) passes a closure over ring
+    attention here.  Mutually exclusive with ``cache``.
     """
+    if attend_fn is not None and cache is not None:
+        raise ValueError("attend_fn does not support the KV-cache decode path")
     B, T = input_ids.shape
     cdt = cfg.compute_dtype
 
@@ -355,7 +368,9 @@ def forward(
 
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
-    if cache is not None:
+    if attend_fn is not None:
+        mask_global = mask_sliding = None   # attend_fn owns masking
+    elif cache is not None:
         S = cache.k.shape[2]
         # The new chunk's slot validity lands at [length, length+T).
         new_valid = lax.dynamic_update_slice(cache.valid, attn_validity, (0, cache.length))
@@ -401,7 +416,7 @@ def forward(
             lp, idx = xs
             h, _ = _layer(
                 h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
-                None, None, None,
+                None, None, None, attend_fn=attend_fn,
             )
             if edit_fn is not None:
                 h = edit_fn(h, idx)

@@ -48,20 +48,43 @@ void deflate_chunk(const unsigned char* data, size_t len, bool last, int level,
     chunk->err = Z_STREAM_ERROR;
     return;
   }
-  chunk->out.resize(deflateBound(&zs, len) + 16);
-  zs.next_in = const_cast<unsigned char*>(data);
-  zs.avail_in = static_cast<uInt>(len);
-  zs.next_out = chunk->out.data();
-  zs.avail_out = static_cast<uInt>(chunk->out.size());
-  int rc = deflate(&zs, last ? Z_FINISH : Z_SYNC_FLUSH);
-  if ((last && rc != Z_STREAM_END) || (!last && rc != Z_OK)) {
-    chunk->err = rc;
+  // zlib's avail_in/avail_out/crc32 lengths are uInt (32-bit): a chunk > 4 GiB
+  // fed in one call would silently truncate both the stream and the CRC.
+  // Stream the input in bounded slices and drain through a staging buffer.
+  constexpr size_t kSlice = static_cast<size_t>(1) << 28;  // 256 MiB << 4 GiB
+  std::vector<unsigned char> stage(static_cast<size_t>(1) << 22);
+  uLong crc = crc32(0L, Z_NULL, 0);
+  size_t pos = 0;
+  int rc = Z_OK;
+  do {
+    size_t take = (len - pos < kSlice) ? len - pos : kSlice;
+    bool final_slice = (pos + take == len);
+    int flush = final_slice ? (last ? Z_FINISH : Z_SYNC_FLUSH) : Z_NO_FLUSH;
+    zs.next_in = const_cast<unsigned char*>(data + pos);
+    zs.avail_in = static_cast<uInt>(take);
+    if (take) crc = crc32(crc, data + pos, static_cast<uInt>(take));
+    do {
+      zs.next_out = stage.data();
+      zs.avail_out = static_cast<uInt>(stage.size());
+      rc = deflate(&zs, flush);
+      if (rc == Z_STREAM_ERROR) {
+        chunk->err = rc;
+        deflateEnd(&zs);
+        return;
+      }
+      chunk->out.insert(chunk->out.end(), stage.data(),
+                        stage.data() + (stage.size() - zs.avail_out));
+    } while (zs.avail_out == 0 || zs.avail_in > 0 ||
+             (flush == Z_FINISH && rc != Z_STREAM_END));
+    pos += take;
+  } while (pos < len);
+  if (last && rc != Z_STREAM_END) {
+    chunk->err = Z_STREAM_ERROR;
     deflateEnd(&zs);
     return;
   }
-  chunk->out.resize(zs.total_out);
   deflateEnd(&zs);
-  chunk->crc = crc32(0L, data, static_cast<uInt>(len));
+  chunk->crc = crc;
   chunk->in_len = len;
 }
 

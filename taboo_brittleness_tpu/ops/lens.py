@@ -244,6 +244,20 @@ def make_full_probs_tap(params: Params, cfg: Gemma2Config,
     return tap
 
 
+def residual_carry_tap(batch: int, seq: int, hidden: int, tap_layer: int):
+    """(init, update) carry tap capturing resid_post at ``tap_layer`` in f32 —
+    O(1) in layers: one [B, T, D] accumulator masked-added per scan step, so
+    the stacked [L, B, T, D] tensor never materializes.  Shared by the dense
+    lens paths and the sequence-parallel forward (parallel/sp.py)."""
+    acc0 = jnp.zeros((batch, seq, hidden), jnp.float32)
+
+    def accumulate(acc, h, layer_idx):
+        keep = (layer_idx == tap_layer).astype(jnp.float32)
+        return acc + h.astype(jnp.float32) * keep
+
+    return acc0, accumulate
+
+
 def _pallas_auto_ok(params: Params) -> bool:
     """Whether ``use_pallas=None`` may resolve to the fused kernel: TPU
     backend, concrete (non-traced) params, placed on a single device.  The
@@ -349,18 +363,12 @@ def _lens_forward_with_tap(
     edit_fn: Optional[Any],
 ) -> LensForwardResult:
     B, T = input_ids.shape
-    acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
-
-    def accumulate(acc, h, layer_idx):
-        keep = (layer_idx == tap_layer).astype(jnp.float32)
-        return acc + h.astype(jnp.float32) * keep
-
     res = forward(
         params, cfg, input_ids,
         positions=positions,
         attn_validity=attn_validity,
         per_layer_fn=stats_tap,
-        carry_tap=(acc0, accumulate),
+        carry_tap=residual_carry_tap(B, T, cfg.hidden_size, tap_layer),
         edit_fn=edit_fn,
         compute_logits=compute_logits,
     )
@@ -391,15 +399,9 @@ def full_probs_forward(
         return res.taps, None
 
     B, T = input_ids.shape
-    acc0 = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
-
-    def accumulate(acc, h, layer_idx):
-        keep = (layer_idx == tap_layer).astype(jnp.float32)
-        return acc + h.astype(jnp.float32) * keep
-
     res = forward(params, cfg, input_ids, positions=positions,
                   attn_validity=attn_validity, per_layer_fn=probs_tap,
-                  carry_tap=(acc0, accumulate),
+                  carry_tap=residual_carry_tap(B, T, cfg.hidden_size, tap_layer),
                   compute_logits=False)
     return res.taps, res.carry_tap
 

@@ -9,13 +9,14 @@ running denominator), so the full [T, T] score matrix never materializes and
 attention cost per device is O(T²/sp).
 
 Numerics match ``models.gemma2.attend`` (GQA, logit softcap, f32 softmax) —
-asserted by tests/test_ring.py against the single-device oracle.  Use inside
-``shard_map`` with a mesh carrying an ``sp`` axis.
+asserted by tests/test_parallel.py against the single-device oracle.  Use
+inside ``shard_map`` with a mesh carrying an ``sp`` axis; the model-level
+entry point is ``parallel.sp.forward_sp``.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,10 +61,14 @@ def ring_attention(
     axis_name: str,
     scaling: float,
     logit_cap: float,
-    sliding_window: Optional[int] = None,
+    sliding_window: Optional[Any] = None,  # int OR traced int32 scalar
 ) -> jax.Array:
     """Causal (optionally sliding-window) GQA attention with the KV blocks
     rotating around the ``axis_name`` ring.  Returns [B, Tq, H*Dh].
+
+    ``sliding_window`` may be a traced scalar (forward_sp passes
+    ``where(is_sliding(layer), window, INT32_MAX)`` so one compiled ring body
+    serves both of Gemma-2's alternating layer kinds inside the layer scan).
 
     Flash-style merge across ring steps: new running max m' = max(m, m_blk),
     rescale previous numerator/denominator by exp(m - m'), add the block's.

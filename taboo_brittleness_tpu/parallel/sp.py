@@ -1,0 +1,108 @@
+"""Sequence-parallel (long-context) model forward over the ``sp`` mesh axis.
+
+The reference never exceeds seq ≈ 80 tokens (SURVEY.md §2.3); this framework
+treats long context as first-class: ``forward_sp`` runs the FULL Gemma-2
+forward under ``shard_map`` with the sequence axis sharded over ``sp``.  Every
+per-token op (embed, norms, projections, MLP, lens/unembed) is position-local
+and runs unchanged on the local ``[B, T/sp, D]`` block; attention — the only
+cross-token op — goes through ``ring.ring_attention`` (K/V blocks rotate one
+ICI hop per step, flash-style accumulation, O(T²/sp) per device).
+
+Sliding vs global layer alternation is preserved by passing the window as a
+*traced* operand (``jnp.where(is_sliding, window, INT32_MAX)``) — one ring
+implementation serves both layer kinds inside the ``lax.scan`` over layers.
+
+Scope: teacher-forced full-sequence passes (the lens/analysis workload).  The
+KV-cache decode path stays dense (``runtime.decode``) — generation at the
+reference's ≤50-token scale has no sequence-parallel need.  Params are taken
+replicated over ``sp`` (combine with tp via the mesh's other axes upstream).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.parallel import mesh as meshlib
+from taboo_brittleness_tpu.parallel import ring
+
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class SPForwardResult(NamedTuple):
+    logits: Optional[jax.Array]      # [B, T, V] (softcapped) or None
+    last_hidden: jax.Array           # [B, T, D]
+    residual: Optional[jax.Array]    # [B, T, D] f32 resid_post at tap_layer
+
+
+def forward_sp(
+    params: gemma2.Params,
+    cfg: gemma2.Gemma2Config,
+    input_ids: jax.Array,            # [B, T], T % sp == 0
+    mesh,
+    *,
+    positions: Optional[jax.Array] = None,
+    attn_validity: Optional[jax.Array] = None,
+    tap_layer: Optional[int] = None,
+    compute_logits: bool = True,
+    edit_fn: Optional[Callable] = None,
+) -> SPForwardResult:
+    """One sp-sharded forward pass; results gather back to the caller's
+    sharding.  ``tap_layer`` captures the residual via the O(1)-in-layers
+    carry tap, exactly like ``ops.lens.lens_forward``."""
+    B, T = input_ids.shape
+    sp = mesh.shape["sp"]
+    if T % sp:
+        raise ValueError(f"sequence length {T} not divisible by sp={sp}")
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    if attn_validity is None:
+        attn_validity = jnp.ones((B, T), bool)
+
+    def local(p, ids_l, pos_l, val_l):
+        def ring_attend(q, k, v, layer_idx):
+            window = jnp.where(
+                cfg.is_sliding(layer_idx), cfg.sliding_window, _INT32_MAX)
+            return ring.ring_attention(
+                q, k, v, pos_l, pos_l, val_l, axis_name="sp",
+                scaling=cfg.query_pre_attn_scalar ** -0.5,
+                logit_cap=cfg.attn_logit_softcap,
+                sliding_window=window)
+
+        carry = None
+        if tap_layer is not None:
+            from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+
+            carry = residual_carry_tap(*ids_l.shape, cfg.hidden_size, tap_layer)
+
+        res = gemma2.forward(
+            p, cfg, ids_l, positions=pos_l, attn_validity=val_l,
+            edit_fn=edit_fn, carry_tap=carry,
+            compute_logits=compute_logits, attend_fn=ring_attend)
+
+        out = [res.last_hidden]
+        if compute_logits:
+            out.append(res.logits)
+        if tap_layer is not None:
+            out.append(res.carry_tap)
+        return tuple(out)
+
+    n_out = 1 + int(compute_logits) + int(tap_layer is not None)
+    out_specs = tuple([P(None, "sp", None)] * n_out)
+    outs = meshlib.shard_map(
+        local, mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=out_specs,
+    )(params, input_ids, positions, attn_validity)
+
+    it = iter(outs)
+    last_hidden = next(it)
+    logits = next(it) if compute_logits else None
+    residual = next(it) if tap_layer is not None else None
+    return SPForwardResult(logits=logits, last_hidden=last_hidden,
+                           residual=residual)

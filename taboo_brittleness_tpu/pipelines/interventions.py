@@ -49,9 +49,17 @@ from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike, target_token_
 # ---------------------------------------------------------------------------
 
 def _at_layer(h: jax.Array, idx: jax.Array, ep: Dict[str, Any], apply) -> jax.Array:
-    """Run ``apply`` only at layer ``ep['layer']``, optionally only where
-    ``ep['positions']`` ([B, T] bool, aligned to the current chunk) is True —
-    the Execution Plan's intervene-at-spike-positions mode.
+    """Run ``apply`` only at layer ``ep['layer']``, optionally position-masked
+    (the Execution Plan's intervene-at-spike-positions mode):
+
+    - ``ep['positions']`` — explicit [B, T] bool mask aligned to the current
+      chunk (teacher-forced full-sequence passes);
+    - ``ep['spike_positions']`` — [B, K] *absolute RoPE positions* of the
+      baseline spikes, matched against ``ep['chunk_positions']`` ([B, T], the
+      current chunk's positions — injected by greedy_decode for prefill and
+      every decode step, and by the sweep's teacher-forced callers).  This is
+      what makes spike-localized editing work *during generation*, where the
+      chunk is one token wide (SURVEY.md §7 hard part #3).
 
     ``lax.cond`` (not ``jnp.where``) so the other 41 scan iterations skip the
     edit's compute entirely: the SAE encode is ~2·D·16384 FLOPs/token — paying
@@ -61,6 +69,18 @@ def _at_layer(h: jax.Array, idx: jax.Array, ep: Dict[str, Any], apply) -> jax.Ar
     def edit(x):
         edited = apply(x)
         mask = ep.get("positions")
+        if mask is None and "spike_positions" in ep:
+            if "chunk_positions" not in ep:
+                # Degrading to an every-position edit here would silently run
+                # the WRONG experimental arm while labeled spike-masked.
+                raise ValueError(
+                    "edit_params has spike_positions but no chunk_positions; "
+                    "route the forward through greedy_decode / measure_arm "
+                    "(which inject the current chunk's positions) or add "
+                    "chunk_positions yourself")
+            cp = ep["chunk_positions"]                     # [B, T] int
+            spk = ep["spike_positions"]                    # [B, K] int
+            mask = jnp.any(cp[:, :, None] == spk[:, None, :], axis=-1)
         if mask is not None:
             edited = jnp.where(mask[:, :, None], edited, x)
         return edited
@@ -239,8 +259,16 @@ def measure_arm(
                                     layout.positions, layout.response_mask)
     B = seqs.shape[0]
 
+    def _ep_with_positions(chunk_positions):
+        """Teacher-forced passes know the whole layout; expose its positions
+        so spike-masked edits (ep['spike_positions']) can align."""
+        if isinstance(edit_params, dict):
+            return {**edit_params,
+                    "chunk_positions": jnp.asarray(chunk_positions, jnp.int32)}
+        return edit_params
+
     # (b) Lens under the edit (edited forward, edited residuals).
-    bound = lambda h, i: edit_fn(h, i, edit_params)
+    bound = lambda h, i: edit_fn(h, i, _ep_with_positions(positions))
     res = lens.lens_forward(
         params, cfg, jnp.asarray(seqs),
         jnp.full((B,), state.target_id, jnp.int32),
@@ -259,7 +287,8 @@ def measure_arm(
     edited_nll = np.asarray(_nll_jit(
         params, cfg, jnp.asarray(state.sequences),
         jnp.asarray(state.valid, bool), jnp.asarray(state.positions),
-        jnp.asarray(next_mask), edit_fn=edit_fn, edit_params=edit_params))
+        jnp.asarray(next_mask), edit_fn=edit_fn,
+        edit_params=_ep_with_positions(state.positions)))
     n_resp = max(int(next_mask.sum()), 1)
     dnll = float((edited_nll - state.baseline_nll).sum() / n_resp)
 
@@ -281,6 +310,18 @@ def measure_arm(
 # Sweeps.
 # ---------------------------------------------------------------------------
 
+def _spike_mask_extra(config: Config, state: WordState) -> Dict[str, Any]:
+    """With ``config.intervention.spike_masked``, edits apply only at the
+    baseline spike positions (Execution Plan's spike-localized arm) instead of
+    every position.  Spike columns convert to absolute RoPE positions so the
+    mask survives the left-padded layout and the one-token decode chunks."""
+    if not config.intervention.spike_masked:
+        return {}
+    B = state.spike_pos.shape[0]
+    spike_abs = state.positions[np.arange(B)[:, None], state.spike_pos]
+    return {"spike_positions": jnp.asarray(spike_abs, jnp.int32)}
+
+
 def run_ablation_sweep(
     params: Params,
     cfg: Gemma2Config,
@@ -296,17 +337,20 @@ def run_ablation_sweep(
     order = np.argsort(-scores)
     S = scores.shape[0]
     rng = np.random.default_rng(config.experiment.seed if seed is None else seed)
+    extra = _spike_mask_extra(config, state)
 
     out: Dict[str, Any] = {"word": state.word, "budgets": {}}
     for m in config.intervention.budgets:
         targeted_ids = jnp.asarray(order[:m], jnp.int32)
-        ep = {"sae": sae, "latent_ids": targeted_ids, "layer": config.model.layer_idx}
+        ep = {"sae": sae, "latent_ids": targeted_ids,
+              "layer": config.model.layer_idx, **extra}
         targeted = measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep)
 
         randoms: List[ArmResult] = []
         for _ in range(config.intervention.random_trials):
             rand_ids = jnp.asarray(rng.choice(S, size=m, replace=False), jnp.int32)
-            ep_r = {"sae": sae, "latent_ids": rand_ids, "layer": config.model.layer_idx}
+            ep_r = {"sae": sae, "latent_ids": rand_ids,
+                    "layer": config.model.layer_idx, **extra}
             randoms.append(
                 measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep_r))
 
@@ -335,17 +379,18 @@ def run_projection_sweep(
     max_rank = max(config.intervention.ranks)
     u_full, _ = projection.principal_subspace(jnp.asarray(spikes), rank=max_rank)
 
+    extra = _spike_mask_extra(config, state)
     out: Dict[str, Any] = {"word": state.word, "ranks": {}}
     for r_i, r in enumerate(config.intervention.ranks):
         basis = u_full[:, :r]
-        ep = {"basis": basis, "layer": config.model.layer_idx}
+        ep = {"basis": basis, "layer": config.model.layer_idx, **extra}
         targeted = measure_arm(params, cfg, tok, config, state, projection_edit, ep)
 
         randoms: List[ArmResult] = []
         for t in range(config.intervention.random_trials):
             key = jax.random.PRNGKey(rng_seed * 1000 + r_i * 100 + t)
             rand_basis = projection.random_subspace(key, spikes.shape[1], r)
-            ep_r = {"basis": rand_basis, "layer": config.model.layer_idx}
+            ep_r = {"basis": rand_basis, "layer": config.model.layer_idx, **extra}
             randoms.append(
                 measure_arm(params, cfg, tok, config, state, projection_edit, ep_r))
 

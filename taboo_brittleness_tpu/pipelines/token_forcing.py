@@ -132,19 +132,28 @@ def run_token_forcing(
     words: Optional[Sequence[str]] = None,
     modes: Sequence[str] = ("pregame", "postgame"),
     output_path: Optional[str] = None,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
 ) -> Dict[str, Any]:
     """Forcing sweep over words; per-word success + overall mean per mode
-    (the paper's Table 1 'Token forcing' rows)."""
+    (the paper's Table 1 'Token forcing' rows).
+
+    ``edit_fn``/``edit_params`` run the whole sweep under an intervention arm
+    (ablated / projected model) — the Execution Plan measures forcing success
+    per arm, so the driver composes this with the intervention sweeps.
+    """
     words = list(words if words is not None else config.words)
     results: Dict[str, Any] = {w: {} for w in words}
     for word in words:
         params, cfg, tok = model_loader(word)
         if "pregame" in modes:
             results[word]["pregame"] = pregame_forcing(
-                params, cfg, tok, config, word)
+                params, cfg, tok, config, word,
+                edit_fn=edit_fn, edit_params=edit_params)
         if "postgame" in modes:
             results[word]["postgame"] = postgame_forcing(
-                params, cfg, tok, config, word)
+                params, cfg, tok, config, word,
+                edit_fn=edit_fn, edit_params=edit_params)
 
     overall = {
         mode: float(np.mean([results[w][mode]["success_rate"] for w in words]))

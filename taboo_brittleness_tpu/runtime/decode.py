@@ -102,8 +102,17 @@ def greedy_decode(
     B, T = prompt_ids.shape
     cache = KVCache.zeros(cfg, B, max_len=T + max_new_tokens)
 
+    def _with_chunk_positions(ep, chunk_pos):
+        """Position-aware edits (spike masking) read the current chunk's RoPE
+        positions from ep['chunk_positions']; non-dict edit state passes
+        through untouched."""
+        if isinstance(ep, dict):
+            return {**ep, "chunk_positions": chunk_pos}
+        return ep
+
     if edit_fn is not None and edit_params is not None:
-        bound_edit = lambda h, idx: edit_fn(h, idx, edit_params)
+        bound_edit = lambda h, idx: edit_fn(
+            h, idx, _with_chunk_positions(edit_params, prompt_positions))
     else:
         bound_edit = edit_fn
 
@@ -114,7 +123,7 @@ def greedy_decode(
         cache=cache,
         edit_fn=bound_edit,
     )
-    step_edit = bound_edit if (bound_edit is not None and decode_edit) else None
+    use_step_edit = edit_fn is not None and decode_edit
 
     prompt_len = jnp.sum(prompt_valid, axis=1)           # [B] real prompt lengths
     first_tok = jnp.argmax(prefill.logits[:, -1], axis=-1).astype(jnp.int32)
@@ -125,6 +134,13 @@ def greedy_decode(
 
     def step(carry, _):
         cache, tok, done, pos = carry
+        if use_step_edit and edit_params is not None:
+            step_edit = lambda h, idx: edit_fn(
+                h, idx, _with_chunk_positions(edit_params, pos[:, None]))
+        elif use_step_edit:
+            step_edit = edit_fn
+        else:
+            step_edit = None
         res = forward(
             params, cfg, tok[:, None],
             positions=pos[:, None],

@@ -1,0 +1,118 @@
+"""tools/convert_gemma_scope.py on synthetic state dicts in every supported
+source form (the real release is unreachable without hub egress; the layout —
+params.npz with W_enc/W_dec/b_enc/b_dec/threshold — is fixed by the official
+gemma-scope release the reference consumes, src/02_run_sae_baseline.py:30-36)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.ops import sae as sae_ops
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import convert_gemma_scope as cgs  # noqa: E402
+
+D, S = 8, 32
+
+
+def _state(rng):
+    return {
+        "W_enc": rng.normal(size=(D, S)).astype(np.float32),
+        "b_enc": rng.normal(size=(S,)).astype(np.float32),
+        "W_dec": rng.normal(size=(S, D)).astype(np.float32),
+        "b_dec": rng.normal(size=(D,)).astype(np.float32),
+        "threshold": rng.random(S).astype(np.float32),
+    }
+
+
+def test_convert_npz_roundtrip(tmp_path):
+    state = _state(np.random.default_rng(0))
+    src = tmp_path / "params.npz"
+    np.savez(src, **state)
+    out = tmp_path / "out.npz"
+    cgs.convert(str(src), str(out))
+    sae = sae_ops.load(str(out))
+    np.testing.assert_allclose(np.asarray(sae.w_enc), state["W_enc"])
+    np.testing.assert_allclose(np.asarray(sae.threshold), state["threshold"])
+    # Loaded SAE actually encodes.
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(3, D)), jnp.float32)
+    assert sae_ops.encode(sae, x).shape == (3, S)
+
+
+def test_convert_snapshot_dir_locates_sae_id(tmp_path):
+    state = _state(np.random.default_rng(2))
+    sae_dir = tmp_path / "layer_31" / "width_16k" / "average_l0_76"
+    sae_dir.mkdir(parents=True)
+    np.savez(sae_dir / "params.npz", **state)
+    out = tmp_path / "out.npz"
+    cgs.convert(str(tmp_path), str(out),
+                sae_id="layer_31/width_16k/average_l0_76")
+    np.testing.assert_allclose(
+        np.asarray(sae_ops.load(str(out)).w_dec), state["W_dec"])
+
+
+def test_convert_fixes_transposed_encoder(tmp_path):
+    state = _state(np.random.default_rng(3))
+    flipped = dict(state, W_enc=state["W_enc"].T, W_dec=state["W_dec"].T)
+    src = tmp_path / "params.npz"
+    np.savez(src, **flipped)
+    out = tmp_path / "out.npz"
+    cgs.convert(str(src), str(out))
+    sae = sae_ops.load(str(out))
+    np.testing.assert_allclose(np.asarray(sae.w_enc), state["W_enc"])
+    np.testing.assert_allclose(np.asarray(sae.w_dec), state["W_dec"])
+
+
+def test_convert_torch_state_dict_with_log_threshold(tmp_path):
+    torch = pytest.importorskip("torch")
+    state = _state(np.random.default_rng(4))
+    sd = {
+        "W_enc": torch.tensor(state["W_enc"]),
+        "b_enc": torch.tensor(state["b_enc"]),
+        "W_dec": torch.tensor(state["W_dec"]),
+        "b_dec": torch.tensor(state["b_dec"]),
+        "log_threshold": torch.tensor(np.log(state["threshold"])),
+    }
+    src = tmp_path / "sae.pt"
+    torch.save(sd, str(src))
+    out = tmp_path / "out.npz"
+    cgs.convert(str(src), str(out))
+    sae = sae_ops.load(str(out))
+    np.testing.assert_allclose(np.asarray(sae.threshold), state["threshold"],
+                               rtol=1e-6)
+
+
+def test_convert_rejects_missing_keys(tmp_path):
+    src = tmp_path / "params.npz"
+    np.savez(src, W_enc=np.zeros((D, S), np.float32))
+    assert cgs.main([str(src), str(tmp_path / "out.npz")]) == 1
+
+
+def test_cli_sae_autoconvert(tmp_path, monkeypatch):
+    """cli._sae auto-converts from TABOO_GEMMA_SCOPE_ROOT when no npz given;
+    output lands under the working tree (snapshot roots may be read-only)."""
+    from taboo_brittleness_tpu import cli
+    from taboo_brittleness_tpu.config import Config
+
+    state = _state(np.random.default_rng(5))
+    root = tmp_path / "snapshot"
+    sae_dir = root / "layer_31" / "width_16k" / "average_l0_76"
+    sae_dir.mkdir(parents=True)
+    np.savez(sae_dir / "params.npz", **state)
+    monkeypatch.setenv("TABOO_GEMMA_SCOPE_ROOT", str(root))
+    monkeypatch.chdir(tmp_path)  # converted npz goes to ./results/sae_cache
+
+    sae = cli._sae(Config(), None)
+    assert sae.d_model == D and sae.d_sae == S
+    assert (tmp_path / "results" / "sae_cache").is_dir()
+    # Second call hits the converted cache.
+    sae2 = cli._sae(Config(), None)
+    np.testing.assert_allclose(np.asarray(sae2.w_enc), state["W_enc"])
+
+    monkeypatch.delenv("TABOO_GEMMA_SCOPE_ROOT")
+    with pytest.raises(SystemExit):
+        cli._sae(Config(), None)

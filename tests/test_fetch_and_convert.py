@@ -1,0 +1,148 @@
+"""End-to-end test of the real-checkpoint on-ramp (tools/fetch_and_convert.py)
+on a tiny HF snapshot written to disk — the same safetensors/config.json layout
+an actual ``bcywinski/gemma-2-9b-it-taboo-*`` download has (reference
+src/models.py:21), so the moment real assets exist the identical code path runs.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.models.params import from_safetensors_dir, from_torch_model
+from taboo_brittleness_tpu.runtime import tokenizer as tokenizer_mod
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import fetch_and_convert as fc  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_snapshot(tmp_path_factory):
+    """A tiny Gemma-2 HF snapshot saved to disk + the torch oracle."""
+    from transformers.models.gemma2 import Gemma2Config as HFConfig, Gemma2ForCausalLM
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    hf_cfg = HFConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.intermediate_size,
+        sliding_window=cfg.sliding_window,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        attn_logit_softcapping=cfg.attn_logit_softcap,
+        final_logit_softcapping=cfg.final_logit_softcap,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps,
+        attn_implementation="eager",
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    hf_model = Gemma2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for name, p in hf_model.named_parameters():
+            if "norm" in name:
+                p.copy_(0.1 * torch.randn_like(p))
+
+    root = tmp_path_factory.mktemp("ckpt_root")
+    snap = root / "gemma-2-9b-it-taboo-moon"
+    hf_model.save_pretrained(snap, safe_serialization=True)
+    return str(root), str(snap), cfg, hf_model
+
+
+def test_safetensors_dir_matches_torch_conversion(tiny_snapshot):
+    _root, snap, cfg, hf_model = tiny_snapshot
+    cfg32 = cfg.replace(dtype="float32", param_dtype="float32")
+    from_disk = from_safetensors_dir(snap, cfg32)
+    from_torch = from_torch_model(hf_model, cfg32)
+    for a, b in zip(*(map(lambda p: __import__("jax").tree_util.tree_leaves(p),
+                          (from_disk, from_torch)))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_onramp_skips_cleanly_without_snapshot(tmp_path, capsys):
+    rc = fc.main(["--word", "ship", "--checkpoint-root", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SKIPPED" in out
+
+
+def test_onramp_converts_and_verifies(tiny_snapshot, tmp_path, monkeypatch, capsys):
+    root, _snap, cfg, _hf = tiny_snapshot
+    monkeypatch.setattr(
+        tokenizer_mod.HFTokenizer, "from_pretrained",
+        staticmethod(lambda path: WordTokenizer(
+            ["moon", "hint", "Give", "me", "a"], vocab_size=cfg.vocab_size)))
+
+    expected = str(tmp_path / "logits_moon.json")
+    args = ["--word", "moon", "--checkpoint-root", root,
+            "--dtype", "float32", "--param-dtype", "float32",
+            "--expected", expected,
+            "--reference-processed", str(tmp_path / "no_such_dir")]
+
+    # First run writes the expectation; second run regresses against it.
+    assert fc.main(args + ["--write-expected"]) == 0
+    assert os.path.exists(expected)
+    assert fc.main(args) == 0
+    out = capsys.readouterr().out
+    assert "FAIL" not in out
+
+    # A corrupted expectation must fail loudly.
+    with open(expected) as f:
+        exp = json.load(f)
+    exp["argmax"] = (exp["argmax"] + 1) % cfg.vocab_size
+    with open(expected, "w") as f:
+        json.dump(exp, f)
+    assert fc.main(args) == 1
+
+
+def test_onramp_decode_verification_against_cached_sidecars(
+        tiny_snapshot, tmp_path, monkeypatch, capsys):
+    """--verify-decode replays cached prompts and diffs response_text —
+    exercised here against sidecars produced by our own decode (so the check
+    passes), then against a corrupted one (so it fails)."""
+    root, snap, cfg, _hf = tiny_snapshot
+    tok = WordTokenizer(["moon", "hint", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    monkeypatch.setattr(tokenizer_mod.HFTokenizer, "from_pretrained",
+                        staticmethod(lambda path: tok))
+
+    cfg32 = cfg.replace(dtype="float32", param_dtype="float32")
+    params = from_safetensors_dir(snap, cfg32)
+    from taboo_brittleness_tpu.runtime import decode
+
+    prompts = ["Give me a hint", "a hint"]
+    result, _texts, prompt_ids = decode.generate(
+        params, cfg32, tok, prompts, max_new_tokens=4)
+    processed = tmp_path / "processed" / "moon"
+    processed.mkdir(parents=True)
+    for i, p in enumerate(prompts):
+        with open(processed / f"prompt_{i + 1:02d}.json", "w") as f:
+            json.dump({"prompt": p,
+                       "response_text": decode.full_text(
+                           tok, prompt_ids[i], result, i)}, f)
+
+    args = ["--word", "moon", "--checkpoint-root", root,
+            "--dtype", "float32", "--param-dtype", "float32",
+            "--expected", str(tmp_path / "none.json"),
+            "--verify-decode", "--max-new-tokens", "4",
+            "--reference-processed", str(tmp_path / "processed")]
+    assert fc.main(args) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+    side = processed / "prompt_01.json"
+    js = json.loads(side.read_text())
+    js["response_text"] = js["response_text"] + " CORRUPTED"
+    side.write_text(json.dumps(js))
+    assert fc.main(args) == 1

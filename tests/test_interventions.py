@@ -90,6 +90,60 @@ def test_projection_edit_changes_model_and_sweep_runs(setup):
     assert abs(r2["delta_nll"]) > 0.0
 
 
+def test_spike_masked_arm_differs_from_full_arm(setup):
+    """config.intervention.spike_masked edits ONLY the baseline spike
+    positions — a different experiment from the every-position edit (VERDICT
+    round-1 item 7), so the two arms must measurably differ."""
+    import dataclasses
+
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+
+    # A strong projection edit makes the difference visible on a tiny model.
+    basis, _ = __import__("taboo_brittleness_tpu.ops.projection",
+                          fromlist=["principal_subspace"]).principal_subspace(
+        jnp.asarray(state.residual.reshape(-1, cfg.hidden_size)), rank=2)
+
+    ep_full = {"basis": basis, "layer": config.model.layer_idx}
+    full = iv.measure_arm(params, cfg, tok, config, state,
+                          iv.projection_edit, ep_full)
+
+    masked_cfg = dataclasses.replace(
+        config, intervention=dataclasses.replace(
+            config.intervention, spike_masked=True))
+    extra = iv._spike_mask_extra(masked_cfg, state)
+    assert "spike_positions" in extra
+    ep_masked = {**ep_full, **extra}
+    masked = iv.measure_arm(params, cfg, tok, config, state,
+                            iv.projection_edit, ep_masked)
+
+    # Full-position editing perturbs the continuation NLL strictly more than
+    # spike-only editing; the two arms must not coincide.
+    assert abs(masked.delta_nll) < abs(full.delta_nll)
+    assert masked.delta_nll != pytest.approx(full.delta_nll, abs=1e-6)
+
+
+def test_spike_masked_sweep_runs_and_differs(setup):
+    import dataclasses
+
+    params, cfg, tok, config, sae = setup
+    masked_cfg = dataclasses.replace(
+        config, intervention=dataclasses.replace(
+            config.intervention, budgets=(2,), random_trials=1,
+            spike_masked=True))
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    res_masked = iv.run_ablation_sweep(params, cfg, tok, masked_cfg, state, sae)
+    res_full = iv.run_ablation_sweep(
+        params, cfg, tok, dataclasses.replace(
+            config, intervention=dataclasses.replace(
+                config.intervention, budgets=(2,), random_trials=1)),
+        state, sae)
+    t_m = res_masked["budgets"]["2"]["targeted"]
+    t_f = res_full["budgets"]["2"]["targeted"]
+    # Same targeted latents, different edit footprint.
+    assert t_m["delta_nll"] != pytest.approx(t_f["delta_nll"], abs=1e-9)
+
+
 def test_full_study_writes_json(setup, tmp_path):
     params, cfg, tok, config, sae = setup
     out = str(tmp_path / "study.json")

@@ -37,6 +37,18 @@ def test_native_multi_chunk_member(tmp_path, rng):
 
 
 @pytest.mark.skipif(not native_io.native_available(), reason="no native writer")
+def test_native_incompressible_member_drains_staging_buffer(tmp_path):
+    """One thread + incompressible bytes > the 4 MiB staging buffer: the
+    slice/drain loop (the >4 GiB-safety path of deflate_chunk) must produce a
+    valid stream and CRC."""
+    raw = np.frombuffer(np.random.default_rng(0).bytes(24 << 20), np.uint8)
+    path = str(tmp_path / "incompressible.npz")
+    assert native_io.save_npz(path, {"raw": raw}, n_threads=1)
+    with np.load(path) as data:
+        np.testing.assert_array_equal(data["raw"], raw)
+
+
+@pytest.mark.skipif(not native_io.native_available(), reason="no native writer")
 def test_native_empty_and_noncontiguous(tmp_path):
     path = str(tmp_path / "odd.npz")
     base = np.arange(64, dtype=np.float32).reshape(8, 8)

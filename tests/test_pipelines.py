@@ -46,6 +46,58 @@ def tiny_setup():
     return params, cfg, tok, config, loader
 
 
+def test_mid_sweep_crash_then_resume_matches_uninterrupted(tiny_setup, tmp_path):
+    """Kill the sweep after word 1, rerun: word 1 is skipped (cache = the
+    checkpoint/resume story, reference src/run_generation.py:96-98) and every
+    final artifact is identical to an uninterrupted run (SURVEY.md §5)."""
+    params, cfg, tok, config, loader = tiny_setup
+    resumed = str(tmp_path / "resumed")
+    clean = str(tmp_path / "clean")
+
+    loads = []
+
+    def crashing_loader(word):
+        loads.append(word)
+        if word == WORDS[1]:
+            raise RuntimeError("simulated mid-sweep crash")
+        return params, cfg, tok
+
+    with pytest.raises(RuntimeError, match="simulated"):
+        generation.run_generation(
+            config, model_loader=crashing_loader, words=WORDS,
+            processed_dir=resumed)
+    # Word 1's cells survived the crash; word 2 never ran.
+    for i in range(2):
+        assert os.path.exists(cache_io.summary_path(resumed, WORDS[0], i))
+        assert not os.path.exists(cache_io.summary_path(resumed, WORDS[1], i))
+
+    # Resume: word 1 fully skipped, only word 2 generates.
+    done = generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=resumed)
+    assert done == {WORDS[0]: [], WORDS[1]: [0, 1]}
+
+    # Artifacts equal an uninterrupted run, byte-for-value.
+    generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=clean)
+    for w in WORDS:
+        for i in range(2):
+            a_arr, a_meta = cache_io.load_summary(
+                cache_io.summary_path(resumed, w, i))
+            b_arr, b_meta = cache_io.load_summary(
+                cache_io.summary_path(clean, w, i))
+            assert a_meta == b_meta
+            assert set(a_arr) == set(b_arr)
+            for k in a_arr:
+                np.testing.assert_array_equal(a_arr[k], b_arr[k])
+
+    # And the downstream evaluation agrees too.
+    res_resumed = logit_lens.run_evaluation(
+        config, tok, words=WORDS, model_loader=loader, processed_dir=resumed)
+    res_clean = logit_lens.run_evaluation(
+        config, tok, words=WORDS, model_loader=loader, processed_dir=clean)
+    assert res_resumed == res_clean
+
+
 def test_generation_builds_cache_and_is_idempotent(tiny_setup, tmp_path):
     params, cfg, tok, config, loader = tiny_setup
     processed = str(tmp_path / "processed")

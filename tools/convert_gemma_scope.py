@@ -1,0 +1,149 @@
+"""Convert a Gemma-Scope SAE release to the framework's npz schema.
+
+The reference gets the SAE via ``sae_lens.SAE.from_pretrained(
+"google/gemma-scope-9b-it-res", "layer_31/width_16k/average_l0_76")``
+(reference src/02_run_sae_baseline.py:30-36).  This host has no hub egress and
+no sae_lens, so the on-ramp is a converter over whatever local form of the
+release exists:
+
+    python tools/convert_gemma_scope.py SOURCE out.npz [--sae-id layer_31/width_16k/average_l0_76]
+
+SOURCE may be:
+- the official release's ``params.npz`` (keys W_enc/W_dec/b_enc/b_dec/threshold);
+- a snapshot DIRECTORY of the gemma-scope repo (the ``<sae_id>/params.npz``
+  inside is located automatically);
+- a torch ``.pt``/``.bin`` state dict (sae_lens layout, same key names);
+- a ``.safetensors`` file with those keys.
+
+Output: ``np.savez(out, W_enc, b_enc, W_dec, b_dec, threshold)`` — exactly what
+``ops/sae.py:load`` consumes.  Shapes are validated against the JumpReLU layout
+(W_enc [d_model, d_sae], W_dec [d_sae, d_model]); an encoder stored transposed
+is fixed automatically using the bias lengths as ground truth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+CANONICAL_KEYS = ("W_enc", "b_enc", "W_dec", "b_dec", "threshold")
+_ALIASES = {
+    "W_enc": ("W_enc", "w_enc", "encoder.weight"),
+    "b_enc": ("b_enc", "encoder.bias"),
+    "W_dec": ("W_dec", "w_dec", "decoder.weight"),
+    "b_dec": ("b_dec", "decoder.bias"),
+    "threshold": ("threshold", "log_threshold"),
+}
+
+
+def load_state(source: str, sae_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Read raw arrays from any supported SOURCE form."""
+    if os.path.isdir(source):
+        found = [os.path.join(dirpath, f)
+                 for dirpath, _dirs, files in os.walk(source)
+                 for f in files if f == "params.npz"]
+        if sae_id:
+            # Exactly the requested SAE — a walk-order fallback would silently
+            # convert a different layer/width and poison every downstream run.
+            want = os.path.join(source, sae_id, "params.npz")
+            if os.path.exists(want):
+                return load_state(want)
+            have = [os.path.relpath(os.path.dirname(p), source) for p in found]
+            raise FileNotFoundError(
+                f"{want} not found; params.npz present for: {have or 'none'}")
+        if len(found) == 1:
+            return load_state(found[0])
+        if not found:
+            raise FileNotFoundError(f"no params.npz under {source}")
+        raise FileNotFoundError(
+            f"multiple SAEs under {source} "
+            f"({[os.path.relpath(os.path.dirname(p), source) for p in found]}); "
+            "pass --sae-id to pick one")
+
+    if source.endswith(".npz"):
+        with np.load(source) as data:
+            return {k: np.asarray(data[k]) for k in data.files}
+    if source.endswith(".safetensors"):
+        from safetensors import safe_open
+
+        with safe_open(source, framework="numpy") as f:
+            return {k: f.get_tensor(k) for k in f.keys()}
+    if source.endswith((".pt", ".bin", ".pth")):
+        import torch
+
+        sd = torch.load(source, map_location="cpu", weights_only=True)
+        sd = sd.get("state_dict", sd)
+        return {k: v.detach().float().numpy() for k, v in sd.items()}
+    raise ValueError(f"unsupported SOURCE {source!r} "
+                     "(expected dir, .npz, .safetensors, .pt/.bin)")
+
+
+def canonicalize(raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Map aliases to canonical keys, fix transposes, validate the layout."""
+    out: Dict[str, np.ndarray] = {}
+    for key, aliases in _ALIASES.items():
+        for a in aliases:
+            if a in raw:
+                arr = np.asarray(raw[a], np.float32)
+                if key == "threshold" and a == "log_threshold":
+                    arr = np.exp(arr)  # sae_lens stores log-space thresholds
+                out[key] = arr
+                break
+        else:
+            raise KeyError(f"missing {key} (tried {aliases}; have {sorted(raw)})")
+
+    d_model, d_sae = out["b_dec"].shape[0], out["b_enc"].shape[0]
+    if out["W_enc"].shape == (d_sae, d_model) and d_sae != d_model:
+        out["W_enc"] = out["W_enc"].T
+    if out["W_dec"].shape == (d_model, d_sae) and d_sae != d_model:
+        out["W_dec"] = out["W_dec"].T
+
+    expect = {"W_enc": (d_model, d_sae), "b_enc": (d_sae,),
+              "W_dec": (d_sae, d_model), "b_dec": (d_model,),
+              "threshold": (d_sae,)}
+    for k, shape in expect.items():
+        if out[k].shape != shape:
+            raise ValueError(f"{k} has shape {out[k].shape}, expected {shape} "
+                             f"(d_model={d_model}, d_sae={d_sae})")
+    return out
+
+
+def convert(source: str, out_path: str, sae_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+    state = canonicalize(load_state(source, sae_id))
+    # Round-trip through the runtime loader so what we wrote is what loads.
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    np.savez(out_path, **state)
+    loaded = sae_ops.load(out_path)
+    assert loaded.d_model == state["b_dec"].shape[0]
+    assert loaded.d_sae == state["b_enc"].shape[0]
+    return state
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source", help="params.npz / snapshot dir / .pt / .safetensors")
+    ap.add_argument("out", help="output npz path")
+    ap.add_argument("--sae-id", default="layer_31/width_16k/average_l0_76",
+                    help="release subfolder when SOURCE is a snapshot dir")
+    args = ap.parse_args(argv)
+    try:
+        state = convert(args.source, args.out, args.sae_id)
+    except (FileNotFoundError, KeyError, ValueError) as e:
+        print(f"FAILED: {e}")
+        return 1
+    print(f"OK: wrote {args.out} "
+          f"(d_model={state['b_dec'].shape[0]}, d_sae={state['b_enc'].shape[0]})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Regenerate the committed tiny-model regression fixtures under
+``results/fixtures/`` (VERDICT round-1 item 9: the reference commits its run
+outputs as de-facto regression fixtures — results JSON, CSV, plots; this is
+the TPU framework's equivalent at tiny-model scale, deterministic on CPU).
+
+    JAX_PLATFORMS=cpu python tools/make_fixtures.py
+
+Outputs:
+- ``processed/<word>/prompt_NN.summary.npz`` — generation cache (2 words x 2 prompts)
+- ``logit_lens_results.json``                — LL-Top-k evaluation results
+- ``baseline_metrics.csv``                   — SAE-Top-k baseline metrics
+- ``heatmap_moon_prompt01.png``              — one lens heatmap
+- ``intervention_moon.json``                 — one ablation+projection study
+
+Round N+1 diffs a fresh run against these (tests/test_fixtures.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FIXTURE_DIR = os.path.join(REPO_ROOT, "results", "fixtures")
+WORDS = ["moon", "ship"]
+PROMPTS = ["Give me a hint", "Another clue please"]
+
+
+def build_setup():
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from taboo_brittleness_tpu.config import (
+        Config, ExperimentConfig, InterventionConfig, ModelConfig, OutputConfig)
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    tok = WordTokenizer(
+        WORDS + ["hint", "clue", "Give", "me", "a", "Another", "please"],
+        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=6),
+        intervention=InterventionConfig(budgets=(1, 2), random_trials=1,
+                                        ranks=(1,), spike_top_k=2),
+        output=OutputConfig(save_plots=False),  # one dedicated heatmap below
+        word_plurals={w: [w, w + "s"] for w in WORDS},
+        prompts=PROMPTS,
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), d_model=cfg.hidden_size,
+                              d_sae=32)
+    return params, cfg, tok, config, sae
+
+
+def main() -> int:
+    params, cfg, tok, config, sae = build_setup()
+    from taboo_brittleness_tpu import plots
+    from taboo_brittleness_tpu.pipelines import (
+        generation, interventions, logit_lens, sae_baseline)
+    from taboo_brittleness_tpu.runtime import cache as cache_io
+
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    processed = os.path.join(FIXTURE_DIR, "processed")
+    loader = lambda word: (params, cfg, tok)
+
+    generation.run_generation(
+        config, model_loader=loader, words=WORDS, processed_dir=processed)
+    print(f"processed cache -> {processed}")
+
+    results = logit_lens.run_evaluation(
+        config, tok, words=WORDS, model_loader=loader, processed_dir=processed,
+        output_path=os.path.join(FIXTURE_DIR, "logit_lens_results.json"))
+    print("LL overall:", json.dumps(results["overall"]))
+
+    # SAE baseline over the cached residuals; a synthetic latent->word map
+    # shaped like feature_map.FEATURE_MAP (the real table indexes the 16k
+    # Gemma-Scope release and only makes sense with the real SAE).
+    fmap = {w: [i] for i, w in enumerate(WORDS)}
+    sae_results = sae_baseline.analyze_sae_baseline(
+        config, sae, words=WORDS, processed_dir=processed, feature_map=fmap)
+    sae_baseline.save_metrics_csv(
+        sae_results, os.path.join(FIXTURE_DIR, "baseline_metrics.csv"))
+    print("SAE overall:", json.dumps(sae_results["overall"]))
+
+    # One heatmap from the compact [L, T] summary slice.
+    arrays, meta = cache_io.load_summary(
+        cache_io.summary_path(processed, "moon", 0))
+    fig = plots.plot_token_probability(
+        arrays["target_prob"], input_words=meta["input_words"],
+        start_idx=0, figsize=(11, 5), font_size=10, title_font_size=12,
+        tick_font_size=8)
+    plots.save_fig(fig, os.path.join(FIXTURE_DIR, "heatmap_moon_prompt01.png"),
+                   dpi=72)
+
+    study = interventions.run_intervention_study(
+        params, cfg, tok, config, "moon", sae,
+        output_path=os.path.join(FIXTURE_DIR, "intervention_moon.json"))
+    print("ablation budgets:", sorted(study["ablation"]["budgets"]))
+    print(f"fixtures -> {FIXTURE_DIR}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
