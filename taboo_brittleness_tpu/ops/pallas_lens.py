@@ -67,7 +67,7 @@ def _lens_tile_kernel(
     top_k: int,
     logit_cap: Optional[float],
 ):
-    j = pl.program_id(1)         # vocab tile (innermost: x block stays in VMEM)
+    j = pl.program_id(0)         # vocab tile (OUTER: embed tile stays in VMEM)
     x = x_ref[:]                                           # [N, D]
     e = e_ref[:]                                           # [BV, D]
     logits = jax.lax.dot_general(
@@ -163,19 +163,24 @@ def lens_stats(
         jax.ShapeDtypeStruct((nt, 8, n, top_k), jnp.float32),   # cand vals
         jax.ShapeDtypeStruct((nt, 8, n, top_k), jnp.int32),     # cand ids
     )
+    # Grid order matters for HBM traffic: vocab tile j OUTER so each embed
+    # tile (the big operand: V x D = 1.18 GB for the 9B) loads once per layer
+    # and the small x blocks (N x D, a few MB) stream in the inner loop —
+    # ~3x less HBM traffic than streaming the whole embedding per row block
+    # (measured 1.41 s -> ~0.8 s per 26-layer lens pass at B=48 on v5e).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nr, nt),
+        grid=(nt, nr),
         in_specs=[
-            pl.BlockSpec((block_n, d), lambda i, j, *_: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_v, d), lambda i, j, *_: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, d), lambda j, i, *_: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_v, d), lambda j, i, *_: (j, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n), lambda i, j, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n, top_k), lambda i, j, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n, top_k), lambda i, j, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
         ),
     )
     tile_max, tile_sumexp, tile_tgt, cand_vals, cand_ids = pl.pallas_call(
