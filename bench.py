@@ -21,7 +21,12 @@ envelope that is ~2 s decode + ~3 s trace/transfer + ~10 s compression ≈ 0.07
 prompts/sec.  No faster number is published ("published": {} in BASELINE.json),
 so 0.07 prompts/sec is the reference point; vs_baseline = ours / 0.07.
 
-Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"} plus the
+north-star projection: a measured sweep *budget cell* (decode + lens + NLL for
+a launch of batched arms — the unit the intervention study repeats 10x per
+word) extrapolated to the full 20-word study, per-phase split included, on one
+chip and on a v5e-8 dp mesh ("projected_full_sweep_hours"; BASELINE.json
+north_star is "< 1 h on v5e-8").
 """
 
 from __future__ import annotations
@@ -48,13 +53,16 @@ PEAK_TFLOPS_BY_KIND = {
 }
 
 
-def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
-               sae_width: int) -> float:
-    """Analytic matmul FLOPs actually executed per arm_step (decode + lens).
+def _phase_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
+                 sae_width: int) -> dict:
+    """Analytic matmul FLOPs per sweep phase: {"decode", "lens", "nll"}.
 
     Counts what the compiled programs do, not an idealized lower bound: the
     SAE edit is lax.cond-gated to the tap layer only, decode attention spans
-    the fixed-size cache each step.
+    the fixed-size cache each step.  Kept per-phase so cross-model projections
+    scale each measured phase by ITS OWN cost ratio — the lens pass is
+    vocab-readout-dominated (L·2·D·V per token) while decode/NLL scale like a
+    plain forward, so one blended ratio would misweight them.
     """
     D, F = cfg.hidden_size, cfg.intermediate_size
     H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -68,17 +76,159 @@ def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
 
     toks_prefill = batch * prompt_len
     toks_decode = batch * new_tokens
-    flops = (toks_prefill + toks_decode) * L * per_tok_layer
-    flops += attn(toks_prefill, prompt_len) * L
-    flops += attn(toks_decode, t_total) * L     # full fixed-size cache per step
-    flops += toks_decode * 2 * D * V            # unembed per generated token
+    decode_f = (toks_prefill + toks_decode) * L * per_tok_layer
+    decode_f += attn(toks_prefill, prompt_len) * L
+    decode_f += attn(toks_decode, t_total) * L  # full fixed-size cache per step
+    decode_f += toks_decode * 2 * D * V         # unembed per generated token
     # In-graph SAE edit (encode dominates), cond-gated to the tap layer.
-    flops += (toks_prefill + toks_decode) * 2 * D * sae_width
+    decode_f += (toks_prefill + toks_decode) * 2 * D * sae_width
+
     # Lens pass: full-sequence forward + the per-layer vocab readout.
     toks_lens = batch * t_total
-    flops += toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
-    flops += toks_lens * L * 2 * D * V          # the dominant term
-    return float(flops)
+    lens_f = toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
+    lens_f += toks_lens * L * 2 * D * V         # the dominant term
+    lens_f += toks_lens * 2 * D * sae_width     # edit rides this pass too
+
+    # NLL pass: one teacher-forced forward + ONE unembed over the sequence.
+    nll_f = toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
+    nll_f += toks_lens * 2 * D * V
+    nll_f += toks_lens * 2 * D * sae_width
+    return {"decode": float(decode_f), "lens": float(lens_f),
+            "nll": float(nll_f)}
+
+
+def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
+               sae_width: int) -> float:
+    """FLOPs of the main bench's arm_step (decode + lens; no NLL phase)."""
+    f = _phase_flops(cfg, batch, prompt_len, new_tokens, sae_width)
+    return f["decode"] + f["lens"]
+
+
+def _sweep_bench(params, cfg, sae, tap_layer: int, use_pallas: bool,
+                 on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
+    """Measure one batched-arm launch of the intervention sweep (decode + lens
+    + NLL, the three compiled programs of pipelines.interventions) and project
+    the full study's wall-clock.
+
+    Study shape (Execution Plan / BASELINE.json): 20 words x (6 ablation
+    budgets + 4 projection ranks) cells, each cell = 1 targeted + 10 random
+    arms over 10 prompts, plus one baseline pass per word.  Arms fold into the
+    row axis (round-3 batching), so the launch below IS the sweep's steady
+    state; per-arm seconds scale linearly in rows until HBM caps the batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode
+
+    prompts_per_word = int(os.environ.get("BENCH_SWEEP_PROMPTS", "10"))
+    arms_per_launch = int(
+        os.environ.get("BENCH_SWEEP_ARMS", "4" if on_accel else "2"))
+    reps = int(os.environ.get("BENCH_SWEEP_REPS", "2" if on_accel else "1"))
+    arms_per_cell = 11          # targeted + R=10 random draws
+    cells_per_word = 6 + 4      # ablation budgets + projection ranks
+    n_words = 20
+    rows = arms_per_launch * prompts_per_word
+
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(rows)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    ep = {"sae": sae,
+          "latent_ids": jnp.asarray(
+              rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)), jnp.int32),
+          "layer": tap_layer}
+    targets = jnp.zeros((rows,), jnp.int32)
+
+    state = {}
+
+    def decode_phase():
+        dec = decode.greedy_decode(
+            params, cfg, *args, max_new_tokens=new_tokens,
+            edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,))
+        jax.block_until_ready(dec.tokens)
+        state["dec"] = dec
+
+    decode_phase()  # compile + capture sequences for the downstream phases
+    dec = state["dec"]
+    seqs, seq_valid = dec.sequences, dec.sequence_valid
+    pos2 = jnp.maximum(jnp.cumsum(seq_valid, axis=1) - 1, 0).astype(jnp.int32)
+    resp = jnp.zeros_like(seq_valid).at[:, prompt_len:].set(True)
+    next_mask = jnp.zeros_like(seq_valid).at[:, prompt_len - 1:-1].set(True)
+    ep_l = {**ep, "chunk_positions": pos2}
+
+    def lens_phase():
+        out = iv._lens_measure(
+            params, cfg, seqs, targets, pos2, seq_valid, resp, ep_l,
+            tap_layer=tap_layer, top_k=5, edit_fn=iv.sae_ablation_edit,
+            use_pallas=use_pallas, want_residual=False)
+        jax.block_until_ready(out["agg_ids"])
+
+    def nll_phase():
+        nll = iv._nll_jit(params, cfg, seqs, seq_valid, pos2, next_mask,
+                          edit_fn=iv.sae_ablation_edit, edit_params=ep_l)
+        jax.block_until_ready(nll)
+
+    lens_phase()
+    nll_phase()
+
+    phase_seconds = {}
+    for name, fn in (("decode", decode_phase), ("lens", lens_phase),
+                     ("nll", nll_phase)):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        phase_seconds[name] = round((time.perf_counter() - t0) / reps, 4)
+
+    launch_seconds = sum(phase_seconds.values())
+    arm_seconds = launch_seconds / arms_per_launch
+    cell_seconds = arm_seconds * arms_per_cell
+    # Baseline pass per word ~= one arm's work (same three programs at B=10).
+    word_seconds = cells_per_word * cell_seconds + arm_seconds
+    study_hours_1chip = n_words * word_seconds / 3600.0
+
+    # Scale the bench shape's measured time to the 9B by analytic matmul
+    # FLOPs — PER PHASE, since the lens phase is vocab-readout-bound while
+    # decode/NLL scale like plain forwards (MFU assumed to carry over; both
+    # are MXU-matmul-dominated).
+    from taboo_brittleness_tpu.models import gemma2 as gemma2_mod
+
+    f_bench = _phase_flops(cfg, prompts_per_word, prompt_len, new_tokens,
+                           sae.w_enc.shape[1])
+    f_9b = _phase_flops(gemma2_mod.PRESETS["gemma2_9b"], prompts_per_word,
+                        prompt_len, new_tokens, sae.w_enc.shape[1])
+    phase_ratio = {k: f_9b[k] / f_bench[k] for k in f_bench}
+    launch_seconds_9b = sum(
+        phase_seconds[k] * phase_ratio[k] for k in phase_seconds)
+    arm_seconds_9b = launch_seconds_9b / arms_per_launch
+    word_seconds_9b = (cells_per_word * arms_per_cell + 1) * arm_seconds_9b
+    hours_9b_1chip = n_words * word_seconds_9b / 3600.0
+    # v5e-8: the (word x cell x arm) grid is embarrassingly data-parallel; the
+    # 9B itself needs tp=4 within the slice (proven in __graft_entry__), so
+    # dp=2 x tp=4 — ideal scaling over 8 chips is the extrapolation.
+    hours_9b_v5e8 = hours_9b_1chip / 8.0
+
+    return {
+        "rows_per_launch": rows,
+        "arms_per_launch": arms_per_launch,
+        "prompts_per_word": prompts_per_word,
+        "reps": reps,
+        "phase_seconds_per_launch": phase_seconds,
+        "arm_seconds": round(arm_seconds, 4),
+        "cell_seconds_11_arms": round(cell_seconds, 3),
+        "word_seconds_10_cells_plus_baseline": round(word_seconds, 2),
+        "projected_full_sweep_hours_1chip_bench_shape": round(study_hours_1chip, 3),
+        "flops_ratio_9b_over_bench_shape_per_phase": {
+            k: round(v, 2) for k, v in phase_ratio.items()},
+        "projected_full_sweep_hours_1chip_9b": round(hours_9b_1chip, 3),
+        "projected_full_sweep_hours_v5e8_9b": round(hours_9b_v5e8, 3),
+        "assumptions": "steady-state (compile amortized; 3 programs total for "
+                       "the whole study), checkpoint load/host IO excluded, "
+                       "9B scaled by per-phase analytic matmul FLOPs at equal "
+                       "MFU, v5e-8 = ideal dp=2 x tp=4 scaling",
+    }
 
 
 def main() -> int:
@@ -151,6 +301,12 @@ def main() -> int:
         peak = PEAK_TFLOPS_BY_KIND.get(kind)
     mfu = round(tflops / peak, 4) if peak else None
 
+    sweep = None
+    if os.environ.get("BENCH_SWEEP", "1") == "1":
+        sweep = _sweep_bench(params, sae=sae, cfg=cfg, tap_layer=tap_layer,
+                             use_pallas=use_pallas, on_accel=on_accel,
+                             prompt_len=prompt_len, new_tokens=new_tokens)
+
     print(json.dumps({
         "metric": "ablation-sweep prompts/sec/chip "
                   f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
@@ -162,6 +318,10 @@ def main() -> int:
         "pallas_lens": use_pallas,
         "config": {"preset": preset, "batch": batch, "new_tokens": new_tokens,
                    "prompt_len": prompt_len, "reps": reps},
+        # North-star account (BASELINE.json: full sweep "< 1 h on v5e-8").
+        "projected_full_sweep_hours": (
+            sweep and sweep["projected_full_sweep_hours_v5e8_9b"]),
+        "sweep": sweep,
     }))
     return 0
 

@@ -194,24 +194,38 @@ def cmd_interventions(args) -> int:
     config = _load(args)
     loader = _loader(config, args, mesh=_mesh(config))
     sae = _sae(config, args.sae_npz)
-    params, cfg, tok = loader(args.word)
-    out = args.output or os.path.join(
-        "results", "interventions", f"{args.word}.json")
     manifest = _manifest(args, "interventions")
     from taboo_brittleness_tpu.runtime.manifest import maybe_profile
 
-    with maybe_profile(args.trace_dir), manifest.stage("study", word=args.word):
-        results = interventions.run_intervention_study(
-            params, cfg, tok, config, args.word, sae, output_path=out)
-    manifest.add_artifact(out)
-    block = results["ablation"]["budgets"]
-    summary = {m: {
-        "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
-        "random_drop": block[m]["random_mean"]["secret_prob_drop"],
-    } for m in block}
-    print(json.dumps(summary, indent=2))
-    print(f"study -> {out}")
-    _finish(args, manifest, os.path.dirname(out))
+    if args.word:
+        # Single word: explicit output path, one study.
+        params, cfg, tok = loader(args.word)
+        out = args.output or os.path.join(
+            "results", "interventions", f"{args.word}.json")
+        with maybe_profile(args.trace_dir), \
+                manifest.stage("study", word=args.word):
+            results = interventions.run_intervention_study(
+                params, cfg, tok, config, args.word, sae, output_path=out)
+        manifest.add_artifact(out)
+        block = results["ablation"]["budgets"]
+        summary = {m: {
+            "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
+            "random_drop": block[m]["random_mean"]["secret_prob_drop"],
+        } for m in block}
+        print(json.dumps(summary, indent=2))
+        print(f"study -> {out}")
+        out_dir = os.path.dirname(out)
+    else:
+        # Full sweep over config.words: resumable (skip-if-exists per word),
+        # next checkpoint prefetched while the current word computes.
+        out_dir = args.output or os.path.join("results", "interventions")
+        with maybe_profile(args.trace_dir), manifest.stage("study-sweep"):
+            results = interventions.run_intervention_studies(
+                config, model_loader=loader, sae=sae, output_dir=out_dir)
+        for w in results:
+            manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
+        print(f"studies ({len(results)} words) -> {out_dir}")
+    _finish(args, manifest, out_dir)
     return 0
 
 
@@ -255,9 +269,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     iv = sub.add_parser("interventions", help="targeted-vs-random sweeps")
     _common(iv)
-    iv.add_argument("--word", required=True)
+    iv.add_argument("--word", default=None,
+                    help="one word; omit to sweep all config words "
+                         "(resumable, next checkpoint prefetched)")
     iv.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
-    iv.add_argument("--output", default=None)
+    iv.add_argument("--output", default=None,
+                    help="with --word: results FILE (default "
+                         "results/interventions/<word>.json); without: "
+                         "results DIRECTORY holding one <word>.json each")
     iv.set_defaults(fn=cmd_interventions)
 
     tf = sub.add_parser("token-forcing", help="pre/postgame forcing attacks")

@@ -114,6 +114,10 @@ class ExperimentConfig:
 
     seed: int = 42
     max_new_tokens: int = 50
+    # Round padded prompt lengths up to this multiple so decode launches with
+    # different max prompt lengths (words, warm-up turns) share one compiled
+    # program.  None = exact-length padding (tiny tests).
+    pad_to_multiple: Optional[int] = 64
 
 
 @dataclass(frozen=True)
@@ -137,6 +141,9 @@ class InterventionConfig:
     # Edit only at the baseline spike positions (Execution Plan's
     # spike-localized arm) instead of every position of every forward.
     spike_masked: bool = False
+    # Max arms folded into one batched launch (None = all 1+R arms of a
+    # budget at once; lower it if the decode batch exceeds HBM on one chip).
+    arm_chunk: Optional[int] = None
 
 
 @dataclass(frozen=True)

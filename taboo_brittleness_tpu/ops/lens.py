@@ -274,6 +274,15 @@ def _pallas_auto_ok(params: Params) -> bool:
     return True
 
 
+def resolve_use_pallas(params: Params, requested: Optional[bool]) -> bool:
+    """Resolve a config's use_pallas tri-state on CONCRETE params, for callers
+    that wrap the lens pass in their own ``jax.jit``: inside the trace the
+    params are Tracers and ``_pallas_auto_ok`` must conservatively say no, so
+    the decision has to be made eagerly and threaded through as a static
+    argument (VERDICT round-2 W7)."""
+    return _pallas_auto_ok(params) if requested is None else requested
+
+
 class LensForwardResult(NamedTuple):
     tap: LensTap                       # stacked [L, B, T, ...]
     residual: Optional[jax.Array]      # [B, T, D] resid_post at tap_layer (f32)
@@ -324,6 +333,27 @@ def lens_forward(
             params, cfg, input_ids, stats_tap, tap_layer=tap_layer,
             positions=positions, attn_validity=attn_validity,
             compute_logits=compute_logits, edit_fn=edit_fn)
+
+    if tp_mesh is not None and tp_mesh.shape.get("sp", 1) > 1:
+        # Sequence-parallel (ring attention) lens path for long sequences;
+        # the per-position readout is shard-local (parallel/sp.py).  The
+        # vocab-sharded branch above wins when both axes are >1: at the
+        # reference's T≲130 the 256k-vocab readout dominates the cost.
+        from taboo_brittleness_tpu.parallel.sp import lens_forward_sp
+
+        if compute_logits:
+            raise ValueError(
+                "the sp lens path computes per-layer stats only (logits=None);"
+                " pass compute_logits=False or use the dense/tp path")
+        if use_pallas:
+            raise ValueError(
+                "the Pallas lens kernel has no sp partitioning; leave "
+                "use_pallas unset (None) with an sp>1 mesh")
+        return lens_forward_sp(
+            params, cfg, input_ids, target_ids, tp_mesh,
+            tap_layer=tap_layer, top_k=top_k, positions=positions,
+            attn_validity=attn_validity, edit_fn=edit_fn,
+            logit_softcap=logit_softcap)
 
     if use_pallas is None:
         use_pallas = _pallas_auto_ok(params)

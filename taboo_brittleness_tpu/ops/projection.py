@@ -46,9 +46,19 @@ def random_subspace(key: jax.Array, d: int, rank: int) -> jax.Array:
 
 
 def remove_subspace(x: jax.Array, u: jax.Array) -> jax.Array:
-    """x - (x @ U) U^T, applied over the last axis.  x: [..., D], u: [D, r]."""
+    """x - (x @ U) U^T, applied over the last axis.  x: [..., D], u: [D, r].
+
+    ``u`` may carry a leading batch axis ([B, D, r], aligned with ``x``'s
+    leading axis): each row gets its own subspace, so a sweep's arms fold into
+    one batched forward.  Zero-padded columns are inert (they project to 0),
+    which lets different ranks share one compiled program at max rank.
+    """
     xf = x.astype(jnp.float32)
-    proj = (xf @ u) @ u.T
+    if u.ndim == 2:
+        proj = (xf @ u) @ u.T
+    else:
+        coeff = jnp.einsum("b...d,bdr->b...r", xf, u)
+        proj = jnp.einsum("b...r,bdr->b...d", coeff, u)
     return (xf - proj).astype(x.dtype)
 
 

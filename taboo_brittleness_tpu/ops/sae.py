@@ -134,7 +134,7 @@ def top_latents(mean_acts: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
 def ablate_latents(
     sae: SAEParams,
     x: jax.Array,            # [..., D] residual
-    latent_ids: jax.Array,   # [m] int latent ids to zero (pad with -1 for none)
+    latent_ids: jax.Array,   # [m] shared or [B, m] per-row ids (pad with -1)
 ) -> jax.Array:
     """Splice: encode, zero the chosen latents, decode, and patch the residual by
     the *difference* of reconstructions.
@@ -143,13 +143,25 @@ def ablate_latents(
     raw reconstruction keeps the SAE's reconstruction error out of the edit: with
     m=0 latents the edit is exactly identity, so ablation deltas measure only the
     removed latents (the control the Execution Plan's random-ablation arm needs).
+
+    ``latent_ids`` may carry a leading batch axis ([B, m], aligned with
+    ``x``'s leading axis): each row gets its own ablation set, which is what
+    lets a whole sweep's arms (targeted + R random draws) fold into ONE
+    batched forward instead of one launch per arm.
     """
     acts = encode(sae, x)                                    # [..., S]
     S = acts.shape[-1]
     # mask[s] = True if s in latent_ids; -1 entries match nothing.
-    hit = jnp.any(
-        jnp.arange(S)[:, None] == latent_ids[None, :], axis=-1
-    )                                                         # [S]
+    if latent_ids.ndim == 1:
+        hit = jnp.any(
+            jnp.arange(S)[:, None] == latent_ids[None, :], axis=-1
+        )                                                     # [S]
+    else:
+        B = latent_ids.shape[0]
+        hit = jnp.any(
+            jnp.arange(S)[None, :, None] == latent_ids[:, None, :], axis=-1
+        )                                                     # [B, S]
+        hit = hit.reshape(B, *([1] * (x.ndim - 2)), S)        # align with acts
     ablated = jnp.where(hit, 0.0, acts)
     delta = decode(sae, ablated) - decode(sae, acts)          # [..., D]
     return (x.astype(jnp.float32) + delta).astype(x.dtype)

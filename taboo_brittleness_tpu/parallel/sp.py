@@ -16,6 +16,15 @@ Scope: teacher-forced full-sequence passes (the lens/analysis workload).  The
 KV-cache decode path stays dense (``runtime.decode``) — generation at the
 reference's ≤50-token scale has no sequence-parallel need.  Params are taken
 replicated over ``sp`` (combine with tp via the mesh's other axes upstream).
+
+``lens_forward_sp`` is the product entry point (VERDICT round-2 item 6): the
+full per-layer :class:`~taboo_brittleness_tpu.ops.lens.LensTap` statistics —
+target prob, argmax, top-k — are *position-local* (each position's lens
+readout depends only on its own residual), so they compute shard-locally on
+the ``[B/dp, T/sp]`` block with zero extra communication; only attention
+rides the ring.  ``ops.lens.lens_forward`` routes here when the mesh has
+``sp > 1`` (and no vocab sharding), which makes the sp axis reachable from
+``analyze_word_on_device`` and the CLI via ``config.mesh``.
 """
 
 from __future__ import annotations
@@ -31,6 +40,23 @@ from taboo_brittleness_tpu.parallel import mesh as meshlib
 from taboo_brittleness_tpu.parallel import ring
 
 _INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _ring_attend_factory(cfg: gemma2.Gemma2Config, pos_l: jax.Array,
+                         val_l: jax.Array):
+    """Per-shard attention closure: one ring implementation serves sliding and
+    global layers via a traced window operand."""
+
+    def ring_attend(q, k, v, layer_idx):
+        window = jnp.where(
+            cfg.is_sliding(layer_idx), cfg.sliding_window, _INT32_MAX)
+        return ring.ring_attention(
+            q, k, v, pos_l, pos_l, val_l, axis_name="sp",
+            scaling=cfg.query_pre_attn_scalar ** -0.5,
+            logit_cap=cfg.attn_logit_softcap,
+            sliding_window=window)
+
+    return ring_attend
 
 
 class SPForwardResult(NamedTuple):
@@ -64,14 +90,7 @@ def forward_sp(
         attn_validity = jnp.ones((B, T), bool)
 
     def local(p, ids_l, pos_l, val_l):
-        def ring_attend(q, k, v, layer_idx):
-            window = jnp.where(
-                cfg.is_sliding(layer_idx), cfg.sliding_window, _INT32_MAX)
-            return ring.ring_attention(
-                q, k, v, pos_l, pos_l, val_l, axis_name="sp",
-                scaling=cfg.query_pre_attn_scalar ** -0.5,
-                logit_cap=cfg.attn_logit_softcap,
-                sliding_window=window)
+        ring_attend = _ring_attend_factory(cfg, pos_l, val_l)
 
         carry = None
         if tap_layer is not None:
@@ -106,3 +125,92 @@ def forward_sp(
     residual = next(it) if tap_layer is not None else None
     return SPForwardResult(logits=logits, last_hidden=last_hidden,
                            residual=residual)
+
+
+def lens_forward_sp(
+    params: gemma2.Params,
+    cfg: gemma2.Gemma2Config,
+    input_ids: jax.Array,            # [B, T]
+    target_ids: jax.Array,           # [B]
+    mesh,
+    *,
+    tap_layer: int,
+    top_k: int = 5,
+    positions: Optional[jax.Array] = None,
+    attn_validity: Optional[jax.Array] = None,
+    edit_fn: Optional[Callable] = None,
+    logit_softcap: Optional[float] = None,
+):
+    """Sequence-parallel lens pass: per-layer :class:`LensTap` stats + the
+    tap-layer residual, batch sharded over ``dp`` and sequence over ``sp``.
+
+    The lens readout (norm → unembed → softmax → target/top-k per position)
+    is position-local, so each shard computes its own [b, T/sp] statistics
+    with no collective; ring attention is the only cross-shard op.  The
+    sequence is right-padded with invalid columns to a multiple of ``sp``
+    (masked out of attention and stripped from the outputs), so any T works.
+
+    ``edit_fn`` passes straight through to the forward; note that under sp it
+    sees the *local* [b, T/sp, D] chunk — position-masked edit state must be
+    pre-sharded by the caller (the dense path handles that case).
+
+    Returns ``ops.lens.LensForwardResult`` (logits=None), matching the dense
+    ``lens_forward`` so pipelines can switch on ``config.mesh`` alone.
+    """
+    from taboo_brittleness_tpu.ops.lens import (
+        LensForwardResult, LensTap, make_lens_tap, residual_carry_tap)
+
+    B, T = input_ids.shape
+    sp = mesh.shape["sp"]
+    dp = mesh.shape.get("dp", 1)
+    if B % dp:
+        raise ValueError(f"batch {B} not divisible by dp={dp}")
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    if attn_validity is None:
+        attn_validity = jnp.ones((B, T), bool)
+
+    pad = (-T) % sp
+    if pad:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, pad)))
+        positions = jnp.pad(positions, ((0, 0), (0, pad)))
+        attn_validity = jnp.pad(attn_validity, ((0, 0), (0, pad)))  # False
+
+    def local(p, ids_l, pos_l, val_l, tgt_l):
+        ring_attend = _ring_attend_factory(cfg, pos_l, val_l)
+        # The tap closes over the LOCAL param arg (replicated in-shard), so
+        # the unembed runs on the shard's own copy — no implicit capture of
+        # device-global arrays inside shard_map.
+        tap = make_lens_tap(p, cfg, tgt_l, top_k=top_k,
+                            logit_softcap=logit_softcap)
+        carry = residual_carry_tap(*ids_l.shape, cfg.hidden_size, tap_layer)
+        res = gemma2.forward(
+            p, cfg, ids_l, positions=pos_l, attn_validity=val_l,
+            per_layer_fn=tap, carry_tap=carry, edit_fn=edit_fn,
+            compute_logits=False, attend_fn=ring_attend)
+        return res.taps, res.carry_tap
+
+    taps, residual = meshlib.shard_map(
+        local, mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P("dp", "sp"), P("dp", "sp"), P("dp", "sp"), P("dp")),
+        out_specs=(
+            LensTap(target_prob=P(None, "dp", "sp"),
+                    argmax_id=P(None, "dp", "sp"),
+                    argmax_prob=P(None, "dp", "sp"),
+                    topk_ids=P(None, "dp", "sp", None),
+                    topk_probs=P(None, "dp", "sp", None)),
+            P("dp", "sp", None),
+        ),
+    )(params, input_ids, positions, attn_validity, target_ids)
+
+    if pad:
+        taps = LensTap(
+            target_prob=taps.target_prob[:, :, :T],
+            argmax_id=taps.argmax_id[:, :, :T],
+            argmax_prob=taps.argmax_prob[:, :, :T],
+            topk_ids=taps.topk_ids[:, :, :T],
+            topk_probs=taps.topk_probs[:, :, :T],
+        )
+        residual = residual[:, :T]
+    return LensForwardResult(tap=taps, residual=residual, logits=None)

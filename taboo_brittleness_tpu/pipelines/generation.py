@@ -65,6 +65,7 @@ def generate_for_word(
     dec, texts, prompt_ids = decode.generate(
         params, model_cfg, tok, prompts,
         max_new_tokens=config.experiment.max_new_tokens,
+        pad_to_multiple=config.experiment.pad_to_multiple,
     )
     layout = decode.response_layout(dec)
     seqs, valid, positions = layout.sequences, layout.valid, layout.positions
@@ -87,6 +88,13 @@ def generate_for_word(
             positions=jnp.asarray(positions),
             attn_validity=jnp.asarray(valid, bool),
             use_pallas=config.model.use_pallas_lens)
+        # LL-Top-k aggregation at generation time: the summary then carries the
+        # finished guesses, so `logit-lens` over a summary cache never touches
+        # the model (run_evaluation(model_loader=None) works end-to-end).
+        agg_ids, agg_probs = lens.aggregate_from_residual(
+            params, model_cfg, res.residual, jnp.asarray(seqs),
+            jnp.asarray(layout.response_mask), top_k=config.model.top_k)
+        agg_ids, agg_probs = np.asarray(agg_ids), np.asarray(agg_probs)
 
     for row, p_idx in enumerate(missing):
         # The reference traces the full output truncated before the response's
@@ -124,6 +132,8 @@ def generate_for_word(
                     "topk_probs": np.asarray(tap.topk_probs)[:, row][:, keep],
                     "residual": np.asarray(res.residual)[row][keep],              # [T, D]
                     "token_ids": np.asarray(ids, np.int32),
+                    "agg_topk_ids": agg_ids[row],                                 # [K]
+                    "agg_topk_probs": agg_probs[row],
                 },
                 {
                     "input_words": input_words,
@@ -132,6 +142,8 @@ def generate_for_word(
                     "word": word,
                     "layer_idx": layer_idx,
                     "target_token_id": int(tid),
+                    # Prompt length in the compacted (pad/stop-stripped) view.
+                    "response_start": int(valid[row][:layout.prompt_len].sum()),
                 },
             )
     return missing
@@ -147,9 +159,13 @@ def run_generation(
 ) -> Dict[str, List[int]]:
     """The reference's main loop (src/run_generation.py:132-158): per word, load
     that word's checkpoint and fill its cache cells."""
+    from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
     generated: Dict[str, List[int]] = {}
-    for word in (words if words is not None else config.words):
+    word_list = list(words if words is not None else config.words)
+    for i, word in enumerate(word_list):
         params, model_cfg, tok = model_loader(word)
+        prefetch_next(model_loader, word_list, i)  # overlap next word's IO
         generated[word] = generate_for_word(
             params, model_cfg, tok, config, word,
             processed_dir=processed_dir, parity_dump=parity_dump)

@@ -21,7 +21,14 @@ For one taboo word:
 
 Every arm of a given shape reuses ONE compiled decode program: the edit state
 (latent ids / basis) is a traced pytree (``edit_params``), not a Python
-closure — see ``runtime.decode.greedy_decode``.
+closure — see ``runtime.decode.greedy_decode``.  The measurement side follows
+the same rule (``_lens_measure`` / ``_nll_jit`` are jitted with static
+module-level edit fns), and the arms themselves *batch*: the targeted arm and
+the R random-control draws of a budget fold into the row axis (per-row latent
+ids / bases, padded to the max budget/rank with inert values), so one decode +
+one lens + one NLL launch serves the whole budget — and, because of the
+padding, every budget of the sweep shares those same three compiled programs
+(SURVEY.md §7 inversion #5: "the whole sweep as a batch").
 """
 
 from __future__ import annotations
@@ -29,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -140,6 +148,61 @@ def _teacher_forced_nll(
 _nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
 
 
+@partial(jax.jit,
+         static_argnames=("cfg", "tap_layer", "top_k", "edit_fn", "use_pallas",
+                          "want_residual"))
+def _lens_measure(
+    params: Params,
+    cfg: Gemma2Config,
+    seqs: jax.Array,          # [B, T]
+    target_ids: jax.Array,    # [B]
+    positions: jax.Array,     # [B, T]
+    valid: jax.Array,         # [B, T] bool
+    resp_mask: jax.Array,     # [B, T] bool
+    edit_params: Any,         # traced pytree (or None)
+    *,
+    tap_layer: int,
+    top_k: int,
+    edit_fn: Optional[Callable],
+    use_pallas: bool,
+    want_residual: bool = True,
+) -> Dict[str, jax.Array]:
+    """ONE compiled program for the sweep's measurement pass: edited lens
+    forward + tap-layer stats + in-graph LL-Top-k aggregation.
+
+    ``edit_fn`` is a static module-level function; all arm state rides in the
+    traced ``edit_params`` pytree, so every arm of every budget that shares
+    shapes reuses this executable (the ``greedy_decode`` recipe — VERDICT
+    round-2 W1 fixed).  ``use_pallas`` must be resolved on concrete params
+    *before* the call (``lens.resolve_use_pallas``): inside the trace the
+    auto-detection can no longer inspect placement.
+    """
+    bound = None
+    if edit_fn is not None:
+        bound = ((lambda h, i: edit_fn(h, i, edit_params))
+                 if edit_params is not None else edit_fn)
+    res = lens.lens_forward(
+        params, cfg, seqs, target_ids, tap_layer=tap_layer, top_k=top_k,
+        positions=positions, attn_validity=valid, edit_fn=bound,
+        use_pallas=use_pallas)
+    tap_prob = res.tap.target_prob[tap_layer]                  # [B, T]
+    rm = resp_mask.astype(jnp.float32)
+    agg_ids, agg_probs = lens.aggregate_from_residual(
+        params, cfg, res.residual, seqs, resp_mask, top_k=top_k)
+    return {
+        "tap_prob": tap_prob,
+        # The residual feeds the in-graph aggregation either way; exposing it
+        # as an OUTPUT pins rows*T*D f32 in HBM (~0.9 GB per 110-row launch
+        # at 9B), so the sweep path opts out and only the baseline pass —
+        # which needs it for spike scoring/PCA — keeps it.
+        "residual": res.residual if want_residual else None,
+        "row_prob_sum": jnp.sum(tap_prob * rm, axis=1),        # [B]
+        "row_resp": jnp.sum(rm, axis=1),                       # [B]
+        "agg_ids": agg_ids,                                    # [B, K]
+        "agg_probs": agg_probs,
+    }
+
+
 def prepare_word_state(
     params: Params,
     cfg: Gemma2Config,
@@ -152,22 +215,24 @@ def prepare_word_state(
     top_k = config.model.top_k
     dec, texts, prompt_ids = decode.generate(
         params, cfg, tok, list(config.prompts),
-        max_new_tokens=config.experiment.max_new_tokens)
+        max_new_tokens=config.experiment.max_new_tokens,
+        pad_to_multiple=config.experiment.pad_to_multiple)
     layout = decode.response_layout(dec)
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
     B = seqs.shape[0]
 
     tid = target_token_id(tok, word)
-    res = lens.lens_forward(
+    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
+    out = _lens_measure(
         params, cfg, jnp.asarray(seqs), jnp.full((B,), tid, jnp.int32),
-        tap_layer=layer_idx, top_k=top_k,
-        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool),
-        use_pallas=config.model.use_pallas_lens)
+        jnp.asarray(positions), jnp.asarray(valid, bool),
+        jnp.asarray(resp, bool), None,
+        tap_layer=layer_idx, top_k=top_k, edit_fn=None, use_pallas=use_pallas)
 
-    target_prob = np.asarray(res.tap.target_prob)[layer_idx]   # [B, T]
-    denom = max(int(resp.sum()), 1)
-    secret_prob = float((target_prob * resp).sum() / denom)
+    target_prob = np.asarray(out["tap_prob"])                  # [B, T]
+    secret_prob = float(np.asarray(out["row_prob_sum"]).sum()
+                        / max(float(np.asarray(out["row_resp"]).sum()), 1.0))
 
     spikes = jax.vmap(
         lambda t, m: lens.spike_positions(t, m, top_k=config.intervention.spike_top_k)
@@ -181,24 +246,19 @@ def prepare_word_state(
         params, cfg, jnp.asarray(seqs), jnp.asarray(valid, bool),
         jnp.asarray(positions), jnp.asarray(next_mask)))
 
-    guesses = _ll_guesses(params, cfg, tok, res.residual, seqs, resp, top_k)
+    guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"]))
 
     return WordState(
         word=word, target_id=int(tid),
         sequences=seqs, valid=valid, positions=positions,
-        response_mask=resp, residual=np.asarray(res.residual),
+        response_mask=resp, residual=np.asarray(out["residual"]),
         secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
         response_texts=texts, guesses=guesses,
     )
 
 
-def _ll_guesses(params, cfg, tok, residual, seqs, resp_mask, top_k) -> List[List[str]]:
-    """LL-Top-k guesses from tapped residuals (one fused jit launch — no
-    persistent [B, T, V] buffer; see lens.aggregate_from_residual)."""
-    agg_ids, _ = lens.aggregate_from_residual(
-        params, cfg, jnp.asarray(residual), jnp.asarray(seqs),
-        jnp.asarray(resp_mask), top_k=top_k)
-    return [[tok.decode([int(i)]).strip() for i in row] for row in np.asarray(agg_ids)]
+def _decode_guess_rows(tok, agg_ids: np.ndarray) -> List[List[str]]:
+    return [[tok.decode([int(i)]).strip() for i in row] for row in agg_ids]
 
 
 # ---------------------------------------------------------------------------
@@ -235,6 +295,117 @@ class ArmResult:
     guesses: List[List[str]]
 
 
+def _with_chunk_positions(ep: Any, chunk_positions) -> Any:
+    """Teacher-forced passes know the whole layout; expose its positions so
+    spike-masked edits (ep['spike_positions']) can align."""
+    if isinstance(ep, dict):
+        return {**ep, "chunk_positions": jnp.asarray(chunk_positions, jnp.int32)}
+    return ep
+
+
+# Shared-ep keys whose leading axis is the per-prompt batch (must tile by the
+# arm count when arms fold into the row axis): the spike-mask mode and the
+# explicit [B, T] position-mask mode of _at_layer.
+_PER_PROMPT_KEYS = ("spike_positions", "positions")
+
+
+def _tile_rows_ep(shared_ep: Any, per_arm: Dict[str, Any], n_arms: int,
+                  batch: int) -> Any:
+    """Build the row-axis edit_params for ``n_arms`` arms x ``batch`` prompts
+    (arm-major): per-arm arrays [A, ...] repeat to [A*B, ...]; per-prompt
+    shared arrays [B, ...] tile to [A*B, ...]; everything else (SAE weights,
+    layer index) passes through untiled."""
+    if not isinstance(shared_ep, dict):
+        return shared_ep
+    rows: Dict[str, Any] = {}
+    for k, v in shared_ep.items():
+        if k in _PER_PROMPT_KEYS:
+            arr = jnp.asarray(v)
+            rows[k] = jnp.tile(arr, (n_arms,) + (1,) * (arr.ndim - 1))
+        else:
+            rows[k] = v
+    for k, v in per_arm.items():
+        rows[k] = jnp.repeat(jnp.asarray(v), batch, axis=0)
+    return rows
+
+
+def _measure_rows(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    edit_fn: Callable,
+    rows_ep: Any,
+    n_arms: int,
+    use_pallas: bool,
+) -> List[ArmResult]:
+    """Measure ``n_arms`` arms folded into the row axis (arm-major tile of the
+    word's prompts): one batched decode, one jitted lens pass, one jitted NLL
+    pass for ALL arms — the per-arm Python loop of round 2 is gone."""
+    layer_idx = config.model.layer_idx
+    top_k = config.model.top_k
+    A, B = n_arms, state.sequences.shape[0]
+    valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
+
+    # (a) Regenerate under the edit — every arm's rows in one decode launch.
+    dec, texts, _ = decode.generate(
+        params, cfg, tok, list(config.prompts) * A,
+        max_new_tokens=config.experiment.max_new_tokens,
+        pad_to_multiple=config.experiment.pad_to_multiple,
+        edit_fn=edit_fn, edit_params=rows_ep)
+    layout = decode.response_layout(dec)
+    seqs, valid, positions, resp = (layout.sequences, layout.valid,
+                                    layout.positions, layout.response_mask)
+    rows = seqs.shape[0]
+
+    # (b) Lens under the edit (edited forward, edited residuals) — one
+    # compiled program shared by every arm/budget of the sweep.
+    out = _lens_measure(
+        params, cfg, jnp.asarray(seqs),
+        jnp.full((rows,), state.target_id, jnp.int32),
+        jnp.asarray(positions), jnp.asarray(valid, bool),
+        jnp.asarray(resp, bool),
+        _with_chunk_positions(rows_ep, positions),
+        tap_layer=layer_idx, top_k=top_k, edit_fn=edit_fn,
+        use_pallas=use_pallas, want_residual=False)
+
+    # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
+    next_mask = np.zeros_like(state.response_mask)
+    next_mask[:, :-1] = state.response_mask[:, 1:]
+    base_pos = np.tile(state.positions, (A, 1))
+    edited_nll = np.asarray(_nll_jit(
+        params, cfg, jnp.asarray(np.tile(state.sequences, (A, 1))),
+        jnp.asarray(np.tile(state.valid, (A, 1)), bool), jnp.asarray(base_pos),
+        jnp.asarray(np.tile(next_mask, (A, 1))), edit_fn=edit_fn,
+        edit_params=_with_chunk_positions(rows_ep, base_pos)))
+
+    row_prob_sum = np.asarray(out["row_prob_sum"])
+    row_resp = np.asarray(out["row_resp"])
+    agg_ids = np.asarray(out["agg_ids"])
+    n_resp = max(int(next_mask.sum()), 1)
+
+    results: List[ArmResult] = []
+    for a in range(A):
+        sl = slice(a * B, (a + 1) * B)
+        guesses = _decode_guess_rows(tok, agg_ids[sl])
+        secret_prob = float(row_prob_sum[sl].sum()
+                            / max(float(row_resp[sl].sum()), 1.0))
+        dnll = float((edited_nll[sl] - state.baseline_nll).sum() / n_resp)
+        m = metrics_mod.calculate_metrics(
+            {state.word: guesses}, [state.word], config.word_plurals)
+        results.append(ArmResult(
+            secret_prob=secret_prob,
+            secret_prob_drop=state.secret_prob - secret_prob,
+            delta_nll=dnll,
+            leak_rate=metrics_mod.leak_rate(texts[sl], valid_forms),
+            prompt_accuracy=m[state.word]["prompt_accuracy"],
+            any_pass=m[state.word]["any_pass"],
+            guesses=guesses,
+        ))
+    return results
+
+
 def measure_arm(
     params: Params,
     cfg: Gemma2Config,
@@ -244,66 +415,56 @@ def measure_arm(
     edit_fn: Callable,
     edit_params: Any,
 ) -> ArmResult:
-    """Run the edited model over the word's prompts and score the edit."""
-    layer_idx = config.model.layer_idx
-    top_k = config.model.top_k
-    valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
+    """Run ONE edited arm over the word's prompts and score the edit (the
+    single-arm view of ``_measure_rows``; sweeps batch arms instead)."""
+    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
+    return _measure_rows(params, cfg, tok, config, state, edit_fn,
+                         edit_params, 1, use_pallas)[0]
 
-    # (a) Regenerate under the edit.
-    dec, texts, _ = decode.generate(
-        params, cfg, tok, list(config.prompts),
-        max_new_tokens=config.experiment.max_new_tokens,
-        edit_fn=edit_fn, edit_params=edit_params)
-    layout = decode.response_layout(dec)
-    seqs, valid, positions, resp = (layout.sequences, layout.valid,
-                                    layout.positions, layout.response_mask)
-    B = seqs.shape[0]
 
-    def _ep_with_positions(chunk_positions):
-        """Teacher-forced passes know the whole layout; expose its positions
-        so spike-masked edits (ep['spike_positions']) can align."""
-        if isinstance(edit_params, dict):
-            return {**edit_params,
-                    "chunk_positions": jnp.asarray(chunk_positions, jnp.int32)}
-        return edit_params
+def measure_arms(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    edit_fn: Callable,
+    shared_ep: Dict[str, Any],
+    per_arm: Dict[str, Any],
+    *,
+    arm_chunk: Optional[int] = None,
+) -> List[ArmResult]:
+    """Measure a stack of arms sharing ``edit_fn`` in as few launches as
+    possible.
 
-    # (b) Lens under the edit (edited forward, edited residuals).
-    bound = lambda h, i: edit_fn(h, i, _ep_with_positions(positions))
-    res = lens.lens_forward(
-        params, cfg, jnp.asarray(seqs),
-        jnp.full((B,), state.target_id, jnp.int32),
-        tap_layer=layer_idx, top_k=top_k,
-        positions=jnp.asarray(positions), attn_validity=jnp.asarray(valid, bool),
-        edit_fn=bound, use_pallas=config.model.use_pallas_lens)
-    target_prob = np.asarray(res.tap.target_prob)[layer_idx]
-    denom = max(int(resp.sum()), 1)
-    secret_prob = float((target_prob * resp).sum() / denom)
+    ``per_arm`` holds the arm-varying arrays with a leading arm axis (e.g.
+    ``latent_ids`` [A, m] or ``basis`` [A, D, r]); ``shared_ep`` holds the
+    rest (SAE weights, layer, spike positions).  Arms fold into the row axis
+    in chunks of ``arm_chunk`` (default: all A at once) to bound the decode
+    batch; at 9B with B=10 prompts, 11 arms = 110 rows ≈ 3 GB of KV cache —
+    fine under tp sharding, chunk on a single chip if HBM is tight.
+    """
+    A = int(next(iter(per_arm.values())).shape[0])
+    B = state.sequences.shape[0]
+    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
+    chunk = arm_chunk or getattr(config.intervention, "arm_chunk", None) or A
 
-    guesses = _ll_guesses(params, cfg, tok, res.residual, seqs, resp, top_k)
-
-    # (c) ΔNLL: the *baseline* continuation re-scored under the edited model.
-    next_mask = np.zeros_like(state.response_mask)
-    next_mask[:, :-1] = state.response_mask[:, 1:]
-    edited_nll = np.asarray(_nll_jit(
-        params, cfg, jnp.asarray(state.sequences),
-        jnp.asarray(state.valid, bool), jnp.asarray(state.positions),
-        jnp.asarray(next_mask), edit_fn=edit_fn,
-        edit_params=_ep_with_positions(state.positions)))
-    n_resp = max(int(next_mask.sum()), 1)
-    dnll = float((edited_nll - state.baseline_nll).sum() / n_resp)
-
-    preds = {state.word: guesses}
-    m = metrics_mod.calculate_metrics(preds, [state.word], config.word_plurals)
-
-    return ArmResult(
-        secret_prob=secret_prob,
-        secret_prob_drop=state.secret_prob - secret_prob,
-        delta_nll=dnll,
-        leak_rate=metrics_mod.leak_rate(texts, valid_forms),
-        prompt_accuracy=m[state.word]["prompt_accuracy"],
-        any_pass=m[state.word]["any_pass"],
-        guesses=guesses,
-    )
+    results: List[ArmResult] = []
+    for s in range(0, A, chunk):
+        pa = {k: jnp.asarray(v)[s:s + chunk] for k, v in per_arm.items()}
+        a = int(next(iter(pa.values())).shape[0])
+        # Pad a ragged final chunk back to `chunk` (repeating the last arm)
+        # so the row count — and therefore the compiled programs — never
+        # changes across chunks; the duplicate arms' results are discarded.
+        pad = chunk - a if A > chunk else 0
+        if pad:
+            pa = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
+                  for k, v in pa.items()}
+        rows_ep = _tile_rows_ep(shared_ep, pa, a + pad, B)
+        results.extend(_measure_rows(
+            params, cfg, tok, config, state, edit_fn, rows_ep, a + pad,
+            use_pallas)[:a])
+    return results
 
 
 # ---------------------------------------------------------------------------
@@ -338,21 +499,26 @@ def run_ablation_sweep(
     S = scores.shape[0]
     rng = np.random.default_rng(config.experiment.seed if seed is None else seed)
     extra = _spike_mask_extra(config, state)
+    shared = {"sae": sae, "layer": config.model.layer_idx, **extra}
+
+    # Pad every budget's id lists to the max budget with -1 (inert in
+    # ablate_latents), so EVERY budget's launch shares one compiled program.
+    mmax = max(config.intervention.budgets)
+
+    def pad_ids(ids) -> np.ndarray:
+        row = np.full((mmax,), -1, np.int64)
+        row[:len(ids)] = ids
+        return row
 
     out: Dict[str, Any] = {"word": state.word, "budgets": {}}
     for m in config.intervention.budgets:
-        targeted_ids = jnp.asarray(order[:m], jnp.int32)
-        ep = {"sae": sae, "latent_ids": targeted_ids,
-              "layer": config.model.layer_idx, **extra}
-        targeted = measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep)
-
-        randoms: List[ArmResult] = []
+        arm_ids = [pad_ids(order[:m])]
         for _ in range(config.intervention.random_trials):
-            rand_ids = jnp.asarray(rng.choice(S, size=m, replace=False), jnp.int32)
-            ep_r = {"sae": sae, "latent_ids": rand_ids,
-                    "layer": config.model.layer_idx, **extra}
-            randoms.append(
-                measure_arm(params, cfg, tok, config, state, sae_ablation_edit, ep_r))
+            arm_ids.append(pad_ids(rng.choice(S, size=m, replace=False)))
+        per_arm = {"latent_ids": jnp.asarray(np.stack(arm_ids), jnp.int32)}
+        arms = measure_arms(params, cfg, tok, config, state,
+                            sae_ablation_edit, shared, per_arm)
+        targeted, randoms = arms[0], arms[1:]
 
         out["budgets"][str(m)] = {
             "targeted": dataclasses.asdict(targeted),
@@ -380,19 +546,24 @@ def run_projection_sweep(
     u_full, _ = projection.principal_subspace(jnp.asarray(spikes), rank=max_rank)
 
     extra = _spike_mask_extra(config, state)
+    shared = {"layer": config.model.layer_idx, **extra}
+    D = spikes.shape[1]
+
+    # Zero-padded columns are inert in remove_subspace, so every rank's launch
+    # shares one compiled program at max rank.
+    def pad_cols(u) -> jnp.ndarray:
+        return jnp.pad(u, ((0, 0), (0, max_rank - u.shape[1])))
+
     out: Dict[str, Any] = {"word": state.word, "ranks": {}}
     for r_i, r in enumerate(config.intervention.ranks):
-        basis = u_full[:, :r]
-        ep = {"basis": basis, "layer": config.model.layer_idx, **extra}
-        targeted = measure_arm(params, cfg, tok, config, state, projection_edit, ep)
-
-        randoms: List[ArmResult] = []
+        bases = [pad_cols(u_full[:, :r])]
         for t in range(config.intervention.random_trials):
             key = jax.random.PRNGKey(rng_seed * 1000 + r_i * 100 + t)
-            rand_basis = projection.random_subspace(key, spikes.shape[1], r)
-            ep_r = {"basis": rand_basis, "layer": config.model.layer_idx, **extra}
-            randoms.append(
-                measure_arm(params, cfg, tok, config, state, projection_edit, ep_r))
+            bases.append(pad_cols(projection.random_subspace(key, D, r)))
+        per_arm = {"basis": jnp.stack(bases)}                 # [A, D, rmax]
+        arms = measure_arms(params, cfg, tok, config, state,
+                            projection_edit, shared, per_arm)
+        targeted, randoms = arms[0], arms[1:]
 
         out["ranks"][str(r)] = {
             "targeted": dataclasses.asdict(targeted),
@@ -433,7 +604,58 @@ def run_intervention_study(
         "projection": run_projection_sweep(params, cfg, tok, config, state),
     }
     if output_path:
-        os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
-        with open(output_path, "w") as f:
-            json.dump(results, f, indent=2)
+        _atomic_json_dump(results, output_path)
     return results
+
+
+def _atomic_json_dump(obj: Any, path: str) -> None:
+    """Write-then-rename so a crash mid-write never leaves a truncated file:
+    the skip-if-exists resume logic treats existence as a completion marker."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
+def run_intervention_studies(
+    config: Config,
+    *,
+    model_loader: Callable,
+    sae: sae_ops.SAEParams,
+    words: Optional[Sequence[str]] = None,
+    output_dir: str = os.path.join("results", "interventions"),
+    force: bool = False,
+) -> Dict[str, Any]:
+    """The full 20-word study: per word, load that word's checkpoint and run
+    both sweeps, prefetching the NEXT word's checkpoint on a host thread while
+    the current word computes (runtime.checkpoints.prefetch_next).
+
+    Resumable the same way the generation cache is: a word whose results JSON
+    already exists is skipped (delete it or pass ``force`` to redo), so a
+    crashed sweep restarts where it stopped.
+    """
+    words = list(words if words is not None else config.words)
+
+    def done(w: str) -> bool:
+        return not force and os.path.exists(os.path.join(output_dir, f"{w}.json"))
+
+    out: Dict[str, Any] = {}
+    for i, word in enumerate(words):
+        path = os.path.join(output_dir, f"{word}.json")
+        if done(word):
+            with open(path) as f:
+                out[word] = json.load(f)
+            continue
+        params, cfg, tok = model_loader(word)
+        # Overlap the next word's checkpoint IO with this word's compute —
+        # but only a word that will actually RUN: prefetching a to-be-skipped
+        # word would pin its params in the loader's pending slot forever.
+        todo = [w for w in words[i + 1:] if not done(w)]
+        if todo:
+            fn = getattr(model_loader, "prefetch", None)
+            if fn is not None:
+                fn(todo[0])
+        out[word] = run_intervention_study(
+            params, cfg, tok, config, word, sae, output_path=path)
+    return out

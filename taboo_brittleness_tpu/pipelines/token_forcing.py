@@ -35,10 +35,17 @@ def _decode_rendered(
     params: Params, cfg: Gemma2Config, tok: TokenizerLike,
     rendered: Sequence[str], *, max_new_tokens: int,
     edit_fn: Optional[Callable] = None, edit_params: Any = None,
+    pad_to_multiple: Optional[int] = None,
 ) -> List[str]:
-    """Batched greedy decode over pre-rendered prompt strings -> response texts."""
+    """Batched greedy decode over pre-rendered prompt strings -> response texts.
+
+    ``pad_to_multiple`` buckets the prompt length so the 3 warm-up turns (and
+    every word of the sweep) reuse one compiled decode program per (batch,
+    bucket) instead of retracing per exact length — the warm-up was 3 fresh
+    traces per word before (VERDICT round-2 item 7 / round-1 W7)."""
     ids = [tok.encode(r) for r in rendered]
-    padded, valid, positions = decode.pad_prompts(ids)
+    padded, valid, positions = decode.pad_prompts(
+        ids, pad_to_multiple=pad_to_multiple)
     import jax.numpy as jnp
 
     result = decode.greedy_decode(
@@ -71,7 +78,8 @@ def pregame_forcing(
     gens = _decode_rendered(
         params, cfg, tok, rendered,
         max_new_tokens=config.experiment.max_new_tokens,
-        edit_fn=edit_fn, edit_params=edit_params)
+        edit_fn=edit_fn, edit_params=edit_params,
+        pad_to_multiple=config.experiment.pad_to_multiple)
     completions = [f"{p}{g}" for p, g in zip(phrases, gens)]
     valid_forms = {f.lower() for f in config.word_plurals.get(word, [word])}
     success = metrics_mod.forcing_success(completions, valid_forms)
@@ -95,7 +103,8 @@ def postgame_forcing(
 ) -> Dict[str, Any]:
     """Warm-up dialogue first (model actually answers each hint turn), then the
     final adversarial turn with each forcing prefill, batched."""
-    kw = dict(edit_fn=edit_fn, edit_params=edit_params)
+    kw = dict(edit_fn=edit_fn, edit_params=edit_params,
+              pad_to_multiple=config.experiment.pad_to_multiple)
     mnt = config.experiment.max_new_tokens
 
     # Warm-up: 3 sequential turns, each one batched decode of a single row.
@@ -142,10 +151,13 @@ def run_token_forcing(
     (ablated / projected model) — the Execution Plan measures forcing success
     per arm, so the driver composes this with the intervention sweeps.
     """
+    from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
     words = list(words if words is not None else config.words)
     results: Dict[str, Any] = {w: {} for w in words}
-    for word in words:
+    for i, word in enumerate(words):
         params, cfg, tok = model_loader(word)
+        prefetch_next(model_loader, words, i)  # overlap next word's IO
         if "pregame" in modes:
             results[word]["pregame"] = pregame_forcing(
                 params, cfg, tok, config, word,

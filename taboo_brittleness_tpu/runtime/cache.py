@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -148,8 +148,16 @@ def save_summary(path: str, summary: Dict[str, np.ndarray], meta: Dict[str, Any]
     native_io.save_npz(path, arrays)
 
 
-def load_summary(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+def load_summary(
+    path: str, keys: Optional[Sequence[str]] = None,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load a summary; ``keys`` restricts decompression to the named arrays
+    (np.load is lazy per member, so unrequested tensors — e.g. the [T, D]
+    residual when only the [K] guesses are wanted — are never inflated)."""
     with np.load(path) as data:
         meta = json.loads(bytes(data["__meta__"]).decode()) if "__meta__" in data else {}
-        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+        names = [k for k in data.files if k != "__meta__"]
+        if keys is not None:
+            names = [k for k in names if k in keys]
+        arrays = {k: data[k] for k in names}
     return arrays, meta

@@ -21,8 +21,9 @@ src/utils.py; here eviction is explicit).
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 from taboo_brittleness_tpu.config import Config, ModelConfig
 from taboo_brittleness_tpu.models import gemma2
@@ -68,14 +69,13 @@ class CheckpointManager:
         self.capacity = max(1, capacity)
         self.mesh = mesh  # when set, params are placed per parallel.mesh policy
         self._cache: "OrderedDict[str, Tuple]" = OrderedDict()
+        self._pending: Dict[str, threading.Thread] = {}
+        self._pending_results: Dict[str, Tuple] = {}
 
     def repo_id(self, word: str) -> str:
         return self.model_cfg.checkpoint_template.format(word=word)
 
-    def load(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
-        if word in self._cache:
-            self._cache.move_to_end(word)
-            return self._cache[word]
+    def _load_triple(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
         snap = resolve_snapshot_dir(self.repo_id(word), self.checkpoint_root)
         cfg = infer_config_from_hf_config_json(
             snap, dtype=self.model_cfg.dtype, param_dtype=self.model_cfg.param_dtype)
@@ -85,7 +85,44 @@ class CheckpointManager:
 
             params = meshlib.shard_params(params, cfg, self.mesh)
         tok = HFTokenizer.from_pretrained(snap)
-        self._cache[word] = (params, cfg, tok)
+        return (params, cfg, tok)
+
+    def prefetch(self, word: str) -> None:
+        """Start loading ``word``'s checkpoint on a host thread.
+
+        The safetensors streaming + tokenizer parse overlap with whatever the
+        device is computing for the CURRENT word (JAX dispatch is
+        thread-safe); the next ``load(word)`` then joins the thread instead
+        of doing the IO serially (VERDICT round-2 item 7: per-word sweep time
+        was checkpoint-load + compute back-to-back).  Errors surface at
+        ``load`` time, not in the thread.
+        """
+        if word in self._cache or word in self._pending:
+            return
+
+        def run():
+            try:
+                self._pending_results[word] = (True, self._load_triple(word))
+            except BaseException as e:  # re-raised by load()
+                self._pending_results[word] = (False, e)
+
+        t = threading.Thread(target=run, name=f"prefetch-{word}", daemon=True)
+        self._pending[word] = t
+        t.start()
+
+    def load(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
+        if word in self._cache:
+            self._cache.move_to_end(word)
+            return self._cache[word]
+        if word in self._pending:
+            self._pending.pop(word).join()
+            ok, payload = self._pending_results.pop(word)
+            if not ok:
+                raise payload
+            triple = payload
+        else:
+            triple = self._load_triple(word)
+        self._cache[word] = triple
         while len(self._cache) > self.capacity:
             # Drop oldest; its device buffers free once unreferenced (the
             # explicit analogue of the reference's clean_gpu_memory dance).
@@ -94,6 +131,15 @@ class CheckpointManager:
 
     def __call__(self, word: str):
         return self.load(word)
+
+
+def prefetch_next(model_loader, words: Sequence[str], current_index: int) -> None:
+    """Overlap the NEXT word's checkpoint load with the current word's
+    compute, when the loader supports it (plain callables are fine too)."""
+    if current_index + 1 < len(words):
+        fn = getattr(model_loader, "prefetch", None)
+        if fn is not None:
+            fn(words[current_index + 1])
 
 
 def model_loader_from_config(config: Config, **kw) -> CheckpointManager:

@@ -49,15 +49,24 @@ def pad_prompts(
     prompt_ids: Sequence[Sequence[int]],
     *,
     pad_id: int = chat.PAD_ID,
+    pad_to_multiple: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Left-pad variable-length prompts into [B, T] (ids, validity, positions).
 
     Left padding keeps every row's *last* prompt token at the same column, so
     the decode step reads ``logits[:, -1]`` uniformly — the standard batched
     autoregressive layout (vs the reference's batch-1 loop which never pads).
+
+    ``pad_to_multiple`` rounds T up to a bucket boundary: jitted programs key
+    on shapes, so bucketing makes consecutive launches with *different* max
+    prompt lengths (sweep words, token-forcing warm-up turns) reuse ONE
+    compiled decode program instead of retracing per length.  Pad columns are
+    masked out of attention, so results are unchanged.
     """
     B = len(prompt_ids)
     T = max(len(p) for p in prompt_ids)
+    if pad_to_multiple:
+        T = -(-T // pad_to_multiple) * pad_to_multiple
     ids = np.full((B, T), pad_id, np.int32)
     valid = np.zeros((B, T), bool)
     positions = np.zeros((B, T), np.int32)
@@ -223,6 +232,7 @@ def generate(
     edit_params: Any = None,
     decode_edit: bool = True,
     prefills: Optional[Sequence[Optional[str]]] = None,
+    pad_to_multiple: Optional[int] = None,
 ) -> Tuple[DecodeResult, List[str], List[List[int]]]:
     """Chat-format, tokenize, batch-decode.  Returns (result, response_texts,
     full_sequences_ids) — the response text is the *generation only* (the
@@ -241,7 +251,7 @@ def generate(
             else chat.user_prompt(p)
         )
     ids = [tok.encode(r) for r in rendered]
-    padded, valid, positions = pad_prompts(ids)
+    padded, valid, positions = pad_prompts(ids, pad_to_multiple=pad_to_multiple)
     result = greedy_decode(
         params, cfg,
         jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),

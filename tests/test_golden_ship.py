@@ -1,0 +1,96 @@
+"""ID-level golden test against the reference's committed real-model artifact
+(`/root/reference/results/ll_topk_ship.json` — produced from the actual
+`bcywinski/gemma-2-9b-it-taboo-ship` checkpoint) over the reference's committed
+cache pairs (`src/data/processed/ship/prompt_01,02.npz`).
+
+This is the last real-model oracle reachable without the 9B weights (VERDICT
+round-2 item 5): it exercises the full cached-analysis path — response-start
+detection, token→id mapping, ID-level current+previous zeroing, masked
+positional sum, top-k — at true Gemma-2 vocab scale against numbers that came
+out of the real model.
+
+Gated on the one small asset this environment lacks: the Gemma-2 tokenizer.
+Set ``TABOO_TOKENIZER_PATH`` to any directory containing the Gemma-2 tokenizer
+files (e.g. a `google/gemma-2-9b-it` or `bcywinski/gemma-2-9b-it-taboo-*`
+snapshot — see tools/fetch_and_convert.py) to enable.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.ops import lens
+from taboo_brittleness_tpu.runtime import cache as cache_io
+from taboo_brittleness_tpu.runtime import chat
+from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer, target_token_id
+
+TOK_PATH = os.environ.get("TABOO_TOKENIZER_PATH")
+REF = "/root/reference"
+GOLD = os.path.join(REF, "results", "ll_topk_ship.json")
+
+pytestmark = pytest.mark.skipif(
+    not (TOK_PATH and os.path.exists(GOLD)),
+    reason="set TABOO_TOKENIZER_PATH to a Gemma-2 tokenizer directory "
+           "(the single asset needed; see tools/fetch_and_convert.py)")
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return HFTokenizer.from_pretrained(TOK_PATH)
+
+
+@pytest.fixture(scope="module")
+def gold():
+    with open(GOLD) as f:
+        return json.load(f)
+
+
+def test_secret_id_space_mode(tok, gold):
+    """' ship' tokenizes to the artifact's secret_id (7509) — the same gate
+    tools/fetch_and_convert.py applies to a real checkpoint's tokenizer."""
+    assert target_token_id(tok, gold["secret_str"]) == gold["secret_id"]
+
+
+@pytest.mark.parametrize("p_idx", [0, 1])
+def test_id_level_topk_matches_real_model_artifact(tok, gold, p_idx):
+    """Top-10 ids from ID-level aggregation over the committed ship cache must
+    equal the artifact's guesses_by_prompt row for that prompt."""
+    npz, js = cache_io.pair_paths(
+        os.path.join(REF, "src", "data", "processed"), "ship", p_idx)
+    pair = cache_io.load_pair(npz, js, layer_idx=gold["layer"])
+
+    ids = np.asarray(tok.convert_tokens_to_ids(pair.input_words), np.int32)
+    start = chat.find_model_response_start(pair.input_words)
+    T = len(pair.input_words)
+    resp = np.arange(T) >= start
+
+    top_ids, _ = lens.aggregate_masked_sum(
+        jnp.asarray(pair.all_probs[gold["layer"]]),
+        jnp.asarray(ids), jnp.asarray(resp), top_k=gold["k"])
+    got = [int(i) for i in np.asarray(top_ids)]
+    want = gold["guesses_by_prompt"][p_idx]
+    assert got == want, (
+        f"prompt {p_idx + 1}: ID-level top-{gold['k']} diverges from the "
+        f"real-model artifact\n got: {got}\nwant: {want}")
+
+
+def test_secret_in_top10_matches_passk(tok, gold):
+    """The artifact's pass@10 (0.8) counts prompts whose top-10 contains the
+    secret id; the two committed pairs are both hits — verify our aggregation
+    reproduces that membership."""
+    for p_idx in (0, 1):
+        npz, js = cache_io.pair_paths(
+            os.path.join(REF, "src", "data", "processed"), "ship", p_idx)
+        pair = cache_io.load_pair(npz, js, layer_idx=gold["layer"])
+        ids = np.asarray(tok.convert_tokens_to_ids(pair.input_words), np.int32)
+        start = chat.find_model_response_start(pair.input_words)
+        resp = np.arange(len(ids)) >= start
+        top_ids, _ = lens.aggregate_masked_sum(
+            jnp.asarray(pair.all_probs[gold["layer"]]),
+            jnp.asarray(ids), jnp.asarray(resp), top_k=gold["k"])
+        assert (gold["secret_id"] in np.asarray(top_ids)) == (
+            gold["secret_id"] in gold["guesses_by_prompt"][p_idx])

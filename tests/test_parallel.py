@@ -284,3 +284,95 @@ def test_ring_attention_with_padding():
     got_np = np.asarray(got)[:, 2:]
     np.testing.assert_allclose(got_np, np.asarray(expected)[:, 2:],
                                atol=2e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Round-3: the sp axis is a product path (VERDICT round-2 item 6) — full
+# LensTap stats under ring attention, reachable from lens_forward via mesh.
+# ---------------------------------------------------------------------------
+
+def test_lens_forward_sp_matches_dense_lens():
+    """Per-layer lens stats computed shard-locally under dp x sp must equal
+    the dense path — including a T NOT divisible by sp (right padding)."""
+    from taboo_brittleness_tpu.ops import lens as lens_ops
+    from taboo_brittleness_tpu.parallel import sp as splib
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(6), cfg)
+    rng = np.random.default_rng(11)
+    B, T = 4, 15                       # 15 % 4 != 0 -> pads to 16
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(B, T)))
+    targets = jnp.asarray([3, 5, 7, 9], jnp.int32)
+
+    dense = lens_ops.lens_forward(params, cfg, ids, targets,
+                                  tap_layer=2, top_k=3)
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=1, sp=4))
+    got = splib.lens_forward_sp(params, cfg, ids, targets, m,
+                                tap_layer=2, top_k=3)
+
+    np.testing.assert_allclose(np.asarray(got.tap.target_prob),
+                               np.asarray(dense.tap.target_prob),
+                               atol=3e-5, rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got.tap.argmax_id),
+                                  np.asarray(dense.tap.argmax_id))
+    np.testing.assert_allclose(np.asarray(got.tap.topk_probs),
+                               np.asarray(dense.tap.topk_probs),
+                               atol=3e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.residual),
+                               np.asarray(dense.residual),
+                               atol=3e-5, rtol=1e-4)
+    assert got.tap.target_prob.shape == dense.tap.target_prob.shape
+
+
+def test_lens_forward_routes_through_sp_mesh():
+    """ops.lens.lens_forward with an sp>1 (tp=1) mesh takes the ring path and
+    agrees with the dense result — the config-selected switch pipelines use."""
+    from taboo_brittleness_tpu.ops import lens as lens_ops
+    from taboo_brittleness_tpu.runtime import decode as decode_mod
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(12), cfg)
+    rng = np.random.default_rng(13)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=n)) for n in (10, 14)]
+    padded, valid, positions = decode_mod.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray([2, 2], jnp.int32))
+    kw = dict(tap_layer=2, top_k=3, positions=jnp.asarray(positions),
+              attn_validity=jnp.asarray(valid, bool))
+
+    dense = lens_ops.lens_forward(params, cfg, *args, **kw)
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=1, sp=4))
+    got = lens_ops.lens_forward(params, cfg, *args, **kw, tp_mesh=m)
+
+    va = np.asarray(valid)
+    np.testing.assert_allclose(
+        np.asarray(got.tap.target_prob)[:, va],    # [L, B, T] -> [L, nnz]
+        np.asarray(dense.tap.target_prob)[:, va],
+        atol=3e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.residual)[va],
+                               np.asarray(dense.residual)[va],
+                               atol=3e-5, rtol=1e-4)
+
+
+def test_analyze_word_on_device_sp_mesh_matches_dense():
+    """Pipeline-level: the LL evaluation produces identical guesses whether
+    the lens pass runs dense or sequence-parallel (sp now serves the lens
+    workload end-to-end instead of being a tested-but-unreachable exhibit)."""
+    from taboo_brittleness_tpu.pipelines import logit_lens
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(14), cfg)
+    tok = WordTokenizer(["moon", "hint", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+
+    kw = dict(layer_idx=2, top_k=3, max_new_tokens=5)
+    dense = logit_lens.analyze_word_on_device(
+        params, cfg, tok, "moon", ["Give me a hint", "a hint"], **kw)
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=1, sp=4))
+    sp = logit_lens.analyze_word_on_device(
+        params, cfg, tok, "moon", ["Give me a hint", "a hint"], mesh=m, **kw)
+
+    assert sp.guesses == dense.guesses
+    assert sp.guess_ids == dense.guess_ids
+    for a, b in zip(sp.target_probs, dense.target_probs):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=1e-4)

@@ -27,6 +27,15 @@ Steps (each prints a PASS/SKIPPED/FAIL line):
    prompts and diff against its committed ``response_text`` strings
    (reference src/data/processed/<word>/prompt_*.json) — SURVEY.md §7 hard
    part #1's decode-parity gate.
+
+Partial assets unlock partial verification: the TOKENIZER ALONE (a few MB —
+any Gemma-2 snapshot's tokenizer.json/tokenizer.model, no weights needed)
+already lights up the real-model ID-level golden test.  Point
+``TABOO_TOKENIZER_PATH`` at the directory holding it and run
+``pytest tests/test_golden_ship.py``: it replays the reference's committed
+ship cache through our aggregation and compares the top-10 ids against
+``results/ll_topk_ship.json`` — numbers that came out of the actual taboo
+checkpoint.
 """
 
 from __future__ import annotations
