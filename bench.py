@@ -22,8 +22,8 @@ prompts/sec.  No faster number is published ("published": {} in BASELINE.json),
 so 0.07 prompts/sec is the reference point; vs_baseline = ours / 0.07.
 
 Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"} plus the
-north-star projection: a measured sweep *budget cell* (decode + lens + NLL for
-a launch of batched arms — the unit the intervention study repeats 10x per
+north-star projection: a measured sweep *budget cell* (decode + readout + NLL
+for a launch of batched arms — the unit the intervention study repeats 10x per
 word) extrapolated to the full 20-word study, per-phase split included, on one
 chip and on a v5e-8 dp mesh ("projected_full_sweep_hours"; BASELINE.json
 north_star is "< 1 h on v5e-8").
@@ -55,7 +55,10 @@ PEAK_TFLOPS_BY_KIND = {
 
 def _phase_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
                  sae_width: int) -> dict:
-    """Analytic matmul FLOPs per sweep phase: {"decode", "lens", "nll"}.
+    """Analytic matmul FLOPs per phase:
+    {"decode", "lens", "nll", "readout"} — "lens" is the all-layer readout
+    pass the MAIN bench still measures (decode + lens = _arm_flops); the
+    sweep projection uses decode/readout/nll, matching its measured phases.
 
     Counts what the compiled programs do, not an idealized lower bound: the
     SAE edit is lax.cond-gated to the tap layer only, decode attention spans
@@ -93,8 +96,12 @@ def _phase_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
     nll_f = toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
     nll_f += toks_lens * 2 * D * V
     nll_f += toks_lens * 2 * D * sae_width
+
+    # Readout: tap-layer stats from the decode-captured residual — one
+    # [T, V] lens readout per row, NO model forward at all.
+    readout_f = toks_lens * 2 * D * V
     return {"decode": float(decode_f), "lens": float(lens_f),
-            "nll": float(nll_f)}
+            "nll": float(nll_f), "readout": float(readout_f)}
 
 
 def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
@@ -104,11 +111,12 @@ def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
     return f["decode"] + f["lens"]
 
 
-def _sweep_bench(params, cfg, sae, tap_layer: int, use_pallas: bool,
+def _sweep_bench(params, cfg, sae, tap_layer: int,
                  on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
-    """Measure one batched-arm launch of the intervention sweep (decode + lens
-    + NLL, the three compiled programs of pipelines.interventions) and project
-    the full study's wall-clock.
+    """Measure one batched-arm launch of the intervention sweep (decode with
+    in-flight residual capture + tap-layer readout + NLL, the three compiled
+    programs of pipelines.interventions) and project the full study's
+    wall-clock.
 
     Study shape (Execution Plan / BASELINE.json): 20 words x (6 ablation
     budgets + 4 projection ranks) cells, each cell = 1 targeted + 10 random
@@ -147,8 +155,9 @@ def _sweep_bench(params, cfg, sae, tap_layer: int, use_pallas: bool,
     def decode_phase():
         dec = decode.greedy_decode(
             params, cfg, *args, max_new_tokens=new_tokens,
-            edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,))
-        jax.block_until_ready(dec.tokens)
+            edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
+            capture_residual_layer=tap_layer)
+        jax.block_until_ready((dec.tokens, dec.residual))
         state["dec"] = dec
 
     decode_phase()  # compile + capture sequences for the downstream phases
@@ -159,11 +168,9 @@ def _sweep_bench(params, cfg, sae, tap_layer: int, use_pallas: bool,
     next_mask = jnp.zeros_like(seq_valid).at[:, prompt_len - 1:-1].set(True)
     ep_l = {**ep, "chunk_positions": pos2}
 
-    def lens_phase():
-        out = iv._lens_measure(
-            params, cfg, seqs, targets, pos2, seq_valid, resp, ep_l,
-            tap_layer=tap_layer, top_k=5, edit_fn=iv.sae_ablation_edit,
-            use_pallas=use_pallas, want_residual=False)
+    def readout_phase():
+        out = iv._residual_measure(
+            params, cfg, dec.residual, seqs, resp, targets, top_k=5)
         jax.block_until_ready(out["agg_ids"])
 
     def nll_phase():
@@ -171,11 +178,11 @@ def _sweep_bench(params, cfg, sae, tap_layer: int, use_pallas: bool,
                           edit_fn=iv.sae_ablation_edit, edit_params=ep_l)
         jax.block_until_ready(nll)
 
-    lens_phase()
+    readout_phase()
     nll_phase()
 
     phase_seconds = {}
-    for name, fn in (("decode", decode_phase), ("lens", lens_phase),
+    for name, fn in (("decode", decode_phase), ("readout", readout_phase),
                      ("nll", nll_phase)):
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -304,7 +311,7 @@ def main() -> int:
     sweep = None
     if os.environ.get("BENCH_SWEEP", "1") == "1":
         sweep = _sweep_bench(params, sae=sae, cfg=cfg, tap_layer=tap_layer,
-                             use_pallas=use_pallas, on_accel=on_accel,
+                             on_accel=on_accel,
                              prompt_len=prompt_len, new_tokens=new_tokens)
 
     print(json.dumps({

@@ -192,7 +192,8 @@ def cmd_interventions(args) -> int:
     from taboo_brittleness_tpu.pipelines import interventions
 
     config = _load(args)
-    loader = _loader(config, args, mesh=_mesh(config))
+    mesh = _mesh(config)
+    loader = _loader(config, args, mesh=mesh)
     sae = _sae(config, args.sae_npz)
     manifest = _manifest(args, "interventions")
     from taboo_brittleness_tpu.runtime.manifest import maybe_profile
@@ -205,7 +206,8 @@ def cmd_interventions(args) -> int:
         with maybe_profile(args.trace_dir), \
                 manifest.stage("study", word=args.word):
             results = interventions.run_intervention_study(
-                params, cfg, tok, config, args.word, sae, output_path=out)
+                params, cfg, tok, config, args.word, sae, output_path=out,
+                mesh=mesh)
         manifest.add_artifact(out)
         block = results["ablation"]["budgets"]
         summary = {m: {
@@ -221,7 +223,8 @@ def cmd_interventions(args) -> int:
         out_dir = args.output or os.path.join("results", "interventions")
         with maybe_profile(args.trace_dir), manifest.stage("study-sweep"):
             results = interventions.run_intervention_studies(
-                config, model_loader=loader, sae=sae, output_dir=out_dir)
+                config, model_loader=loader, sae=sae, output_dir=out_dir,
+                mesh=mesh)
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
         print(f"studies ({len(results)} words) -> {out_dir}")

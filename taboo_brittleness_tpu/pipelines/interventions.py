@@ -22,7 +22,7 @@ For one taboo word:
 Every arm of a given shape reuses ONE compiled decode program: the edit state
 (latent ids / basis) is a traced pytree (``edit_params``), not a Python
 closure — see ``runtime.decode.greedy_decode``.  The measurement side follows
-the same rule (``_lens_measure`` / ``_nll_jit`` are jitted with static
+the same rule (``_residual_measure`` / ``_nll_jit`` are jitted with static
 module-level edit fns), and the arms themselves *batch*: the targeted arm and
 the R random-control draws of a budget fold into the row axis (per-row latent
 ids / bases, padded to the max budget/rank with inert values), so one decode +
@@ -148,56 +148,64 @@ def _teacher_forced_nll(
 _nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "tap_layer", "top_k", "edit_fn", "use_pallas",
-                          "want_residual"))
-def _lens_measure(
+def _dp_sharding(mesh, ndim: int, rows: int):
+    """NamedSharding placing the leading (row) axis over the mesh's dp axis,
+    or None when there is no mesh / dp does not divide the rows.  Placing the
+    batch is all SPMD needs: params are already placed by the checkpoint
+    loader, and jit propagates shardings through the compiled programs."""
+    if mesh is None:
+        return None
+    dp = mesh.shape.get("dp", 1)
+    if dp <= 1 or rows % dp:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
+
+
+def _place_rows(x, mesh):
+    arr = jnp.asarray(x)
+    sh = _dp_sharding(mesh, arr.ndim, arr.shape[0])
+    return arr if sh is None else jax.device_put(arr, sh)
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k"))
+def _residual_measure(
     params: Params,
     cfg: Gemma2Config,
+    residual: jax.Array,      # [B, T, D] decode-captured resid at the tap layer
     seqs: jax.Array,          # [B, T]
-    target_ids: jax.Array,    # [B]
-    positions: jax.Array,     # [B, T]
-    valid: jax.Array,         # [B, T] bool
     resp_mask: jax.Array,     # [B, T] bool
-    edit_params: Any,         # traced pytree (or None)
+    target_ids: jax.Array,    # [B]
     *,
-    tap_layer: int,
     top_k: int,
-    edit_fn: Optional[Callable],
-    use_pallas: bool,
-    want_residual: bool = True,
 ) -> Dict[str, jax.Array]:
-    """ONE compiled program for the sweep's measurement pass: edited lens
-    forward + tap-layer stats + in-graph LL-Top-k aggregation.
+    """Tap-layer statistics + in-graph LL-Top-k aggregation straight from the
+    residual that ``greedy_decode(capture_residual_layer=...)`` captured.
 
-    ``edit_fn`` is a static module-level function; all arm state rides in the
-    traced ``edit_params`` pytree, so every arm of every budget that shares
-    shapes reuses this executable (the ``greedy_decode`` recipe — VERDICT
-    round-2 W1 fixed).  ``use_pallas`` must be resolved on concrete params
-    *before* the call (``lens.resolve_use_pallas``): inside the trace the
-    auto-detection can no longer inspect placement.
+    This replaces the sweep's second full-model lens pass entirely: the
+    decode already ran the (edited) forward over every position, and the
+    sweep consumes only the tap layer — so the measurement left to do is one
+    [T, V] lens readout per row (norm → unembed → softmax → target/top-k),
+    ~1/42nd of the all-layer readout, with zero extra model FLOPs.  vmapped
+    per row inside ONE jitted program so no persistent [B, T, V] buffer
+    exists (same fusion argument as lens.aggregate_from_residual).
     """
-    bound = None
-    if edit_fn is not None:
-        bound = ((lambda h, i: edit_fn(h, i, edit_params))
-                 if edit_params is not None else edit_fn)
-    res = lens.lens_forward(
-        params, cfg, seqs, target_ids, tap_layer=tap_layer, top_k=top_k,
-        positions=positions, attn_validity=valid, edit_fn=bound,
-        use_pallas=use_pallas)
-    tap_prob = res.tap.target_prob[tap_layer]                  # [B, T]
-    rm = resp_mask.astype(jnp.float32)
-    agg_ids, agg_probs = lens.aggregate_from_residual(
-        params, cfg, res.residual, seqs, resp_mask, top_k=top_k)
+
+    def one(h, ids, m, tgt):
+        probs = lens.lens_probs(params, cfg, h[None])[0]       # [T, V] f32
+        tgt_p = probs[:, tgt]                                  # [T]
+        rm = m.astype(jnp.float32)
+        agg_ids, agg_probs = lens.aggregate_masked_sum(
+            probs, ids, m, top_k=top_k)
+        return tgt_p, jnp.sum(tgt_p * rm), jnp.sum(rm), agg_ids, agg_probs
+
+    tap_prob, row_sum, row_cnt, agg_ids, agg_probs = jax.vmap(one)(
+        residual, seqs, resp_mask, target_ids)
     return {
-        "tap_prob": tap_prob,
-        # The residual feeds the in-graph aggregation either way; exposing it
-        # as an OUTPUT pins rows*T*D f32 in HBM (~0.9 GB per 110-row launch
-        # at 9B), so the sweep path opts out and only the baseline pass —
-        # which needs it for spike scoring/PCA — keeps it.
-        "residual": res.residual if want_residual else None,
-        "row_prob_sum": jnp.sum(tap_prob * rm, axis=1),        # [B]
-        "row_resp": jnp.sum(rm, axis=1),                       # [B]
+        "tap_prob": tap_prob,                                  # [B, T]
+        "row_prob_sum": row_sum,                               # [B]
+        "row_resp": row_cnt,                                   # [B]
         "agg_ids": agg_ids,                                    # [B, K]
         "agg_probs": agg_probs,
     }
@@ -209,6 +217,8 @@ def prepare_word_state(
     tok: TokenizerLike,
     config: Config,
     word: str,
+    *,
+    mesh: Any = None,
 ) -> WordState:
     """Baseline (unedited) pass over all hint prompts of one word."""
     layer_idx = config.model.layer_idx
@@ -216,19 +226,19 @@ def prepare_word_state(
     dec, texts, prompt_ids = decode.generate(
         params, cfg, tok, list(config.prompts),
         max_new_tokens=config.experiment.max_new_tokens,
-        pad_to_multiple=config.experiment.pad_to_multiple)
+        pad_to_multiple=config.experiment.pad_to_multiple,
+        capture_residual_layer=layer_idx,
+        input_sharding=_dp_sharding(mesh, 2, len(config.prompts)))
     layout = decode.response_layout(dec)
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
     B = seqs.shape[0]
 
     tid = target_token_id(tok, word)
-    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
-    out = _lens_measure(
-        params, cfg, jnp.asarray(seqs), jnp.full((B,), tid, jnp.int32),
-        jnp.asarray(positions), jnp.asarray(valid, bool),
-        jnp.asarray(resp, bool), None,
-        tap_layer=layer_idx, top_k=top_k, edit_fn=None, use_pallas=use_pallas)
+    out = _residual_measure(
+        params, cfg, dec.residual, _place_rows(seqs, mesh),
+        _place_rows(resp.astype(bool), mesh),
+        _place_rows(np.full((B,), tid, np.int32), mesh), top_k=top_k)
 
     target_prob = np.asarray(out["tap_prob"])                  # [B, T]
     secret_prob = float(np.asarray(out["row_prob_sum"]).sum()
@@ -243,15 +253,16 @@ def prepare_word_state(
     next_mask = np.zeros_like(resp)
     next_mask[:, :-1] = resp[:, 1:]
     nll = np.asarray(_nll_jit(
-        params, cfg, jnp.asarray(seqs), jnp.asarray(valid, bool),
-        jnp.asarray(positions), jnp.asarray(next_mask)))
+        params, cfg, _place_rows(seqs, mesh),
+        _place_rows(valid.astype(bool), mesh),
+        _place_rows(positions, mesh), _place_rows(next_mask, mesh)))
 
     guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"]))
 
     return WordState(
         word=word, target_id=int(tid),
         sequences=seqs, valid=valid, positions=positions,
-        response_mask=resp, residual=np.asarray(out["residual"]),
+        response_mask=resp, residual=np.asarray(dec.residual),
         secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
         response_texts=texts, guesses=guesses,
     )
@@ -338,46 +349,56 @@ def _measure_rows(
     edit_fn: Callable,
     rows_ep: Any,
     n_arms: int,
-    use_pallas: bool,
+    mesh: Any = None,
 ) -> List[ArmResult]:
     """Measure ``n_arms`` arms folded into the row axis (arm-major tile of the
-    word's prompts): one batched decode, one jitted lens pass, one jitted NLL
-    pass for ALL arms — the per-arm Python loop of round 2 is gone."""
+    word's prompts): one batched decode (which captures the tap-layer
+    residual as it runs), one jitted readout, one jitted NLL pass for ALL
+    arms — neither the per-arm Python loop of round 2 nor the full-model
+    lens re-run of early round 3 remains."""
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     A, B = n_arms, state.sequences.shape[0]
     valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
 
-    # (a) Regenerate under the edit — every arm's rows in one decode launch.
+    # (a) Regenerate under the edit — every arm's rows in one decode launch;
+    # the tap-layer residual (post-edit) rides out on the decode's carry tap.
     dec, texts, _ = decode.generate(
         params, cfg, tok, list(config.prompts) * A,
         max_new_tokens=config.experiment.max_new_tokens,
         pad_to_multiple=config.experiment.pad_to_multiple,
-        edit_fn=edit_fn, edit_params=rows_ep)
+        edit_fn=edit_fn,
+        edit_params=jax.tree_util.tree_map(
+            lambda v: _place_rows(v, mesh)
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == A * B else v,
+            rows_ep),
+        capture_residual_layer=layer_idx,
+        input_sharding=_dp_sharding(mesh, 2, A * B))
     layout = decode.response_layout(dec)
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
     rows = seqs.shape[0]
 
-    # (b) Lens under the edit (edited forward, edited residuals) — one
-    # compiled program shared by every arm/budget of the sweep.
-    out = _lens_measure(
-        params, cfg, jnp.asarray(seqs),
-        jnp.full((rows,), state.target_id, jnp.int32),
-        jnp.asarray(positions), jnp.asarray(valid, bool),
-        jnp.asarray(resp, bool),
-        _with_chunk_positions(rows_ep, positions),
-        tap_layer=layer_idx, top_k=top_k, edit_fn=edit_fn,
-        use_pallas=use_pallas, want_residual=False)
+    # (b) Tap-layer readout from the captured residual — one [T, V] readout
+    # per row, shared by every arm/budget of the sweep (no model FLOPs).
+    out = _residual_measure(
+        params, cfg, dec.residual, _place_rows(seqs, mesh),
+        _place_rows(resp.astype(bool), mesh),
+        _place_rows(np.full((rows,), state.target_id, np.int32), mesh),
+        top_k=top_k)
+    # The readout is dispatched; drop the [rows, T, D] f32 residual reference
+    # so its ~0.9 GB (110 rows at 9B) frees before the NLL forward peaks.
+    dec = dec._replace(residual=None)
 
     # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
     next_mask = np.zeros_like(state.response_mask)
     next_mask[:, :-1] = state.response_mask[:, 1:]
     base_pos = np.tile(state.positions, (A, 1))
     edited_nll = np.asarray(_nll_jit(
-        params, cfg, jnp.asarray(np.tile(state.sequences, (A, 1))),
-        jnp.asarray(np.tile(state.valid, (A, 1)), bool), jnp.asarray(base_pos),
-        jnp.asarray(np.tile(next_mask, (A, 1))), edit_fn=edit_fn,
+        params, cfg, _place_rows(np.tile(state.sequences, (A, 1)), mesh),
+        _place_rows(np.tile(state.valid, (A, 1)).astype(bool), mesh),
+        _place_rows(base_pos, mesh),
+        _place_rows(np.tile(next_mask, (A, 1)), mesh), edit_fn=edit_fn,
         edit_params=_with_chunk_positions(rows_ep, base_pos)))
 
     row_prob_sum = np.asarray(out["row_prob_sum"])
@@ -414,12 +435,13 @@ def measure_arm(
     state: WordState,
     edit_fn: Callable,
     edit_params: Any,
+    *,
+    mesh: Any = None,
 ) -> ArmResult:
     """Run ONE edited arm over the word's prompts and score the edit (the
     single-arm view of ``_measure_rows``; sweeps batch arms instead)."""
-    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
     return _measure_rows(params, cfg, tok, config, state, edit_fn,
-                         edit_params, 1, use_pallas)[0]
+                         edit_params, 1, mesh)[0]
 
 
 def measure_arms(
@@ -433,6 +455,7 @@ def measure_arms(
     per_arm: Dict[str, Any],
     *,
     arm_chunk: Optional[int] = None,
+    mesh: Any = None,
 ) -> List[ArmResult]:
     """Measure a stack of arms sharing ``edit_fn`` in as few launches as
     possible.
@@ -446,7 +469,6 @@ def measure_arms(
     """
     A = int(next(iter(per_arm.values())).shape[0])
     B = state.sequences.shape[0]
-    use_pallas = lens.resolve_use_pallas(params, config.model.use_pallas_lens)
     chunk = arm_chunk or getattr(config.intervention, "arm_chunk", None) or A
 
     results: List[ArmResult] = []
@@ -463,7 +485,7 @@ def measure_arms(
         rows_ep = _tile_rows_ep(shared_ep, pa, a + pad, B)
         results.extend(_measure_rows(
             params, cfg, tok, config, state, edit_fn, rows_ep, a + pad,
-            use_pallas)[:a])
+            mesh)[:a])
     return results
 
 
@@ -492,6 +514,7 @@ def run_ablation_sweep(
     sae: sae_ops.SAEParams,
     *,
     seed: Optional[int] = None,
+    mesh: Any = None,
 ) -> Dict[str, Any]:
     """Targeted vs random SAE-latent ablations over the budget grid."""
     scores = score_latents_for_word(state, sae, params)
@@ -517,7 +540,7 @@ def run_ablation_sweep(
             arm_ids.append(pad_ids(rng.choice(S, size=m, replace=False)))
         per_arm = {"latent_ids": jnp.asarray(np.stack(arm_ids), jnp.int32)}
         arms = measure_arms(params, cfg, tok, config, state,
-                            sae_ablation_edit, shared, per_arm)
+                            sae_ablation_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
 
         out["budgets"][str(m)] = {
@@ -536,6 +559,7 @@ def run_projection_sweep(
     state: WordState,
     *,
     seed: Optional[int] = None,
+    mesh: Any = None,
 ) -> Dict[str, Any]:
     """Low-rank removal: PCA of spike residuals vs random orthonormal bases."""
     B, K = state.spike_pos.shape
@@ -562,7 +586,7 @@ def run_projection_sweep(
             bases.append(pad_cols(projection.random_subspace(key, D, r)))
         per_arm = {"basis": jnp.stack(bases)}                 # [A, D, rmax]
         arms = measure_arms(params, cfg, tok, config, state,
-                            projection_edit, shared, per_arm)
+                            projection_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
 
         out["ranks"][str(r)] = {
@@ -590,9 +614,10 @@ def run_intervention_study(
     sae: sae_ops.SAEParams,
     *,
     output_path: Optional[str] = None,
+    mesh: Any = None,
 ) -> Dict[str, Any]:
     """Full brittleness study for one word: baseline + both sweeps."""
-    state = prepare_word_state(params, cfg, tok, config, word)
+    state = prepare_word_state(params, cfg, tok, config, word, mesh=mesh)
     results = {
         "word": word,
         "baseline": {
@@ -600,8 +625,10 @@ def run_intervention_study(
             "guesses": state.guesses,
             "response_texts": state.response_texts,
         },
-        "ablation": run_ablation_sweep(params, cfg, tok, config, state, sae),
-        "projection": run_projection_sweep(params, cfg, tok, config, state),
+        "ablation": run_ablation_sweep(params, cfg, tok, config, state, sae,
+                                       mesh=mesh),
+        "projection": run_projection_sweep(params, cfg, tok, config, state,
+                                           mesh=mesh),
     }
     if output_path:
         _atomic_json_dump(results, output_path)
@@ -626,6 +653,7 @@ def run_intervention_studies(
     words: Optional[Sequence[str]] = None,
     output_dir: str = os.path.join("results", "interventions"),
     force: bool = False,
+    mesh: Any = None,
 ) -> Dict[str, Any]:
     """The full 20-word study: per word, load that word's checkpoint and run
     both sweeps, prefetching the NEXT word's checkpoint on a host thread while
@@ -657,5 +685,5 @@ def run_intervention_studies(
             if fn is not None:
                 fn(todo[0])
         out[word] = run_intervention_study(
-            params, cfg, tok, config, word, sae, output_path=path)
+            params, cfg, tok, config, word, sae, output_path=path, mesh=mesh)
     return out

@@ -43,6 +43,10 @@ class DecodeResult(NamedTuple):
     # Full sequence view (prompt + generation), left-padded:
     sequences: jax.Array     # [B, T_prompt + N]
     sequence_valid: jax.Array  # [B, T_prompt + N] bool
+    # With capture_residual_layer: resid_post (post-edit) at that layer for
+    # EVERY sequence position, f32 — captured as the decode computes it, so
+    # the analysis needs no second full-model pass (see greedy_decode).
+    residual: Optional[jax.Array] = None   # [B, T_prompt + N, D]
 
 
 def pad_prompts(
@@ -80,7 +84,8 @@ def pad_prompts(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "edit_fn", "decode_edit", "stop_ids"),
+    static_argnames=("cfg", "max_new_tokens", "edit_fn", "decode_edit",
+                     "stop_ids", "capture_residual_layer"),
 )
 def greedy_decode(
     params: Params,
@@ -94,6 +99,7 @@ def greedy_decode(
     edit_params: Any = None,
     decode_edit: bool = True,
     stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    capture_residual_layer: Optional[int] = None,
 ) -> DecodeResult:
     """One compiled program: prefill + max_new_tokens greedy steps.
 
@@ -107,9 +113,26 @@ def greedy_decode(
     in ``edit_params``: it is a *traced* pytree, so the intervention sweep
     reuses ONE compiled program across trials/arms instead of retracing per
     closure (the recompile-per-position hazard of SURVEY.md §7 hard part #3).
+
+    ``capture_residual_layer`` captures that layer's (post-edit) resid_post
+    for every position AS THE DECODE COMPUTES IT — prefill columns from the
+    prefill's carry tap, each generated column from its step's forward.  The
+    analysis then reads the residual straight off the decode instead of
+    re-running a full teacher-forced pass over the finished sequence, which
+    halves the intervention sweep's per-arm cost (the re-run was a 42-layer
+    forward; the sweep consumes only this one layer).
     """
     B, T = prompt_ids.shape
     cache = KVCache.zeros(cfg, B, max_len=T + max_new_tokens)
+    capture = capture_residual_layer is not None
+
+    def _carry_tap(chunk: int):
+        if not capture:
+            return None
+        from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+
+        return residual_carry_tap(B, chunk, cfg.hidden_size,
+                                  capture_residual_layer)
 
     def _with_chunk_positions(ep, chunk_pos):
         """Position-aware edits (spike masking) read the current chunk's RoPE
@@ -131,6 +154,7 @@ def greedy_decode(
         attn_validity=prompt_valid,
         cache=cache,
         edit_fn=bound_edit,
+        carry_tap=_carry_tap(T),
     )
     use_step_edit = edit_fn is not None and decode_edit
 
@@ -156,14 +180,16 @@ def greedy_decode(
             attn_validity=(~done)[:, None],
             cache=cache,
             edit_fn=step_edit,
+            carry_tap=_carry_tap(1),
         )
         next_tok = jnp.argmax(res.logits[:, 0], axis=-1).astype(jnp.int32)
         next_done = done | is_stop(tok)
         next_tok = jnp.where(next_done, chat.PAD_ID, next_tok)
-        return (res.cache, next_tok, next_done, pos + 1), (tok, done)
+        step_resid = res.carry_tap if capture else jnp.zeros((), jnp.float32)
+        return (res.cache, next_tok, next_done, pos + 1), (tok, done, step_resid)
 
     done0 = jnp.zeros((B,), bool)
-    (_, _, _, _), (toks, dones) = lax.scan(
+    (_, _, _, _), (toks, dones, step_resids) = lax.scan(
         step,
         (prefill.cache, first_tok, done0, prompt_len),
         None,
@@ -176,9 +202,16 @@ def greedy_decode(
 
     sequences = jnp.concatenate([prompt_ids, tokens], axis=1)
     sequence_valid = jnp.concatenate([prompt_valid, emitted], axis=1)
+    residual = None
+    if capture:
+        # [N, B, 1, D] -> [B, N, D]; column Tp+i holds step i's input token,
+        # exactly where `sequences` puts it.
+        gen_resid = jnp.swapaxes(step_resids[:, :, 0, :], 0, 1)
+        residual = jnp.concatenate([prefill.carry_tap, gen_resid], axis=1)
     return DecodeResult(
         tokens=tokens, lengths=lengths,
         sequences=sequences, sequence_valid=sequence_valid,
+        residual=residual,
     )
 
 
@@ -233,6 +266,8 @@ def generate(
     decode_edit: bool = True,
     prefills: Optional[Sequence[Optional[str]]] = None,
     pad_to_multiple: Optional[int] = None,
+    capture_residual_layer: Optional[int] = None,
+    input_sharding: Optional[Any] = None,
 ) -> Tuple[DecodeResult, List[str], List[List[int]]]:
     """Chat-format, tokenize, batch-decode.  Returns (result, response_texts,
     full_sequences_ids) — the response text is the *generation only* (the
@@ -252,13 +287,24 @@ def generate(
         )
     ids = [tok.encode(r) for r in rendered]
     padded, valid, positions = pad_prompts(ids, pad_to_multiple=pad_to_multiple)
+
+    def place(x):
+        """With ``input_sharding`` (e.g. NamedSharding over the mesh's dp
+        axis), the batch lands sharded and the jitted decode runs SPMD —
+        the sweep-grid data parallelism of SURVEY.md §2.3."""
+        arr = jnp.asarray(x)
+        if input_sharding is None:
+            return arr
+        return jax.device_put(arr, input_sharding)
+
     result = greedy_decode(
         params, cfg,
-        jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),
+        place(padded), place(valid), place(positions),
         max_new_tokens=max_new_tokens,
         edit_fn=edit_fn,
         edit_params=edit_params,
         decode_edit=decode_edit,
+        capture_residual_layer=capture_residual_layer,
     )
     texts = decode_texts(tok, result)
     return result, texts, ids

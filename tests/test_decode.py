@@ -180,3 +180,38 @@ def test_prefetch_matches_direct_load_and_propagates_errors(monkeypatch):
     ck.prefetch_next(lambda w: None, ["a", "b"], 0)
     ck.prefetch_next(mgr, ["x", "ship"], 0)
     ck.prefetch_next(mgr, ["x"], 0)
+
+
+def test_capture_residual_matches_teacher_forced_lens():
+    """The residual captured in-flight by greedy_decode must equal the
+    teacher-forced lens pass's residual at every real (non-pad) position —
+    the invariant that lets the sweep drop its second full-model pass."""
+    from taboo_brittleness_tpu.ops import lens as lens_ops
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(23), cfg)
+    tok = WordTokenizer(["Give", "me", "a", "hint", "clue"],
+                        vocab_size=cfg.vocab_size)
+
+    dec, _, _ = decode.generate(
+        params, cfg, tok, ["Give me a hint", "a clue"], max_new_tokens=5,
+        capture_residual_layer=2)
+    assert dec.residual is not None
+    layout = decode.response_layout(dec)
+
+    ref = lens_ops.lens_forward(
+        params, cfg, jnp.asarray(layout.sequences),
+        jnp.asarray([3, 3], jnp.int32), tap_layer=2, top_k=3,
+        positions=jnp.asarray(layout.positions),
+        attn_validity=jnp.asarray(layout.valid, bool))
+
+    va = np.asarray(layout.valid)
+    np.testing.assert_allclose(np.asarray(dec.residual)[va],
+                               np.asarray(ref.residual)[va],
+                               atol=1e-4, rtol=1e-4)
+
+    # Without the flag nothing extra is carried.
+    dec2, _, _ = decode.generate(
+        params, cfg, tok, ["Give me a hint"], max_new_tokens=3)
+    assert dec2.residual is None

@@ -203,11 +203,11 @@ def test_sweep_shares_one_program_across_budgets(setup):
     params, cfg, tok, config, sae = setup
     state = iv.prepare_word_state(params, cfg, tok, config, WORD)
 
-    before = (iv._lens_measure._cache_size(),
+    before = (iv._residual_measure._cache_size(),
               iv._nll_jit._cache_size(),
               dec_mod.greedy_decode._cache_size())
     iv.run_ablation_sweep(params, cfg, tok, config, state, sae)  # budgets (1,2) R=2
-    after = (iv._lens_measure._cache_size(),
+    after = (iv._residual_measure._cache_size(),
              iv._nll_jit._cache_size(),
              dec_mod.greedy_decode._cache_size())
     deltas = tuple(a - b for a, b in zip(after, before))
@@ -215,7 +215,7 @@ def test_sweep_shares_one_program_across_budgets(setup):
 
     # A second sweep with different random draws adds ZERO new entries.
     iv.run_ablation_sweep(params, cfg, tok, config, state, sae, seed=123)
-    again = (iv._lens_measure._cache_size(),
+    again = (iv._residual_measure._cache_size(),
              iv._nll_jit._cache_size(),
              dec_mod.greedy_decode._cache_size())
     assert again == after
@@ -251,13 +251,13 @@ def test_arm_chunking_matches_full_batch(setup):
 
     full = iv.measure_arms(params, cfg, tok, config, state,
                            iv.sae_ablation_edit, shared, {"latent_ids": ids})
-    before = iv._lens_measure._cache_size()
+    before = iv._residual_measure._cache_size()
     chunked = iv.measure_arms(params, cfg, tok, config, state,
                               iv.sae_ablation_edit, shared,
                               {"latent_ids": ids}, arm_chunk=2)
     # 3 arms in chunks of 2 -> the ragged final chunk pads to 2 arms, so both
     # launches share ONE compiled program (and at most one new entry total).
-    assert iv._lens_measure._cache_size() - before <= 1
+    assert iv._residual_measure._cache_size() - before <= 1
     for f, c in zip(full, chunked):
         assert f.guesses == c.guesses
         assert f.secret_prob == pytest.approx(c.secret_prob, abs=1e-5)
@@ -359,3 +359,30 @@ def test_studies_never_prefetch_skipped_words(setup, tmp_path):
     assert prefetched == []                       # next word was done
     assert res["done_word"] == {"word": "done_word"}
     assert set(res[WORD]) == {"word", "baseline", "ablation", "projection"}
+
+
+def test_measure_arms_dp_mesh_matches_single_device(setup):
+    """Rows sharded over the mesh's dp axis must score identically to the
+    unsharded path — the sweep-grid data parallelism of SURVEY.md §2.3,
+    reachable from the pipeline (not just the dryrun)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    shared = {"sae": sae, "layer": config.model.layer_idx}
+    # 4 arms x 2 prompts = 8 rows -> divisible by dp=8.
+    ids = np.asarray([[0, -1], [3, 7], [5, -1], [2, 9]], np.int32)
+
+    plain = iv.measure_arms(params, cfg, tok, config, state,
+                            iv.sae_ablation_edit, shared, {"latent_ids": ids})
+    m = meshlib.make_mesh(MeshConfig(dp=-1, tp=1, sp=1))
+    sharded = iv.measure_arms(params, cfg, tok, config, state,
+                              iv.sae_ablation_edit, shared,
+                              {"latent_ids": ids}, mesh=m)
+    for a, b in zip(plain, sharded):
+        assert a.guesses == b.guesses
+        assert a.secret_prob == pytest.approx(b.secret_prob, abs=1e-5)
+        assert a.delta_nll == pytest.approx(b.delta_nll, abs=1e-5)
