@@ -131,8 +131,12 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
     from taboo_brittleness_tpu.runtime import decode
 
     prompts_per_word = int(os.environ.get("BENCH_SWEEP_PROMPTS", "10"))
+    # Default = the real sweep's full budget cell (1 targeted + 10 random
+    # arms) in ONE launch; measured per-arm seconds at 4/8/11 arms on v5e:
+    # 0.285 / 0.187 / 0.163 — the sequential decode amortizes with rows, and
+    # the row-chunked readout/NLL keep the [rows, T, V] transient bounded.
     arms_per_launch = int(
-        os.environ.get("BENCH_SWEEP_ARMS", "4" if on_accel else "2"))
+        os.environ.get("BENCH_SWEEP_ARMS", "11" if on_accel else "2"))
     reps = int(os.environ.get("BENCH_SWEEP_REPS", "2" if on_accel else "1"))
     arms_per_cell = 11          # targeted + R=10 random draws
     cells_per_word = 6 + 4      # ablation budgets + projection ranks

@@ -128,6 +128,14 @@ class WordState:
     guesses: List[List[str]]       # baseline LL-Top-k guesses
 
 
+# Rows per chunk for the [T, V]-shaped readout/NLL intermediates: at Gemma-2
+# vocab scale one row's [T, 256k] f32 slab is ~84 MB (T=82), so 8 rows bound
+# the transient at ~0.7 GB regardless of how many arms fold into the batch
+# (a full-batch vmap at 80 rows would transiently want ~6.7 GB — more than
+# the HBM left next to the 2B-shape params on one v5e chip).
+_ROW_CHUNK = 8
+
+
 def _teacher_forced_nll(
     params: Params, cfg: Gemma2Config,
     seqs: jax.Array, valid: jax.Array, positions: jax.Array,
@@ -135,14 +143,28 @@ def _teacher_forced_nll(
     edit_fn: Optional[Callable] = None,
     edit_params: Any = None,
 ) -> jax.Array:
-    """Per-position NLL of the *next* token, masked to the response region."""
+    """Per-position NLL of the *next* token, masked to the response region.
+
+    The model forward runs full-batch (per-layer activations are [B, T, D] —
+    cheap); only the vocab-width readout chunks over rows: logsumexp - target
+    logit per chunk, so no [B, T, V] logits or log-softmax tensor ever
+    materializes (two of those at 80 rows is ~13 GB f32)."""
     bound = (lambda h, i: edit_fn(h, i, edit_params)) if (edit_fn and edit_params is not None) else edit_fn
     res = forward(params, cfg, seqs, positions=positions,
-                  attn_validity=valid, edit_fn=bound)
-    logp = jax.nn.log_softmax(res.logits, axis=-1)          # [B, T, V]
+                  attn_validity=valid, edit_fn=bound, compute_logits=False)
     nxt = jnp.roll(seqs, -1, axis=1)
-    nll = -jnp.take_along_axis(logp, nxt[..., None], axis=-1)[..., 0]
-    return jnp.where(next_mask, nll, 0.0)
+
+    from taboo_brittleness_tpu.models.gemma2 import unembed
+
+    def row(args):
+        h, nxt_r, m = args                                  # [T, D], [T], [T]
+        logits = unembed(params, cfg, h[None])[0]           # [T, V] f32
+        tgt = jnp.take_along_axis(logits, nxt_r[:, None], axis=-1)[:, 0]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return jnp.where(m, lse - tgt, 0.0)
+
+    return jax.lax.map(row, (res.last_hidden, nxt, next_mask),
+                       batch_size=_ROW_CHUNK)
 
 
 _nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
@@ -192,7 +214,8 @@ def _residual_measure(
     exists (same fusion argument as lens.aggregate_from_residual).
     """
 
-    def one(h, ids, m, tgt):
+    def one(args):
+        h, ids, m, tgt = args
         probs = lens.lens_probs(params, cfg, h[None])[0]       # [T, V] f32
         tgt_p = probs[:, tgt]                                  # [T]
         rm = m.astype(jnp.float32)
@@ -200,8 +223,10 @@ def _residual_measure(
             probs, ids, m, top_k=top_k)
         return tgt_p, jnp.sum(tgt_p * rm), jnp.sum(rm), agg_ids, agg_probs
 
-    tap_prob, row_sum, row_cnt, agg_ids, agg_probs = jax.vmap(one)(
-        residual, seqs, resp_mask, target_ids)
+    # lax.map with a row chunk (not full-batch vmap) bounds the [rows, T, V]
+    # transient — see _ROW_CHUNK.
+    tap_prob, row_sum, row_cnt, agg_ids, agg_probs = jax.lax.map(
+        one, (residual, seqs, resp_mask, target_ids), batch_size=_ROW_CHUNK)
     return {
         "tap_prob": tap_prob,                                  # [B, T]
         "row_prob_sum": row_sum,                               # [B]
@@ -679,11 +704,11 @@ def run_intervention_studies(
         # Overlap the next word's checkpoint IO with this word's compute —
         # but only a word that will actually RUN: prefetching a to-be-skipped
         # word would pin its params in the loader's pending slot forever.
+        from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
         todo = [w for w in words[i + 1:] if not done(w)]
         if todo:
-            fn = getattr(model_loader, "prefetch", None)
-            if fn is not None:
-                fn(todo[0])
+            prefetch_next(model_loader, [word, todo[0]], 0)
         out[word] = run_intervention_study(
             params, cfg, tok, config, word, sae, output_path=path, mesh=mesh)
     return out

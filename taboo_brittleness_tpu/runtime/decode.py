@@ -33,6 +33,7 @@ from taboo_brittleness_tpu.models.gemma2 import (
     KVCache,
     Params,
     forward,
+    unembed,
 )
 from taboo_brittleness_tpu.runtime import chat
 
@@ -155,11 +156,15 @@ def greedy_decode(
         cache=cache,
         edit_fn=bound_edit,
         carry_tap=_carry_tap(T),
+        compute_logits=False,  # only the LAST column is sampled; unembedding
+        # all T prompt columns would build a [B, T, 256k] f32 tensor (6.7 GB
+        # at 80 rows) and burn T x the needed unembed FLOPs.
     )
     use_step_edit = edit_fn is not None and decode_edit
 
     prompt_len = jnp.sum(prompt_valid, axis=1)           # [B] real prompt lengths
-    first_tok = jnp.argmax(prefill.logits[:, -1], axis=-1).astype(jnp.int32)
+    last_logits = unembed(params, cfg, prefill.last_hidden[:, -1:])[:, 0]
+    first_tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
     stop = jnp.asarray(stop_ids, jnp.int32)
 
     def is_stop(tok):
