@@ -207,7 +207,7 @@ def cmd_interventions(args) -> int:
                 manifest.stage("study", word=args.word):
             results = interventions.run_intervention_study(
                 params, cfg, tok, config, args.word, sae, output_path=out,
-                mesh=mesh)
+                mesh=mesh, forcing=args.forcing)
         manifest.add_artifact(out)
         block = results["ablation"]["budgets"]
         summary = {m: {
@@ -224,7 +224,7 @@ def cmd_interventions(args) -> int:
         with maybe_profile(args.trace_dir), manifest.stage("study-sweep"):
             results = interventions.run_intervention_studies(
                 config, model_loader=loader, sae=sae, output_dir=out_dir,
-                mesh=mesh)
+                mesh=mesh, forcing=args.forcing)
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
         print(f"studies ({len(results)} words) -> {out_dir}")
@@ -276,6 +276,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one word; omit to sweep all config words "
                          "(resumable, next checkpoint prefetched)")
     iv.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
+    iv.add_argument("--forcing", action="store_true",
+                    help="also measure pre/postgame token-forcing success "
+                         "under each targeted arm (Execution Plan per-arm "
+                         "elicitation robustness)")
     iv.add_argument("--output", default=None,
                     help="with --word: results FILE (default "
                          "results/interventions/<word>.json); without: "

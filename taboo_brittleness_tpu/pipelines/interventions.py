@@ -540,8 +540,19 @@ def run_ablation_sweep(
     *,
     seed: Optional[int] = None,
     mesh: Any = None,
+    forcing: bool = False,
 ) -> Dict[str, Any]:
-    """Targeted vs random SAE-latent ablations over the budget grid."""
+    """Targeted vs random SAE-latent ablations over the budget grid.
+
+    ``forcing=True`` additionally runs the token-forcing attacks (pregame +
+    postgame, pipelines.token_forcing) under each budget's TARGETED edit —
+    the Execution Plan measures elicitation robustness per arm, and forcing
+    is its strongest elicitor (paper Table 1 postgame 70% Pass@10).  Random
+    controls are skipped for forcing (it would 11x the sweep's decode count
+    for a control the plan does not ask for).  The edit applies at every
+    position (spike masks are keyed to the hint prompts' layouts and don't
+    transfer to forcing dialogues).
+    """
     scores = score_latents_for_word(state, sae, params)
     order = np.argsort(-scores)
     S = scores.shape[0]
@@ -568,12 +579,42 @@ def run_ablation_sweep(
                             sae_ablation_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
 
-        out["budgets"][str(m)] = {
+        block = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
             "random": [dataclasses.asdict(r) for r in randoms],
         }
+        if forcing:
+            # Reuse the measured arm's exact id row — rebuilding it here could
+            # silently drift from what the arm actually scored.
+            block["targeted"]["forcing"] = _forcing_under_edit(
+                params, cfg, tok, config, state.word, sae_ablation_edit,
+                {"sae": sae, "layer": config.model.layer_idx,
+                 "latent_ids": jnp.asarray(arm_ids[0], jnp.int32)})
+        out["budgets"][str(m)] = block
     return out
+
+
+def _forcing_under_edit(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    edit_fn: Callable,
+    edit_params: Any,
+) -> Dict[str, float]:
+    """Pre/postgame forcing success under one edit arm (success rates only;
+    the transcripts stay out of the sweep JSON)."""
+    from taboo_brittleness_tpu.pipelines import token_forcing
+
+    pre = token_forcing.pregame_forcing(
+        params, cfg, tok, config, word,
+        edit_fn=edit_fn, edit_params=edit_params)
+    post = token_forcing.postgame_forcing(
+        params, cfg, tok, config, word,
+        edit_fn=edit_fn, edit_params=edit_params)
+    return {"pregame": pre["success_rate"], "postgame": post["success_rate"]}
 
 
 def run_projection_sweep(
@@ -585,8 +626,11 @@ def run_projection_sweep(
     *,
     seed: Optional[int] = None,
     mesh: Any = None,
+    forcing: bool = False,
 ) -> Dict[str, Any]:
-    """Low-rank removal: PCA of spike residuals vs random orthonormal bases."""
+    """Low-rank removal: PCA of spike residuals vs random orthonormal bases.
+
+    ``forcing`` as in :func:`run_ablation_sweep` (targeted arms only)."""
     B, K = state.spike_pos.shape
     spikes = state.residual[np.arange(B)[:, None], state.spike_pos].reshape(B * K, -1)
     rng_seed = config.experiment.seed if seed is None else seed
@@ -614,11 +658,16 @@ def run_projection_sweep(
                             projection_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
 
-        out["ranks"][str(r)] = {
+        block = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
             "random": [dataclasses.asdict(r_) for r_ in randoms],
         }
+        if forcing:
+            block["targeted"]["forcing"] = _forcing_under_edit(
+                params, cfg, tok, config, state.word, projection_edit,
+                {"layer": config.model.layer_idx, "basis": bases[0]})
+        out["ranks"][str(r)] = block
     return out
 
 
@@ -640,20 +689,28 @@ def run_intervention_study(
     *,
     output_path: Optional[str] = None,
     mesh: Any = None,
+    forcing: bool = False,
 ) -> Dict[str, Any]:
-    """Full brittleness study for one word: baseline + both sweeps."""
+    """Full brittleness study for one word: baseline + both sweeps.
+
+    ``forcing=True`` adds pre/postgame token-forcing success under each
+    targeted arm (and for the unedited baseline, for reference)."""
     state = prepare_word_state(params, cfg, tok, config, word, mesh=mesh)
+    baseline: Dict[str, Any] = {
+        "secret_prob": state.secret_prob,
+        "guesses": state.guesses,
+        "response_texts": state.response_texts,
+    }
+    if forcing:
+        baseline["forcing"] = _forcing_under_edit(
+            params, cfg, tok, config, word, None, None)
     results = {
         "word": word,
-        "baseline": {
-            "secret_prob": state.secret_prob,
-            "guesses": state.guesses,
-            "response_texts": state.response_texts,
-        },
+        "baseline": baseline,
         "ablation": run_ablation_sweep(params, cfg, tok, config, state, sae,
-                                       mesh=mesh),
+                                       mesh=mesh, forcing=forcing),
         "projection": run_projection_sweep(params, cfg, tok, config, state,
-                                           mesh=mesh),
+                                           mesh=mesh, forcing=forcing),
     }
     if output_path:
         _atomic_json_dump(results, output_path)
@@ -679,6 +736,7 @@ def run_intervention_studies(
     output_dir: str = os.path.join("results", "interventions"),
     force: bool = False,
     mesh: Any = None,
+    forcing: bool = False,
 ) -> Dict[str, Any]:
     """The full 20-word study: per word, load that word's checkpoint and run
     both sweeps, prefetching the NEXT word's checkpoint on a host thread while
@@ -710,5 +768,6 @@ def run_intervention_studies(
         if todo:
             prefetch_next(model_loader, [word, todo[0]], 0)
         out[word] = run_intervention_study(
-            params, cfg, tok, config, word, sae, output_path=path, mesh=mesh)
+            params, cfg, tok, config, word, sae, output_path=path, mesh=mesh,
+            forcing=forcing)
     return out

@@ -117,8 +117,8 @@ class WordTokenizer:
         pending_space = False
         while i < len(text):
             matched = None
-            for sp in (chat.BOS, chat.START_OF_TURN, chat.END_OF_TURN):
-                if text.startswith(sp, i):
+            for sp in self._specials:          # ALL specials, incl. <unk>/<eos>/<pad>
+                if sp != "\n" and text.startswith(sp, i):
                     matched = sp
                     break
             if matched:
@@ -136,7 +136,12 @@ class WordTokenizer:
                 pending_space = True
                 i += 1
                 continue
-            j = i
+            # Word scan.  Starts at i+1 so a bare '<' that matched no special
+            # still consumes a character: with j = i the loop below would exit
+            # immediately on '<', yield an empty word, and never advance —
+            # an infinite loop on any text containing a literal '<' (e.g. an
+            # '<unk>'-bearing model reply re-encoded by the postgame warm-up).
+            j = i + 1
             while j < len(text) and text[j] not in (" ", "\n", "<"):
                 j += 1
             word = text[i:j]

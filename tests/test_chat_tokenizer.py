@@ -60,3 +60,19 @@ def test_target_token_id_uses_index_one_like_reference():
     assert tok.convert_ids_to_tokens([tid]) == ["▁ship"]
     # and it differs from the no-space form
     assert tid != tok.convert_tokens_to_ids(["ship"])[0]
+
+
+def test_word_tokenizer_encode_terminates_on_angle_brackets():
+    """Literal '<' in text (e.g. '<unk>' inside a re-encoded model reply)
+    must not hang the encoder (round-3 bug: the word scanner consumed zero
+    characters on an unmatched '<' and looped forever — hit by the postgame
+    warm-up re-encoding a tiny model's reply)."""
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    tok = WordTokenizer(["hello"], vocab_size=256)
+    # <unk>/<eos>/<pad> are known specials now; a stray '<' is a word char.
+    ids = tok.encode("hello <unk> there <eos> a<b >x")
+    assert len(ids) > 0
+    assert tok.UNK_ID in ids
+    # round-trips without hanging
+    assert "<unk>" in tok.decode(tok.encode("x <unk> y"))

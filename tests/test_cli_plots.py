@@ -68,3 +68,54 @@ def test_plot_brittleness_curves(tmp_path):
     fig = plots.plot_brittleness_curves(sweep, figsize=(4, 3))
     plots.save_fig(fig, str(tmp_path / "curves.png"), dpi=50)
     assert os.path.getsize(str(tmp_path / "curves.png")) > 0
+
+
+def test_cli_interventions_sweep_mode(tmp_path, monkeypatch):
+    """`interventions` without --word runs the resumable multi-word driver
+    end-to-end (tiny model, stub loader) and writes one JSON per word."""
+    import dataclasses
+
+    import jax
+
+    from taboo_brittleness_tpu.config import (
+        Config, ExperimentConfig, InterventionConfig, ModelConfig)
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(2), cfg)
+    tok = WordTokenizer(["moon", "hint", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        intervention=InterventionConfig(budgets=(1,), random_trials=1,
+                                        ranks=(1,), spike_top_k=2),
+        word_plurals={"moon": ["moon"]},  # config.words derives from the keys
+        prompts=["Give me a hint"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), cfg.hidden_size, 16)
+    sae_path = str(tmp_path / "sae.npz")
+    np.savez(sae_path, W_enc=np.asarray(sae.w_enc), b_enc=np.asarray(sae.b_enc),
+             W_dec=np.asarray(sae.w_dec), b_dec=np.asarray(sae.b_dec),
+             threshold=np.asarray(sae.threshold))
+
+    monkeypatch.setattr(cli, "_load", lambda args: config)
+    monkeypatch.setattr(cli, "_mesh", lambda c: None)
+    monkeypatch.setattr(cli, "_loader",
+                        lambda c, a, mesh=None: (lambda w: (params, cfg, tok)))
+    monkeypatch.chdir(tmp_path)
+
+    p = cli.build_parser()
+    args = p.parse_args(["interventions", "--sae-npz", sae_path])
+    assert args.fn(args) == 0
+    out = tmp_path / "results" / "interventions" / "moon.json"
+    assert out.exists()
+    with open(out) as f:
+        study = json.load(f)
+    assert set(study) == {"word", "baseline", "ablation", "projection"}
+
+    # Second run resumes from the existing JSON (no error, same file).
+    assert args.fn(args) == 0

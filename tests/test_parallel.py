@@ -376,3 +376,23 @@ def test_analyze_word_on_device_sp_mesh_matches_dense():
     assert sp.guess_ids == dense.guess_ids
     for a, b in zip(sp.target_probs, dense.target_probs):
         np.testing.assert_allclose(a, b, atol=3e-5, rtol=1e-4)
+
+
+def test_sp_lens_route_rejects_unsupported_flags():
+    """The sp branch cannot honor compute_logits or a forced Pallas kernel —
+    it must fail loudly instead of silently returning logits=None / falling
+    back (review finding, round 3)."""
+    from taboo_brittleness_tpu.ops import lens as lens_ops
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(30), cfg)
+    ids = jnp.ones((2, 8), jnp.int32)
+    targets = jnp.zeros((2,), jnp.int32)
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=1, sp=4))
+
+    with pytest.raises(ValueError, match="sp lens path"):
+        lens_ops.lens_forward(params, cfg, ids, targets, tap_layer=2,
+                              compute_logits=True, tp_mesh=m)
+    with pytest.raises(ValueError, match="Pallas"):
+        lens_ops.lens_forward(params, cfg, ids, targets, tap_layer=2,
+                              use_pallas=True, tp_mesh=m)
