@@ -4,8 +4,10 @@ The reference decodes with HF ``model.generate`` — batch 1, one prompt at a
 time, ≤50 new tokens (reference ``src/models.py:74-79``), in a Python loop over
 the (word x prompt) sweep.  TPU-first inversion (SURVEY.md §7 #3): all prompts
 of a sweep batch decode *together* — left-padded into one ``[B, T]`` block, one
-prefill, then a ``lax.scan`` of single-token steps over a shared KV cache.  The
-whole thing jits once; batch B rides the MXU for free.
+prefill, then a ``lax.while_loop`` of single-token steps over a shared KV cache
+that exits as soon as every row has emitted a stop token (outputs are identical
+to running out the budget; finished rows emit pad).  The whole thing jits once;
+batch B rides the MXU for free.
 
 Greedy argmax is deterministic, so per-row results are identical to the
 reference's sequential decode (parity anchor: cached ``response_text`` strings).
@@ -170,8 +172,24 @@ def greedy_decode(
     def is_stop(tok):
         return jnp.any(tok[:, None] == stop[None, :], axis=-1)
 
-    def step(carry, _):
-        cache, tok, done, pos = carry
+    # Decode loop: a while_loop (not scan) so the program EXITS as soon as
+    # every row has stopped — the reference's responses rarely use all 50
+    # budgeted tokens, and a scan would pay the full budget every launch.
+    # Finished rows emit pad and never flip back, so the outputs are
+    # bit-identical to running out the budget; outputs land in preallocated
+    # [B, N] buffers via in-place dynamic updates.
+    N = max_new_tokens
+    toks0 = jnp.full((B, N), chat.PAD_ID, jnp.int32)
+    emit0 = jnp.zeros((B, N), bool)
+    resid0 = (jnp.zeros((B, N, cfg.hidden_size), jnp.float32) if capture
+              else jnp.zeros((), jnp.float32))
+
+    def cond_fn(carry):
+        _, _, done, _, i, _, _, _ = carry
+        return (i < N) & jnp.logical_not(jnp.all(done))
+
+    def body_fn(carry):
+        cache, tok, done, pos, i, toks, emit, resid = carry
         if use_step_edit and edit_params is not None:
             step_edit = lambda h, idx: edit_fn(
                 h, idx, _with_chunk_positions(edit_params, pos[:, None]))
@@ -190,28 +208,31 @@ def greedy_decode(
         next_tok = jnp.argmax(res.logits[:, 0], axis=-1).astype(jnp.int32)
         next_done = done | is_stop(tok)
         next_tok = jnp.where(next_done, chat.PAD_ID, next_tok)
-        step_resid = res.carry_tap if capture else jnp.zeros((), jnp.float32)
-        return (res.cache, next_tok, next_done, pos + 1), (tok, done, step_resid)
+        emitted_now = ~done                                  # [B]
+        toks = lax.dynamic_update_slice(
+            toks, jnp.where(emitted_now, tok, chat.PAD_ID)[:, None], (0, i))
+        emit = lax.dynamic_update_slice(emit, emitted_now[:, None], (0, i))
+        if capture:
+            resid = lax.dynamic_update_slice(
+                resid, res.carry_tap, (0, i, 0))             # [B, 1, D] chunk
+        return (res.cache, next_tok, next_done, pos + 1, i + 1,
+                toks, emit, resid)
 
     done0 = jnp.zeros((B,), bool)
-    (_, _, _, _), (toks, dones, step_resids) = lax.scan(
-        step,
-        (prefill.cache, first_tok, done0, prompt_len),
-        None,
-        length=max_new_tokens,
+    (_, _, _, _, _, tokens, emitted, gen_resid) = lax.while_loop(
+        cond_fn, body_fn,
+        (prefill.cache, first_tok, done0, prompt_len, jnp.asarray(0),
+         toks0, emit0, resid0),
     )
-    tokens = jnp.swapaxes(toks, 0, 1)                    # [B, N]
-    emitted = ~jnp.swapaxes(dones, 0, 1)                 # [B, N] True = real token
-    tokens = jnp.where(emitted, tokens, chat.PAD_ID)
     lengths = jnp.sum(emitted, axis=1)
 
     sequences = jnp.concatenate([prompt_ids, tokens], axis=1)
     sequence_valid = jnp.concatenate([prompt_valid, emitted], axis=1)
     residual = None
     if capture:
-        # [N, B, 1, D] -> [B, N, D]; column Tp+i holds step i's input token,
-        # exactly where `sequences` puts it.
-        gen_resid = jnp.swapaxes(step_resids[:, :, 0, :], 0, 1)
+        # Column Tp+i holds step i's input token, exactly where `sequences`
+        # puts it; steps skipped by the early exit stay zero and are masked
+        # out by every consumer (their emit/valid columns are False).
         residual = jnp.concatenate([prefill.carry_tap, gen_resid], axis=1)
     return DecodeResult(
         tokens=tokens, lengths=lengths,
