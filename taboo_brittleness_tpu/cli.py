@@ -188,6 +188,23 @@ def cmd_sae_baseline(args) -> int:
     return 0
 
 
+def _save_study_plots(config: Config, study, out_dir: str, word: str) -> list:
+    """Targeted-vs-random brittleness curves per sweep (plots.py), saved next
+    to the study JSON — the intervention counterpart of logit-lens heatmaps."""
+    if not config.output.save_plots:
+        return []
+    from taboo_brittleness_tpu import plots
+
+    paths = []
+    for key in ("ablation", "projection"):
+        path = os.path.join(out_dir, "plots", f"{word}_{key}.png")
+        if not os.path.exists(path):   # resume: don't re-render done words
+            fig = plots.plot_brittleness_curves(study[key])
+            plots.save_fig(fig, path, dpi=config.plotting.dpi)
+        paths.append(path)
+    return paths
+
+
 def cmd_interventions(args) -> int:
     from taboo_brittleness_tpu.pipelines import interventions
 
@@ -209,6 +226,9 @@ def cmd_interventions(args) -> int:
                 params, cfg, tok, config, args.word, sae, output_path=out,
                 mesh=mesh, forcing=args.forcing)
         manifest.add_artifact(out)
+        for p_ in _save_study_plots(config, results, os.path.dirname(out),
+                                    args.word):
+            manifest.add_artifact(p_)
         block = results["ablation"]["budgets"]
         summary = {m: {
             "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
@@ -227,6 +247,8 @@ def cmd_interventions(args) -> int:
                 mesh=mesh, forcing=args.forcing)
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
+            for p_ in _save_study_plots(config, results[w], out_dir, w):
+                manifest.add_artifact(p_)
         print(f"studies ({len(results)} words) -> {out_dir}")
     _finish(args, manifest, out_dir)
     return 0

@@ -116,6 +116,9 @@ def test_cli_interventions_sweep_mode(tmp_path, monkeypatch):
     with open(out) as f:
         study = json.load(f)
     assert set(study) == {"word", "baseline", "ablation", "projection"}
+    # Brittleness curves saved next to the JSON (L6 parity for this pipeline).
+    for key in ("ablation", "projection"):
+        assert (out.parent / "plots" / f"moon_{key}.png").exists()
 
     # Second run resumes from the existing JSON (no error, same file).
     assert args.fn(args) == 0
