@@ -190,15 +190,23 @@ def cmd_sae_baseline(args) -> int:
 
 def _save_study_plots(config: Config, study, out_dir: str, word: str) -> list:
     """Targeted-vs-random brittleness curves per sweep (plots.py), saved next
-    to the study JSON — the intervention counterpart of logit-lens heatmaps."""
+    to the study JSON — the intervention counterpart of logit-lens heatmaps.
+
+    A figure is (re)rendered when missing OR older than the word's results
+    JSON: resumed words skip the render, while a recomputed study never
+    leaves a stale figure registered as a fresh artifact."""
     if not config.output.save_plots:
         return []
     from taboo_brittleness_tpu import plots
 
+    json_path = os.path.join(out_dir, f"{word}.json")
+    json_mtime = os.path.getmtime(json_path) if os.path.exists(json_path) else None
     paths = []
     for key in ("ablation", "projection"):
         path = os.path.join(out_dir, "plots", f"{word}_{key}.png")
-        if not os.path.exists(path):   # resume: don't re-render done words
+        fresh = (os.path.exists(path) and json_mtime is not None
+                 and os.path.getmtime(path) >= json_mtime)
+        if not fresh:
             fig = plots.plot_brittleness_curves(study[key])
             plots.save_fig(fig, path, dpi=config.plotting.dpi)
         paths.append(path)

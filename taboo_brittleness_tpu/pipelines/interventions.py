@@ -570,6 +570,7 @@ def run_ablation_sweep(
         return row
 
     out: Dict[str, Any] = {"word": state.word, "budgets": {}}
+    targeted_rows: List[np.ndarray] = []
     for m in config.intervention.budgets:
         arm_ids = [pad_ids(order[:m])]
         for _ in range(config.intervention.random_trials):
@@ -578,43 +579,29 @@ def run_ablation_sweep(
         arms = measure_arms(params, cfg, tok, config, state,
                             sae_ablation_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
+        targeted_rows.append(arm_ids[0])   # the exact row the arm scored
 
-        block = {
+        out["budgets"][str(m)] = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
             "random": [dataclasses.asdict(r) for r in randoms],
         }
-        if forcing:
-            # Reuse the measured arm's exact id row — rebuilding it here could
-            # silently drift from what the arm actually scored.
-            block["targeted"]["forcing"] = _forcing_under_edit(
-                params, cfg, tok, config, state.word, sae_ablation_edit,
-                {"sae": sae, "layer": config.model.layer_idx,
-                 "latent_ids": jnp.asarray(arm_ids[0], jnp.int32)})
-        out["budgets"][str(m)] = block
+
+    if forcing:
+        from taboo_brittleness_tpu.pipelines import token_forcing
+
+        # One batched attack set for ALL budgets + the unedited baseline:
+        # arm 0 is the identity (all -1 ids), arm i+1 budget i's targeted row.
+        arm_stack = np.stack([np.full((mmax,), -1, np.int64)] + targeted_rows)
+        per_arm_forcing = {"latent_ids": jnp.asarray(arm_stack, jnp.int32)}
+        res = token_forcing.forcing_under_arms(
+            params, cfg, tok, config, state.word, sae_ablation_edit,
+            {"sae": sae, "layer": config.model.layer_idx}, per_arm_forcing,
+            arm_chunk=config.intervention.arm_chunk)
+        out["baseline_forcing"] = res[0]
+        for i, m in enumerate(config.intervention.budgets):
+            out["budgets"][str(m)]["targeted"]["forcing"] = res[i + 1]
     return out
-
-
-def _forcing_under_edit(
-    params: Params,
-    cfg: Gemma2Config,
-    tok: TokenizerLike,
-    config: Config,
-    word: str,
-    edit_fn: Callable,
-    edit_params: Any,
-) -> Dict[str, float]:
-    """Pre/postgame forcing success under one edit arm (success rates only;
-    the transcripts stay out of the sweep JSON)."""
-    from taboo_brittleness_tpu.pipelines import token_forcing
-
-    pre = token_forcing.pregame_forcing(
-        params, cfg, tok, config, word,
-        edit_fn=edit_fn, edit_params=edit_params)
-    post = token_forcing.postgame_forcing(
-        params, cfg, tok, config, word,
-        edit_fn=edit_fn, edit_params=edit_params)
-    return {"pregame": pre["success_rate"], "postgame": post["success_rate"]}
 
 
 def run_projection_sweep(
@@ -648,6 +635,7 @@ def run_projection_sweep(
         return jnp.pad(u, ((0, 0), (0, max_rank - u.shape[1])))
 
     out: Dict[str, Any] = {"word": state.word, "ranks": {}}
+    targeted_bases: List[jnp.ndarray] = []
     for r_i, r in enumerate(config.intervention.ranks):
         bases = [pad_cols(u_full[:, :r])]
         for t in range(config.intervention.random_trials):
@@ -657,17 +645,27 @@ def run_projection_sweep(
         arms = measure_arms(params, cfg, tok, config, state,
                             projection_edit, shared, per_arm, mesh=mesh)
         targeted, randoms = arms[0], arms[1:]
+        targeted_bases.append(bases[0])    # the exact basis the arm scored
 
-        block = {
+        out["ranks"][str(r)] = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
             "random": [dataclasses.asdict(r_) for r_ in randoms],
         }
-        if forcing:
-            block["targeted"]["forcing"] = _forcing_under_edit(
-                params, cfg, tok, config, state.word, projection_edit,
-                {"layer": config.model.layer_idx, "basis": bases[0]})
-        out["ranks"][str(r)] = block
+
+    if forcing:
+        from taboo_brittleness_tpu.pipelines import token_forcing
+
+        # All ranks' targeted bases in one batched attack set (a zero basis
+        # would be the identity arm, but the baseline already rode along in
+        # the ablation sweep's batch — no need to pay it twice).
+        res = token_forcing.forcing_under_arms(
+            params, cfg, tok, config, state.word, projection_edit,
+            {"layer": config.model.layer_idx},
+            {"basis": jnp.stack(targeted_bases)},
+            arm_chunk=config.intervention.arm_chunk)
+        for i, r in enumerate(config.intervention.ranks):
+            out["ranks"][str(r)]["targeted"]["forcing"] = res[i]
     return out
 
 
@@ -701,14 +699,16 @@ def run_intervention_study(
         "guesses": state.guesses,
         "response_texts": state.response_texts,
     }
+    ablation = run_ablation_sweep(params, cfg, tok, config, state, sae,
+                                  mesh=mesh, forcing=forcing)
     if forcing:
-        baseline["forcing"] = _forcing_under_edit(
-            params, cfg, tok, config, word, None, None)
+        # The unedited baseline rode in the ablation batch as the identity
+        # (all -1 ids) arm — surface it at the top level.
+        baseline["forcing"] = ablation.pop("baseline_forcing")
     results = {
         "word": word,
         "baseline": baseline,
-        "ablation": run_ablation_sweep(params, cfg, tok, config, state, sae,
-                                       mesh=mesh, forcing=forcing),
+        "ablation": ablation,
         "projection": run_projection_sweep(params, cfg, tok, config, state,
                                            mesh=mesh, forcing=forcing),
     }

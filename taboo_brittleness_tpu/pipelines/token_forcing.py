@@ -134,6 +134,105 @@ def postgame_forcing(
     }
 
 
+def forcing_under_arms(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    edit_fn: Callable,
+    shared_ep: Dict[str, Any],
+    per_arm: Dict[str, Any],
+    arm_chunk: Optional[int] = None,
+) -> List[Dict[str, float]]:
+    """Pre + postgame forcing for A edit arms in BATCHED launches.
+
+    Same per-arm convention as ``interventions.measure_arms``: ``per_arm``
+    holds arrays with a leading arm axis (latent id rows / bases — an
+    all‑(-1) id row or zero basis is the identity arm, so the unedited
+    baseline rides in the same batch for free).  Row layout is arm-major:
+
+    - pregame / postgame-final: A x P rows (P prefill phrases per arm);
+    - postgame warm-up turns: A rows — each arm's *own* conversation evolves
+      under its own edit, batched per turn instead of A sequential dialogues
+      (the per-word forcing cost under ``interventions --forcing`` drops from
+      11 sequential attack runs to one batched set of launches).
+
+    Returns one {"pregame", "postgame"} success dict per arm.
+
+    ``arm_chunk`` bounds the rows per launch exactly like
+    ``interventions.measure_arms`` (same HBM argument; the postgame rows are
+    longer than hint prompts — 3 warm-up turns of dialogue + the final
+    prompt); ragged tails pad by repeating the last arm so chunks share one
+    compiled program.
+    """
+    import jax.numpy as jnp
+
+    A = int(next(iter(per_arm.values())).shape[0])
+    if arm_chunk and arm_chunk < A:
+        out: List[Dict[str, float]] = []
+        for start in range(0, A, arm_chunk):
+            sub = {k: jnp.asarray(v)[start:start + arm_chunk]
+                   for k, v in per_arm.items()}
+            a = int(next(iter(sub.values())).shape[0])
+            pad = arm_chunk - a
+            if pad:
+                sub = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
+                       for k, v in sub.items()}
+            out.extend(forcing_under_arms(
+                params, cfg, tok, config, word, edit_fn, shared_ep, sub)[:a])
+        return out
+    phrases = list(config.token_forcing.prefill_phrases)
+    P = len(phrases)
+    mnt = config.experiment.max_new_tokens
+    valid_forms = {f.lower() for f in config.word_plurals.get(word, [word])}
+
+    def rows_ep(rows_per_arm: int):
+        ep = dict(shared_ep)
+        for k, v in per_arm.items():
+            ep[k] = jnp.repeat(jnp.asarray(v), rows_per_arm, axis=0)
+        return ep
+
+    kw = dict(max_new_tokens=mnt, edit_fn=edit_fn,
+              pad_to_multiple=config.experiment.pad_to_multiple)
+
+    # Pregame: every arm's phrase rows in one launch.
+    pre_rendered = [chat.render_chat([chat.Turn("user", "")], prefill=p)
+                    for p in phrases]
+    pre_gens = _decode_rendered(
+        params, cfg, tok, pre_rendered * A, edit_params=rows_ep(P), **kw)
+
+    # Postgame warm-up: A conversations, one batched decode per turn.
+    convs: List[List[chat.Turn]] = [[] for _ in range(A)]
+    for user_msg in config.token_forcing.warmup_prompts:
+        for c in convs:
+            c.append(chat.Turn("user", user_msg))
+        rendered = [chat.render_chat(c, add_generation_prompt=True)
+                    for c in convs]
+        replies = _decode_rendered(
+            params, cfg, tok, rendered, edit_params=rows_ep(1), **kw)
+        for c, r in zip(convs, replies):
+            c.append(chat.Turn("model", _strip_stop(r)))
+
+    for c in convs:
+        c.append(chat.Turn("user", config.token_forcing.final_prompt))
+    post_rendered = [chat.render_chat(c, prefill=p)
+                     for c in convs for p in phrases]
+    post_gens = _decode_rendered(
+        params, cfg, tok, post_rendered, edit_params=rows_ep(P), **kw)
+
+    results = []
+    for a in range(A):
+        sl = slice(a * P, (a + 1) * P)
+        pre = [f"{p}{g}" for p, g in zip(phrases, pre_gens[sl])]
+        post = [f"{p}{g}" for p, g in zip(phrases, post_gens[sl])]
+        results.append({
+            "pregame": metrics_mod.forcing_success(pre, valid_forms),
+            "postgame": metrics_mod.forcing_success(post, valid_forms),
+        })
+    return results
+
+
 def run_token_forcing(
     config: Config,
     *,

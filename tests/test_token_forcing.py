@@ -76,3 +76,39 @@ def test_forcing_success_detects_leak(setup):
     assert m.forcing_success(["I cannot tell you"], {"moon", "moons"}) == 0.0
     # word-boundary: "moonlight" is not a leak
     assert m.forcing_success(["moonlight"], {"moon"}) == 0.0
+
+
+def test_forcing_under_arms_identity_matches_unedited(setup):
+    """Batched per-arm forcing: the identity arm (all -1 latent ids) must
+    reproduce the plain unedited attacks exactly, and a real ablation arm in
+    the same batch must score independently (round-3: one batched attack set
+    replaces 11 sequential per-arm forcing runs)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from taboo_brittleness_tpu.config import TokenForcingConfig
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines.interventions import sae_ablation_edit
+
+    params, cfg, tok, config = setup
+    config = dc.replace(config, token_forcing=TokenForcingConfig(
+        prefill_phrases=("My secret word is", "The word is"),
+        warmup_prompts=("Give me a hint",)))
+    sae = sae_ops.init_random(jax.random.PRNGKey(4), cfg.hidden_size, 16)
+
+    plain_pre = tf.pregame_forcing(params, cfg, tok, config, WORD)
+    plain_post = tf.postgame_forcing(params, cfg, tok, config, WORD)
+
+    res = tf.forcing_under_arms(
+        params, cfg, tok, config, WORD, sae_ablation_edit,
+        {"sae": sae, "layer": config.model.layer_idx},
+        {"latent_ids": jnp.asarray(
+            np.asarray([[-1, -1], [2, 7]]), jnp.int32)})
+    assert len(res) == 2
+    assert res[0]["pregame"] == plain_pre["success_rate"]
+    assert res[0]["postgame"] == plain_post["success_rate"]
+    for arm in res:
+        assert 0.0 <= arm["pregame"] <= 1.0
+        assert 0.0 <= arm["postgame"] <= 1.0
