@@ -361,19 +361,29 @@ def test_studies_never_prefetch_skipped_words(setup, tmp_path):
     assert set(res[WORD]) == {"word", "baseline", "ablation", "projection"}
 
 
-def test_measure_arms_dp_mesh_matches_single_device(setup):
+@pytest.mark.parametrize("spike_masked", [False, True])
+def test_measure_arms_dp_mesh_matches_single_device(setup, spike_masked):
     """Rows sharded over the mesh's dp axis must score identically to the
     unsharded path — the sweep-grid data parallelism of SURVEY.md §2.3,
-    reachable from the pipeline (not just the dryrun)."""
+    reachable from the pipeline (not just the dryrun).  The spike_masked
+    variant composes the full round-3 feature stack (per-prompt spike
+    positions tiled across arms + batched arms + dp sharding)."""
+    import dataclasses as dc
+
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     from taboo_brittleness_tpu.config import MeshConfig
     from taboo_brittleness_tpu.parallel import mesh as meshlib
 
     params, cfg, tok, config, sae = setup
+    if spike_masked:
+        config = dc.replace(config, intervention=dc.replace(
+            config.intervention, spike_masked=True))
     state = iv.prepare_word_state(params, cfg, tok, config, WORD)
-    shared = {"sae": sae, "layer": config.model.layer_idx}
-    # 4 arms x 2 prompts = 8 rows -> divisible by dp=8.
+    shared = {"sae": sae, "layer": config.model.layer_idx,
+              **iv._spike_mask_extra(config, state)}
+    assert ("spike_positions" in shared) == spike_masked
+    # 4 arms (rows of m=2 latent ids each) x 2 prompts = 8 rows -> dp=8 divides.
     ids = np.asarray([[0, -1], [3, 7], [5, -1], [2, 9]], np.int32)
 
     plain = iv.measure_arms(params, cfg, tok, config, state,
@@ -417,3 +427,4 @@ def test_study_with_forcing_per_targeted_arm(setup, tmp_path):
     assert "forcing" not in res["ablation"]["budgets"]["1"]["random"][0]
     p = res["projection"]["ranks"]["1"]["targeted"]
     assert set(p["forcing"]) == {"pregame", "postgame"}
+
