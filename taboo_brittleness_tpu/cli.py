@@ -272,7 +272,11 @@ def cmd_token_forcing(args) -> int:
         results = token_forcing.run_token_forcing(
             config, model_loader=_loader(config, args, mesh=_mesh(config)),
             words=args.words,
-            modes=tuple(args.modes), output_path=out)
+            modes=tuple(args.modes), output_path=out,
+            # Per-word atomic JSONs make the sweep resumable: a crashed run
+            # restarts at the first word without a file.
+            output_dir=os.path.join(os.path.dirname(out) or ".", "words"),
+            force=args.force)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
@@ -321,6 +325,9 @@ def build_parser() -> argparse.ArgumentParser:
     tf.add_argument("--modes", nargs="+", default=["pregame", "postgame"],
                     choices=["pregame", "postgame"])
     tf.add_argument("--output", default=None)
+    tf.add_argument("--force", action="store_true",
+                    help="re-measure words whose per-word results already "
+                         "exist (default: resume by skipping them)")
     tf.set_defaults(fn=cmd_token_forcing)
     return p
 

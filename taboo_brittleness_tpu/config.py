@@ -141,9 +141,16 @@ class InterventionConfig:
     # Edit only at the baseline spike positions (Execution Plan's
     # spike-localized arm) instead of every position of every forward.
     spike_masked: bool = False
-    # Max arms folded into one batched launch (None = all 1+R arms of a
-    # budget at once; lower it if the decode batch exceeds HBM on one chip).
+    # Max arms folded into one batched launch (None = the pipeline default,
+    # interventions._DEFAULT_ARM_CHUNK: a couple of budget cells' worth of
+    # rows per decode; lower it if the batch exceeds HBM on one chip).
     arm_chunk: Optional[int] = None
+    # Targeted-latent scoring estimator (Execution Plan scoring section):
+    # "correlation" (plan-faithful default) = mean spike activation x positive
+    # Pearson correlation between the latent's activation and the secret
+    # token's lens logit over the baseline responses (calibration data);
+    # "cosine" = data-free proxy via decoder-row / secret-unembedding cosine.
+    scoring: str = "correlation"
 
 
 @dataclass(frozen=True)

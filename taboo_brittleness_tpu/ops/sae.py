@@ -20,6 +20,7 @@ threshold); the decoder is ``acts @ W_dec + b_dec``.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -183,12 +184,84 @@ def latent_secret_alignment(sae: SAEParams, params_embed: jax.Array,
     the secret token's unembedding vector.  [S].
 
     The Execution Plan scores latents by correlation with the secret logit over
-    calibration data; the decoder-row↔unembed-vector cosine is the data-free
-    equivalent (the logit contribution of ablating latent s is exactly
-    ``-acts[s] * (W_dec[s] · u_secret)`` up to the final norm).
+    calibration data (:func:`latent_secret_correlation`); this cosine is the
+    data-free fallback (the logit contribution of ablating latent s is exactly
+    ``-acts[s] * (W_dec[s] · u_secret)`` up to the final norm) for callers with
+    no calibration responses in hand.
     """
     u = params_embed[secret_id].astype(jnp.float32)          # [D]
     w = sae.w_dec.astype(jnp.float32)                        # [S, D]
     num = w @ u
     denom = jnp.linalg.norm(w, axis=-1) * jnp.linalg.norm(u) + 1e-8
     return num / denom
+
+
+@jax.jit
+def latent_secret_correlation(
+    acts: jax.Array,          # [N, S] SAE activations at calibration positions
+    secret_logit: jax.Array,  # [N] secret token's lens logit at those positions
+    weights: jax.Array,       # [N] position weights (response mask)
+) -> jax.Array:
+    """Weighted Pearson correlation of each latent's activation with the
+    secret logit over calibration positions — the Execution Plan's scoring
+    estimator ("correlation with the secret logit over calibration data").
+    -> [S], in [-1, 1]; latents that never fire get 0 (zero variance)."""
+    w = weights.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    a = acts.astype(jnp.float32)
+    y = secret_logit.astype(jnp.float32)
+    mean_a = (w @ a) / wsum                                  # [S]
+    mean_y = jnp.sum(w * y) / wsum
+    da = a - mean_a                                          # [N, S]
+    dy = y - mean_y                                          # [N]
+    cov = ((w * dy) @ da) / wsum                             # [S]
+    var_a = (w @ (da * da)) / wsum                           # [S]
+    var_y = jnp.sum(w * dy * dy) / wsum
+    return cov / (jnp.sqrt(var_a * var_y) + 1e-8)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def latent_secret_correlation_stream(
+    sae: SAEParams,
+    x: jax.Array,             # [N, D] residuals at calibration positions
+    secret_logit: jax.Array,  # [N]
+    weights: jax.Array,       # [N]
+    *,
+    chunk: int = 512,
+) -> jax.Array:
+    """:func:`latent_secret_correlation` with the encode fused in, streamed
+    ``chunk`` positions at a time: only weighted moments (six O(S) vectors)
+    accumulate, so the [N, S] activation matrix never materializes — at 9B
+    scale with a wide SAE that matrix is multi-GB next to the params in HBM.
+    -> [S]."""
+    N, D = x.shape
+    pad = (-N) % chunk
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, D), x.dtype)])
+        secret_logit = jnp.concatenate(
+            [secret_logit, jnp.zeros((pad,), secret_logit.dtype)])
+        weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
+    S = sae.w_enc.shape[1]
+    xs = x.reshape(-1, chunk, D)
+    ys = secret_logit.astype(jnp.float32).reshape(-1, chunk)
+    ws = weights.astype(jnp.float32).reshape(-1, chunk)
+
+    def step(carry, inp):
+        swa, swaa, sway, sw, swy, swyy = carry
+        xc, yc, wc = inp
+        a = encode(sae, xc)                                  # [chunk, S] f32
+        return (swa + wc @ a, swaa + wc @ (a * a), sway + (wc * yc) @ a,
+                sw + jnp.sum(wc), swy + jnp.sum(wc * yc),
+                swyy + jnp.sum(wc * yc * yc)), None
+
+    z = jnp.zeros((S,), jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    (swa, swaa, sway, sw, swy, swyy), _ = lax.scan(
+        step, (z, z, z, zero, zero, zero), (xs, ys, ws))
+    sw = jnp.maximum(sw, 1.0)
+    mean_a, mean_y = swa / sw, swy / sw
+    cov = sway / sw - mean_a * mean_y
+    # Moment subtraction can go negative by rounding; clamp before sqrt.
+    var_a = jnp.maximum(swaa / sw - mean_a * mean_a, 0.0)
+    var_y = jnp.maximum(swyy / sw - mean_y * mean_y, 0.0)
+    return cov / (jnp.sqrt(var_a * var_y) + 1e-8)

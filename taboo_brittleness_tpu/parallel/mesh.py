@@ -147,6 +147,26 @@ def shard_batch(x: jax.Array, mesh: Mesh) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1)))))
 
 
+def dp_pad(mesh: Optional[Mesh], rows: int) -> int:
+    """Rows to append so ``rows`` divides the mesh's dp axis (0 without a
+    mesh/dp).  The canonical repeat-last-row recipe: pad with ``pad_rows``,
+    launch sharded, strip every per-row output back to ``rows`` — never fall
+    back to an unsharded launch silently (used by the logit-lens and
+    interventions pipelines)."""
+    if mesh is None:
+        return 0
+    dp = mesh.shape.get("dp", 1)
+    return (-rows) % dp if dp > 1 else 0
+
+
+def pad_rows(x, pad: int) -> np.ndarray:
+    """Repeat the last row ``pad`` times along axis 0 (host-side)."""
+    x = np.asarray(x)
+    if not pad:
+        return x
+    return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+
+
 # ---------------------------------------------------------------------------
 # TP-aware distributed top-k (the lens readout's merge step).
 # ---------------------------------------------------------------------------

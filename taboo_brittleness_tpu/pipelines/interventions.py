@@ -171,18 +171,43 @@ _nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
 
 
 def _dp_sharding(mesh, ndim: int, rows: int):
-    """NamedSharding placing the leading (row) axis over the mesh's dp axis,
-    or None when there is no mesh / dp does not divide the rows.  Placing the
-    batch is all SPMD needs: params are already placed by the checkpoint
-    loader, and jit propagates shardings through the compiled programs."""
+    """NamedSharding placing the leading (row) axis over the mesh's dp axis
+    (None when there is no mesh / no dp axis).  Placing the batch is all SPMD
+    needs: params are already placed by the checkpoint loader, and jit
+    propagates shardings through the compiled programs.
+
+    Rows that do not divide dp are a hard error, never a silent fallback: the
+    callers pad their row axis to the dp multiple first (``_dp_pad`` /
+    ``_pad_rows``, mirroring ``analyze_word_on_device``), so a 110-row launch
+    on a dp=4 mesh runs *sharded* instead of quietly single-device."""
     if mesh is None:
         return None
     dp = mesh.shape.get("dp", 1)
-    if dp <= 1 or rows % dp:
+    if dp <= 1:
         return None
+    if rows % dp:
+        raise ValueError(
+            f"{rows} rows do not divide the mesh's dp={dp}; pad the row axis "
+            "first (repeat-last-row, strip after) — dp sharding is never "
+            "dropped silently")
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
+
+
+def _dp_pad(mesh, rows: int) -> int:
+    """Rows to append so ``rows`` divides the mesh's dp axis — the shared
+    repeat-last-row recipe (parallel.mesh.dp_pad), also used by
+    ``logit_lens.analyze_word_on_device``."""
+    from taboo_brittleness_tpu.parallel.mesh import dp_pad
+
+    return dp_pad(mesh, rows)
+
+
+def _pad_rows(x, pad: int) -> np.ndarray:
+    from taboo_brittleness_tpu.parallel.mesh import pad_rows
+
+    return pad_rows(x, pad)
 
 
 def _place_rows(x, mesh):
@@ -245,33 +270,41 @@ def prepare_word_state(
     *,
     mesh: Any = None,
 ) -> WordState:
-    """Baseline (unedited) pass over all hint prompts of one word."""
+    """Baseline (unedited) pass over all hint prompts of one word.
+
+    When the prompt count does not divide the mesh's dp axis, the batch pads
+    (repeating the last prompt) so the launch still runs sharded, and every
+    per-row output strips back to the real prompts — dp sharding is never
+    dropped silently (same recipe as ``logit_lens.analyze_word_on_device``)."""
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
+    B = len(config.prompts)
+    pad = _dp_pad(mesh, B)
+    prompts = list(config.prompts) + [config.prompts[-1]] * pad
     dec, texts, prompt_ids = decode.generate(
-        params, cfg, tok, list(config.prompts),
+        params, cfg, tok, prompts,
         max_new_tokens=config.experiment.max_new_tokens,
         pad_to_multiple=config.experiment.pad_to_multiple,
         capture_residual_layer=layer_idx,
-        input_sharding=_dp_sharding(mesh, 2, len(config.prompts)))
+        input_sharding=_dp_sharding(mesh, 2, B + pad))
     layout = decode.response_layout(dec)
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
-    B = seqs.shape[0]
+    rows = seqs.shape[0]
 
     tid = target_token_id(tok, word)
     out = _residual_measure(
         params, cfg, dec.residual, _place_rows(seqs, mesh),
         _place_rows(resp.astype(bool), mesh),
-        _place_rows(np.full((B,), tid, np.int32), mesh), top_k=top_k)
+        _place_rows(np.full((rows,), tid, np.int32), mesh), top_k=top_k)
 
-    target_prob = np.asarray(out["tap_prob"])                  # [B, T]
-    secret_prob = float(np.asarray(out["row_prob_sum"]).sum()
-                        / max(float(np.asarray(out["row_resp"]).sum()), 1.0))
+    target_prob = np.asarray(out["tap_prob"])[:B]              # [B, T]
+    secret_prob = float(np.asarray(out["row_prob_sum"])[:B].sum()
+                        / max(float(np.asarray(out["row_resp"])[:B].sum()), 1.0))
 
     spikes = jax.vmap(
         lambda t, m: lens.spike_positions(t, m, top_k=config.intervention.spike_top_k)
-    )(jnp.asarray(target_prob), jnp.asarray(resp))
+    )(jnp.asarray(target_prob), jnp.asarray(resp[:B]))
     spike_pos = np.asarray(spikes[0])
 
     # next_mask[t] = True iff position t predicts a response token at t+1.
@@ -280,16 +313,16 @@ def prepare_word_state(
     nll = np.asarray(_nll_jit(
         params, cfg, _place_rows(seqs, mesh),
         _place_rows(valid.astype(bool), mesh),
-        _place_rows(positions, mesh), _place_rows(next_mask, mesh)))
+        _place_rows(positions, mesh), _place_rows(next_mask, mesh)))[:B]
 
-    guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"]))
+    guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"])[:B])
 
     return WordState(
         word=word, target_id=int(tid),
-        sequences=seqs, valid=valid, positions=positions,
-        response_mask=resp, residual=np.asarray(dec.residual),
+        sequences=seqs[:B], valid=valid[:B], positions=positions[:B],
+        response_mask=resp[:B], residual=np.asarray(dec.residual)[:B],
         secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
-        response_texts=texts, guesses=guesses,
+        response_texts=texts[:B], guesses=guesses,
     )
 
 
@@ -305,15 +338,59 @@ def score_latents_for_word(
     state: WordState,
     sae: sae_ops.SAEParams,
     params: Params,
+    *,
+    config: Optional[Config] = None,
+    cfg: Optional[Gemma2Config] = None,
 ) -> np.ndarray:
-    """[S] targeting scores: mean SAE activation at spike positions × positive
-    alignment of each latent's decoder row with the secret unembedding."""
+    """[S] targeting scores = mean SAE activation at spike positions × positive
+    "relatedness to the secret" (Execution Plan scoring section).
+
+    ``config.intervention.scoring`` selects the relatedness estimator:
+
+    - ``"correlation"`` (the plan's estimator, default): Pearson correlation of
+      each latent's activation with the secret token's lens logit over the
+      baseline *response* positions — the calibration data the plan
+      prescribes, all of which the baseline pass already captured
+      (``state.residual`` holds every position's tap-layer residual).
+    - ``"cosine"``: data-free proxy — cosine of the latent's decoder row with
+      the secret unembedding (``sae_ops.latent_secret_alignment``).  Same sign
+      structure, but a *different estimator* that can rank latents differently
+      on a real model; kept as the documented fallback.
+
+    ``cfg`` (the model architecture) is only needed for the correlation path
+    (final-norm lens logit); omitted → falls back to the raw-residual dot
+    product with the secret unembedding, which has identical correlation
+    structure up to the per-position RMS scale.
+    """
+    scoring = config.intervention.scoring if config is not None else "cosine"
     B, K = state.spike_pos.shape
     spikes = state.residual[np.arange(B)[:, None], state.spike_pos]  # [B, K, D]
-    acts = np.asarray(sae_ops.encode(sae, jnp.asarray(spikes.reshape(B * K, -1))))
-    align = np.asarray(sae_ops.latent_secret_alignment(
-        sae, params["embed"], jnp.asarray(state.target_id)))
-    return np.asarray(sae_ops.score_latents(jnp.asarray(acts), jnp.asarray(align)))
+    acts = sae_ops.encode(sae, jnp.asarray(spikes.reshape(B * K, -1)))
+
+    if scoring == "cosine":
+        rel = sae_ops.latent_secret_alignment(
+            sae, params["embed"], jnp.asarray(state.target_id))
+    elif scoring == "correlation":
+        D = state.residual.shape[-1]
+        h = jnp.asarray(state.residual.reshape(-1, D))            # [N, D]
+        if cfg is not None:
+            from taboo_brittleness_tpu.models.gemma2 import rms_norm
+
+            x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+        else:
+            x = h
+        u = params["embed"][state.target_id].astype(jnp.float32)  # [D]
+        secret_logit = x.astype(jnp.float32) @ u                  # [N]
+        # Streamed: the [N, S] calibration-activation matrix (multi-GB at
+        # 9B x wide-SAE scale) never materializes, only O(S) moments.
+        rel = sae_ops.latent_secret_correlation_stream(
+            sae, h, secret_logit,
+            jnp.asarray(state.response_mask.reshape(-1)))
+    else:
+        raise ValueError(
+            f"unknown intervention.scoring {scoring!r}; "
+            "expected 'correlation' or 'cosine'")
+    return np.asarray(sae_ops.score_latents(acts, rel))
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +420,15 @@ def _with_chunk_positions(ep: Any, chunk_positions) -> Any:
 # arm count when arms fold into the row axis): the spike-mask mode and the
 # explicit [B, T] position-mask mode of _at_layer.
 _PER_PROMPT_KEYS = ("spike_positions", "positions")
+
+# Default max arms per batched launch when neither the caller nor the config
+# bounds it.  22 arms x 10 prompts = 220 rows: two full budget cells
+# (1 targeted + 10 random each) share one decode launch, which amortizes the
+# latency-bound sequential decode phase (VERDICT round-3: arm-seconds
+# 0.285/0.187/0.163 at 4/8/11 arms — rows keep paying off) while the row
+# count stays inside one chip's HBM at 9B shapes (~6 GB KV + ~1.8 GB captured
+# residual next to the tp-sharded params).
+_DEFAULT_ARM_CHUNK = 22
 
 
 def _tile_rows_ep(shared_ep: Any, per_arm: Dict[str, Any], n_arms: int,
@@ -386,19 +472,29 @@ def _measure_rows(
     A, B = n_arms, state.sequences.shape[0]
     valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
 
+    # Pad the row axis (repeating the last row) to the dp multiple so the
+    # launch always runs sharded; pad rows are stripped by the per-arm slices
+    # below (they sit past the last real arm).
+    pad = _dp_pad(mesh, A * B)
+
+    def pad_per_row(v):
+        """Pad + place arrays whose leading axis is the A*B row axis."""
+        if getattr(v, "ndim", 0) >= 1 and v.shape[0] == A * B:
+            return _place_rows(_pad_rows(v, pad), mesh)
+        return v
+
+    rows_ep_p = jax.tree_util.tree_map(pad_per_row, rows_ep)
+
     # (a) Regenerate under the edit — every arm's rows in one decode launch;
     # the tap-layer residual (post-edit) rides out on the decode's carry tap.
     dec, texts, _ = decode.generate(
-        params, cfg, tok, list(config.prompts) * A,
+        params, cfg, tok, list(config.prompts) * A + [config.prompts[-1]] * pad,
         max_new_tokens=config.experiment.max_new_tokens,
         pad_to_multiple=config.experiment.pad_to_multiple,
         edit_fn=edit_fn,
-        edit_params=jax.tree_util.tree_map(
-            lambda v: _place_rows(v, mesh)
-            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == A * B else v,
-            rows_ep),
+        edit_params=rows_ep_p,
         capture_residual_layer=layer_idx,
-        input_sharding=_dp_sharding(mesh, 2, A * B))
+        input_sharding=_dp_sharding(mesh, 2, A * B + pad))
     layout = decode.response_layout(dec)
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
@@ -418,13 +514,16 @@ def _measure_rows(
     # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
     next_mask = np.zeros_like(state.response_mask)
     next_mask[:, :-1] = state.response_mask[:, 1:]
-    base_pos = np.tile(state.positions, (A, 1))
+    base_pos = _pad_rows(np.tile(state.positions, (A, 1)), pad)
     edited_nll = np.asarray(_nll_jit(
-        params, cfg, _place_rows(np.tile(state.sequences, (A, 1)), mesh),
-        _place_rows(np.tile(state.valid, (A, 1)).astype(bool), mesh),
+        params, cfg,
+        _place_rows(_pad_rows(np.tile(state.sequences, (A, 1)), pad), mesh),
+        _place_rows(_pad_rows(np.tile(state.valid, (A, 1)), pad).astype(bool),
+                    mesh),
         _place_rows(base_pos, mesh),
-        _place_rows(np.tile(next_mask, (A, 1)), mesh), edit_fn=edit_fn,
-        edit_params=_with_chunk_positions(rows_ep, base_pos)))
+        _place_rows(_pad_rows(np.tile(next_mask, (A, 1)), pad), mesh),
+        edit_fn=edit_fn,
+        edit_params=_with_chunk_positions(rows_ep_p, base_pos)))
 
     row_prob_sum = np.asarray(out["row_prob_sum"])
     row_resp = np.asarray(out["row_resp"])
@@ -488,13 +587,18 @@ def measure_arms(
     ``per_arm`` holds the arm-varying arrays with a leading arm axis (e.g.
     ``latent_ids`` [A, m] or ``basis`` [A, D, r]); ``shared_ep`` holds the
     rest (SAE weights, layer, spike positions).  Arms fold into the row axis
-    in chunks of ``arm_chunk`` (default: all A at once) to bound the decode
-    batch; at 9B with B=10 prompts, 11 arms = 110 rows ≈ 3 GB of KV cache —
-    fine under tp sharding, chunk on a single chip if HBM is tight.
+    in chunks of ``arm_chunk`` (default: ``_DEFAULT_ARM_CHUNK``, sized so a
+    whole sweep's arm stack — all budgets at once — launches a few budgets'
+    worth of rows at a time): more rows per launch amortize the
+    latency-bound sequential decode (measured arm-seconds on v5e:
+    0.285/0.187/0.163/0.125 at 4/8/11/22 arms of 10 prompts), while the
+    chunk bound keeps the decode batch inside HBM (at 9B with B=10, 22 arms
+    = 220 rows ≈ 6 GB of KV cache — fine under tp sharding).
     """
     A = int(next(iter(per_arm.values())).shape[0])
     B = state.sequences.shape[0]
-    chunk = arm_chunk or getattr(config.intervention, "arm_chunk", None) or A
+    chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
+             or min(A, _DEFAULT_ARM_CHUNK))
 
     results: List[ArmResult] = []
     for s in range(0, A, chunk):
@@ -553,7 +657,7 @@ def run_ablation_sweep(
     position (spike masks are keyed to the hint prompts' layouts and don't
     transfer to forcing dialogues).
     """
-    scores = score_latents_for_word(state, sae, params)
+    scores = score_latents_for_word(state, sae, params, config=config, cfg=cfg)
     order = np.argsort(-scores)
     S = scores.shape[0]
     rng = np.random.default_rng(config.experiment.seed if seed is None else seed)
@@ -569,18 +673,31 @@ def run_ablation_sweep(
         row[:len(ids)] = ids
         return row
 
-    out: Dict[str, Any] = {"word": state.word, "budgets": {}}
+    # ALL budgets' arms in ONE stack: the id rows are budget-padded anyway, so
+    # nothing distinguishes budgets at launch time — measure_arms folds the
+    # stack into the row axis arm_chunk arms at a time, i.e. several budgets
+    # share each decode launch instead of one launch per budget (VERDICT
+    # round-3 item 2: more rows amortize the latency-bound decode).
+    budgets = list(config.intervention.budgets)
+    R = config.intervention.random_trials
     targeted_rows: List[np.ndarray] = []
-    for m in config.intervention.budgets:
-        arm_ids = [pad_ids(order[:m])]
-        for _ in range(config.intervention.random_trials):
+    arm_ids: List[np.ndarray] = []
+    for m in budgets:
+        t_row = pad_ids(order[:m])         # the exact row the arm scores
+        targeted_rows.append(t_row)
+        arm_ids.append(t_row)
+        for _ in range(R):
             arm_ids.append(pad_ids(rng.choice(S, size=m, replace=False)))
-        per_arm = {"latent_ids": jnp.asarray(np.stack(arm_ids), jnp.int32)}
-        arms = measure_arms(params, cfg, tok, config, state,
-                            sae_ablation_edit, shared, per_arm, mesh=mesh)
-        targeted, randoms = arms[0], arms[1:]
-        targeted_rows.append(arm_ids[0])   # the exact row the arm scored
+    per_arm = {"latent_ids": jnp.asarray(np.stack(arm_ids), jnp.int32)}
+    arms = measure_arms(params, cfg, tok, config, state,
+                        sae_ablation_edit, shared, per_arm, mesh=mesh)
 
+    out: Dict[str, Any] = {"word": state.word,
+                           "scoring": config.intervention.scoring,
+                           "budgets": {}}
+    for i, m in enumerate(budgets):
+        block = arms[i * (R + 1):(i + 1) * (R + 1)]
+        targeted, randoms = block[0], block[1:]
         out["budgets"][str(m)] = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
@@ -598,9 +715,16 @@ def run_ablation_sweep(
             params, cfg, tok, config, state.word, sae_ablation_edit,
             {"sae": sae, "layer": config.model.layer_idx}, per_arm_forcing,
             arm_chunk=config.intervention.arm_chunk)
-        out["baseline_forcing"] = res[0]
+        # Forcing dialogues have their own layouts, so spike masks (keyed to
+        # the hint prompts) do not transfer: the forcing edit always applies
+        # at every position.  Stamp the scope so a spike-masked sweep's
+        # brittleness score and its forcing score can't be conflated as the
+        # same edit footprint (ADVICE round-3).
+        scope = {"edit": "all-positions"}
+        out["baseline_forcing"] = {**res[0], "edit": "none"}
         for i, m in enumerate(config.intervention.budgets):
-            out["budgets"][str(m)]["targeted"]["forcing"] = res[i + 1]
+            out["budgets"][str(m)]["targeted"]["forcing"] = {**res[i + 1],
+                                                             **scope}
     return out
 
 
@@ -630,23 +754,31 @@ def run_projection_sweep(
     D = spikes.shape[1]
 
     # Zero-padded columns are inert in remove_subspace, so every rank's launch
-    # shares one compiled program at max rank.
+    # shares one compiled program at max rank — and, as in the ablation sweep,
+    # ALL ranks' arms stack into one batch that measure_arms folds arm_chunk
+    # arms at a time (several ranks per decode launch).
     def pad_cols(u) -> jnp.ndarray:
         return jnp.pad(u, ((0, 0), (0, max_rank - u.shape[1])))
 
-    out: Dict[str, Any] = {"word": state.word, "ranks": {}}
+    ranks = list(config.intervention.ranks)
+    R = config.intervention.random_trials
     targeted_bases: List[jnp.ndarray] = []
-    for r_i, r in enumerate(config.intervention.ranks):
-        bases = [pad_cols(u_full[:, :r])]
+    bases: List[jnp.ndarray] = []
+    for r_i, r in enumerate(ranks):
+        t_basis = pad_cols(u_full[:, :r])  # the exact basis the arm scores
+        targeted_bases.append(t_basis)
+        bases.append(t_basis)
         for t in range(config.intervention.random_trials):
             key = jax.random.PRNGKey(rng_seed * 1000 + r_i * 100 + t)
             bases.append(pad_cols(projection.random_subspace(key, D, r)))
-        per_arm = {"basis": jnp.stack(bases)}                 # [A, D, rmax]
-        arms = measure_arms(params, cfg, tok, config, state,
-                            projection_edit, shared, per_arm, mesh=mesh)
-        targeted, randoms = arms[0], arms[1:]
-        targeted_bases.append(bases[0])    # the exact basis the arm scored
+    per_arm = {"basis": jnp.stack(bases)}                     # [A, D, rmax]
+    arms = measure_arms(params, cfg, tok, config, state,
+                        projection_edit, shared, per_arm, mesh=mesh)
 
+    out: Dict[str, Any] = {"word": state.word, "ranks": {}}
+    for i, r in enumerate(ranks):
+        block = arms[i * (R + 1):(i + 1) * (R + 1)]
+        targeted, randoms = block[0], block[1:]
         out["ranks"][str(r)] = {
             "targeted": dataclasses.asdict(targeted),
             "random_mean": _mean_arms(randoms),
@@ -665,7 +797,10 @@ def run_projection_sweep(
             {"basis": jnp.stack(targeted_bases)},
             arm_chunk=config.intervention.arm_chunk)
         for i, r in enumerate(config.intervention.ranks):
-            out["ranks"][str(r)]["targeted"]["forcing"] = res[i]
+            # Spike masks don't transfer to forcing dialogues (see the
+            # ablation sweep): stamp the every-position scope.
+            out["ranks"][str(r)]["targeted"]["forcing"] = {
+                **res[i], "edit": "all-positions"}
     return out
 
 

@@ -134,14 +134,12 @@ def analyze_word_on_device(
 
     # The tp lens path shards the batch over dp; pad (repeating the last row,
     # stripped below) so any number of cache-missing prompts divides.
-    pad_rows = (-B) % mesh.shape.get("dp", 1) if mesh is not None else 0
+    from taboo_brittleness_tpu.parallel.mesh import dp_pad, pad_rows as _pr
+
+    pad_rows = dp_pad(mesh, B)
 
     def padded(x):
-        if not pad_rows:
-            return np.asarray(x)
-        return np.concatenate(
-            [np.asarray(x), np.repeat(np.asarray(x)[-1:], pad_rows, axis=0)],
-            axis=0)
+        return _pr(x, pad_rows)
 
     Bp = B + pad_rows
     target_ids = jnp.full((Bp,), tid, jnp.int32)

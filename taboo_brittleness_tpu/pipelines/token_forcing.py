@@ -240,6 +240,8 @@ def run_token_forcing(
     words: Optional[Sequence[str]] = None,
     modes: Sequence[str] = ("pregame", "postgame"),
     output_path: Optional[str] = None,
+    output_dir: Optional[str] = None,
+    force: bool = False,
     edit_fn: Optional[Callable] = None,
     edit_params: Any = None,
 ) -> Dict[str, Any]:
@@ -249,22 +251,61 @@ def run_token_forcing(
     ``edit_fn``/``edit_params`` run the whole sweep under an intervention arm
     (ablated / projected model) — the Execution Plan measures forcing success
     per arm, so the driver composes this with the intervention sweeps.
+
+    Resumable exactly like ``run_intervention_studies``: with ``output_dir``
+    each word's results write atomically to ``<output_dir>/<word>.json`` as
+    soon as they exist, and a word whose file exists is skipped (its model is
+    never loaded) — a crash at word 19 of 20 costs one word, not the sweep.
+    Pass ``force`` to redo.  ``output_path`` (the aggregate JSON) also writes
+    atomically, last.
     """
+    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
     words = list(words if words is not None else config.words)
-    results: Dict[str, Any] = {w: {} for w in words}
+
+    def word_path(w: str) -> Optional[str]:
+        return os.path.join(output_dir, f"{w}.json") if output_dir else None
+
+    def load_done(w: str) -> Optional[Dict[str, Any]]:
+        """The word's saved entry, or None if it must (re)run.  A file from a
+        narrower-modes run does NOT count as done: resuming with more modes
+        re-measures the word instead of crashing at aggregation on the
+        missing key."""
+        p = word_path(w)
+        if p is None or force or not os.path.exists(p):
+            return None
+        with open(p) as f:
+            entry = json.load(f)
+        return entry if all(m in entry for m in modes) else None
+
+    def done(w: str) -> bool:
+        return load_done(w) is not None
+
+    results: Dict[str, Any] = {}
     for i, word in enumerate(words):
+        saved = load_done(word)
+        if saved is not None:
+            results[word] = saved
+            continue
         params, cfg, tok = model_loader(word)
-        prefetch_next(model_loader, words, i)  # overlap next word's IO
+        # Overlap the next *running* word's checkpoint IO with this word's
+        # compute (a to-be-skipped word would pin the pending slot forever).
+        todo = [w for w in words[i + 1:] if not done(w)]
+        if todo:
+            prefetch_next(model_loader, [word, todo[0]], 0)
+        entry: Dict[str, Any] = {}
         if "pregame" in modes:
-            results[word]["pregame"] = pregame_forcing(
+            entry["pregame"] = pregame_forcing(
                 params, cfg, tok, config, word,
                 edit_fn=edit_fn, edit_params=edit_params)
         if "postgame" in modes:
-            results[word]["postgame"] = postgame_forcing(
+            entry["postgame"] = postgame_forcing(
                 params, cfg, tok, config, word,
                 edit_fn=edit_fn, edit_params=edit_params)
+        results[word] = entry
+        if output_dir:
+            _atomic_json_dump(entry, word_path(word))
 
     overall = {
         mode: float(np.mean([results[w][mode]["success_rate"] for w in words]))
@@ -272,7 +313,5 @@ def run_token_forcing(
     }
     out = {"overall": overall, "words": results}
     if output_path:
-        os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
-        with open(output_path, "w") as f:
-            json.dump(out, f, indent=2)
+        _atomic_json_dump(out, output_path)
     return out

@@ -144,6 +144,78 @@ def test_spike_masked_sweep_runs_and_differs(setup):
     assert t_m["delta_nll"] != pytest.approx(t_f["delta_nll"], abs=1e-9)
 
 
+def test_latent_scoring_estimators(setup):
+    """Both Execution-Plan scoring estimators run and differ; the sweep JSON
+    records which one targeted the latents (VERDICT round-3 item 7)."""
+    import dataclasses as dc
+
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+
+    corr = iv.score_latents_for_word(state, sae, params, config=config, cfg=cfg)
+    cos_cfg = dc.replace(config, intervention=dc.replace(
+        config.intervention, scoring="cosine"))
+    cos = iv.score_latents_for_word(state, sae, params, config=cos_cfg, cfg=cfg)
+    assert corr.shape == cos.shape == (sae.d_sae,)
+    assert np.all(corr >= 0.0) and np.all(cos >= 0.0)  # max(0, rel) clamps
+    # Different estimators -> different score vectors (rankings CAN differ).
+    assert not np.allclose(corr, cos)
+    # Deterministic: same inputs, same scores.
+    np.testing.assert_array_equal(
+        corr, iv.score_latents_for_word(state, sae, params, config=config,
+                                        cfg=cfg))
+
+    with pytest.raises(ValueError, match="unknown intervention.scoring"):
+        bad = dc.replace(config, intervention=dc.replace(
+            config.intervention, scoring="nope"))
+        iv.score_latents_for_word(state, sae, params, config=bad, cfg=cfg)
+
+    res = iv.run_ablation_sweep(params, cfg, tok, config, state, sae)
+    assert res["scoring"] == "correlation"
+    res_cos = iv.run_ablation_sweep(params, cfg, tok, cos_cfg, state, sae)
+    assert res_cos["scoring"] == "cosine"
+
+
+def test_latent_secret_correlation_matches_numpy(setup):
+    """Weighted Pearson op vs a plain numpy oracle on the weighted subset."""
+    from taboo_brittleness_tpu.ops.sae import latent_secret_correlation
+
+    rng = np.random.default_rng(0)
+    N, S = 40, 7
+    acts = rng.normal(size=(N, S)).astype(np.float32)
+    y = rng.normal(size=(N,)).astype(np.float32)
+    w = (rng.random(N) > 0.3).astype(np.float32)
+    got = np.asarray(latent_secret_correlation(
+        jnp.asarray(acts), jnp.asarray(y), jnp.asarray(w)))
+    sel = w > 0
+    want = np.array([np.corrcoef(acts[sel, s], y[sel])[0, 1] for s in range(S)])
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    # A latent that IS the secret logit correlates at +1; its negation at -1.
+    acts2 = np.stack([y, -y], axis=1)
+    got2 = np.asarray(latent_secret_correlation(
+        jnp.asarray(acts2), jnp.asarray(y), jnp.ones(N, np.float32)))
+    np.testing.assert_allclose(got2, [1.0, -1.0], atol=1e-4)
+
+
+def test_latent_secret_correlation_stream_matches_dense(setup):
+    """The streamed (encode-fused, chunked-moment) product path must agree
+    with the dense oracle — including when N does not divide the chunk."""
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+
+    params, cfg, tok, config, sae = setup
+    rng = np.random.default_rng(1)
+    N = 37                                    # does not divide chunk=8
+    x = rng.normal(size=(N, cfg.hidden_size)).astype(np.float32)
+    y = rng.normal(size=(N,)).astype(np.float32)
+    w = (rng.random(N) > 0.25).astype(np.float32)
+    dense = sae_ops.latent_secret_correlation(
+        sae_ops.encode(sae, jnp.asarray(x)), jnp.asarray(y), jnp.asarray(w))
+    stream = sae_ops.latent_secret_correlation_stream(
+        sae, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), chunk=8)
+    np.testing.assert_allclose(np.asarray(stream), np.asarray(dense),
+                               atol=2e-4)
+
+
 def test_full_study_writes_json(setup, tmp_path):
     params, cfg, tok, config, sae = setup
     out = str(tmp_path / "study.json")
@@ -398,6 +470,49 @@ def test_measure_arms_dp_mesh_matches_single_device(setup, spike_masked):
         assert a.delta_nll == pytest.approx(b.delta_nll, abs=1e-5)
 
 
+def test_dp_mesh_pads_non_dividing_rows(setup):
+    """Rows that do NOT divide dp must still run sharded (padded to the dp
+    multiple, pad rows stripped) with results identical to single-device —
+    never a silent unsharded fallback (VERDICT round-3 item 6)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+
+    params, cfg, tok, config, sae = setup
+    m = meshlib.make_mesh(MeshConfig(dp=-1, tp=1, sp=1))
+    dp = m.shape["dp"]
+
+    # The silent-fallback hole is closed at the source: non-dividing rows are
+    # a hard error in _dp_sharding, so no caller can quietly run unsharded.
+    assert iv._dp_sharding(m, 2, dp * 2) is not None
+    with pytest.raises(ValueError, match="dp sharding is never dropped"):
+        iv._dp_sharding(m, 2, dp * 2 + 1)
+
+    # 3 arms x 2 prompts = 6 rows on a dp=8 mesh (6 % 8 != 0) -> pads to 8.
+    state_plain = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    state_mesh = iv.prepare_word_state(params, cfg, tok, config, WORD, mesh=m)
+    assert state_mesh.sequences.shape == state_plain.sequences.shape
+    np.testing.assert_array_equal(state_mesh.sequences, state_plain.sequences)
+    assert state_mesh.secret_prob == pytest.approx(state_plain.secret_prob,
+                                                   abs=1e-5)
+    np.testing.assert_allclose(state_mesh.baseline_nll,
+                               state_plain.baseline_nll, atol=1e-4)
+
+    shared = {"sae": sae, "layer": config.model.layer_idx}
+    ids = np.asarray([[0, -1], [3, 7], [5, -1]], np.int32)  # 3 arms
+    plain = iv.measure_arms(params, cfg, tok, config, state_plain,
+                            iv.sae_ablation_edit, shared, {"latent_ids": ids})
+    sharded = iv.measure_arms(params, cfg, tok, config, state_plain,
+                              iv.sae_ablation_edit, shared,
+                              {"latent_ids": ids}, mesh=m)
+    assert len(sharded) == 3
+    for a, b in zip(plain, sharded):
+        assert a.guesses == b.guesses
+        assert a.secret_prob == pytest.approx(b.secret_prob, abs=1e-5)
+        assert a.delta_nll == pytest.approx(b.delta_nll, abs=1e-5)
+
+
 def test_study_with_forcing_per_targeted_arm(setup, tmp_path):
     """forcing=True composes the token-forcing attacks with each targeted
     edit arm (Execution Plan: elicitation robustness measured per arm)."""
@@ -419,12 +534,17 @@ def test_study_with_forcing_per_targeted_arm(setup, tmp_path):
         params, cfg, tok, fast, WORD, sae,
         output_path=str(tmp_path / "s.json"), forcing=True)
 
-    assert set(res["baseline"]["forcing"]) == {"pregame", "postgame"}
+    assert set(res["baseline"]["forcing"]) == {"pregame", "postgame", "edit"}
+    assert res["baseline"]["forcing"]["edit"] == "none"
     t = res["ablation"]["budgets"]["1"]["targeted"]
-    assert set(t["forcing"]) == {"pregame", "postgame"}
-    assert all(0.0 <= v <= 1.0 for v in t["forcing"].values())
+    assert set(t["forcing"]) == {"pregame", "postgame", "edit"}
+    # The forcing edit always applies at every position (spike masks are
+    # keyed to the hint prompts' layouts) — the stored scope must say so.
+    assert t["forcing"]["edit"] == "all-positions"
+    assert all(0.0 <= t["forcing"][m] <= 1.0 for m in ("pregame", "postgame"))
     # random controls don't pay the forcing cost
     assert "forcing" not in res["ablation"]["budgets"]["1"]["random"][0]
     p = res["projection"]["ranks"]["1"]["targeted"]
-    assert set(p["forcing"]) == {"pregame", "postgame"}
+    assert set(p["forcing"]) == {"pregame", "postgame", "edit"}
+    assert p["forcing"]["edit"] == "all-positions"
 

@@ -70,6 +70,77 @@ def test_run_token_forcing_overall(setup, tmp_path):
         assert json.load(f)["overall"] == res["overall"]
 
 
+def test_run_token_forcing_resumable(setup, tmp_path):
+    """Kill/resume: per-word results persist atomically as soon as they exist,
+    and a resumed sweep skips completed words without reloading their models
+    (VERDICT round-3 item 8)."""
+    import json
+    import os
+
+    params, cfg, tok, config = setup
+    out = str(tmp_path / "forcing.json")
+    words_dir = str(tmp_path / "words")
+    loads = []
+
+    class Crash(RuntimeError):
+        pass
+
+    def crashing_loader(w):
+        loads.append(w)
+        if w == "word2":
+            raise Crash("killed mid-sweep")  # word 2 of 2 dies
+        return params, cfg, tok
+
+    config2 = Config(
+        model=config.model, experiment=config.experiment,
+        word_plurals={WORD: [WORD], "word2": ["word2"]},
+        prompts=config.prompts, token_forcing=config.token_forcing)
+    with pytest.raises(Crash):
+        tf.run_token_forcing(
+            config2, model_loader=crashing_loader, words=[WORD, "word2"],
+            modes=("pregame",), output_path=out, output_dir=words_dir)
+    # The completed word's JSON survived the crash; the aggregate did not
+    # (it writes last) — but nothing is truncated/corrupt.
+    assert os.path.exists(os.path.join(words_dir, f"{WORD}.json"))
+    assert not os.path.exists(out)
+    with open(os.path.join(words_dir, f"{WORD}.json")) as f:
+        saved = json.load(f)
+    assert saved["pregame"]["word"] == WORD
+
+    # Resume: the finished word is NOT reloaded; only word2 runs.
+    loads.clear()
+
+    def loader(w):
+        loads.append(w)
+        return params, cfg, tok
+
+    res = tf.run_token_forcing(
+        config2, model_loader=loader, words=[WORD, "word2"],
+        modes=("pregame",), output_path=out, output_dir=words_dir)
+    assert loads == ["word2"]
+    assert res["words"][WORD] == saved
+    assert os.path.exists(out)
+    assert set(res["words"]) == {WORD, "word2"}
+
+    # A saved entry from a NARROWER modes run does not count as done: asking
+    # for pregame+postgame re-measures the word instead of crashing on the
+    # missing mode at aggregation.
+    loads.clear()
+    res2 = tf.run_token_forcing(
+        config2, model_loader=loader, words=[WORD],
+        modes=("pregame", "postgame"), output_path=out, output_dir=words_dir)
+    assert loads == [WORD]
+    assert set(res2["words"][WORD]) == {"pregame", "postgame"}
+    assert set(res2["overall"]) == {"pregame", "postgame"}
+    # And the widened entry now satisfies a narrower resume.
+    loads.clear()
+    res3 = tf.run_token_forcing(
+        config2, model_loader=loader, words=[WORD],
+        modes=("pregame",), output_path=out, output_dir=words_dir)
+    assert loads == []
+    assert res3["words"][WORD]["pregame"] == res2["words"][WORD]["pregame"]
+
+
 def test_forcing_success_detects_leak(setup):
     from taboo_brittleness_tpu import metrics as m
     assert m.forcing_success(["My secret word is moon!"], {"moon", "moons"}) == 1.0
