@@ -54,9 +54,9 @@ class LensStats(NamedTuple):
 
 
 def _lens_tile_kernel(
-    target_ref,                  # SMEM (1, 1) int32 — target vocab id
     x_ref,                       # VMEM [RN, D]     — this row block's activations
     e_ref,                       # VMEM [BV, D]     — this tile of the embedding
+    target_ref,                  # VMEM [RN, 1] int32 — per-row target vocab id
     max_ref,                     # out [1, 8, RN]  (8 = sublane pad; row 0 real)
     sumexp_ref,                  # out [1, 8, RN]
     tgt_ref,                     # out [1, 8, RN]
@@ -89,10 +89,12 @@ def _lens_tile_kernel(
     max_ref[0] = jnp.broadcast_to(tile_max[None, :], (8, n))
     sumexp_ref[0] = jnp.broadcast_to(sumexp[None, :], (8, n))
 
-    # Target logit (the target id lives in exactly one tile).
-    tgt = target_ref[0, 0]
-    local = tgt - base
-    hit = (col == local)                                    # [N, BV] bool
+    # Target logit — PER ROW (each row's target id lives in exactly one tile).
+    # A shared scalar target is just the broadcast case; per-row targets are
+    # what lets the teacher-forced NLL readout (lse - next-token logit) ride
+    # this kernel instead of materializing [T, V] logits in HBM.
+    local = target_ref[:, 0] - base                         # [N]
+    hit = (col == local[:, None])                           # [N, BV] bool
     tgt_row = jnp.where(
         jnp.logical_and(local >= 0, local < bv),
         jnp.sum(jnp.where(hit, logits, 0.0), axis=1),
@@ -122,7 +124,7 @@ def _lens_tile_kernel(
 def lens_stats(
     x: jax.Array,            # [N, D] final-norm'd rows (any float dtype)
     embed: jax.Array,        # [V, D] tied embedding / unembedding matrix
-    target_id: jax.Array,    # [] int32 — one target token id for all rows
+    target_id: jax.Array,    # [] or [N] int32 — target token id(s)
     *,
     top_k: int = 5,
     logit_cap: Optional[float] = None,
@@ -137,6 +139,11 @@ def lens_stats(
     (VMEM budget: x-block + double-buffered embed tile + [RN, BV] logits must
     fit 16 MB); N pads to a block_n multiple internally.
 
+    ``target_id`` may be a scalar (one secret token for the whole batch — the
+    lens readout) or per-row ``[N]`` (each position's next token — the
+    teacher-forced NLL readout, whose integrand is exactly
+    ``logsumexp - target_logit``).
+
     ``logit_cap=None`` (default) matches the reference lens: bare logits, no
     final softcap (reference src/models.py:135-138 calls lm_head directly).
     """
@@ -146,10 +153,21 @@ def lens_stats(
         raise ValueError(f"vocab {v} not divisible by block_v {block_v}")
     nt = v // block_v
 
+    target_id = jnp.asarray(target_id, jnp.int32)
+    if target_id.ndim == 0:
+        targets = jnp.full((n_rows,), target_id, jnp.int32)
+    elif target_id.shape == (n_rows,):
+        targets = target_id
+    else:
+        raise ValueError(
+            f"target_id must be scalar or [N={n_rows}], got {target_id.shape}")
+
     block_n = min(block_n, ((n_rows + 7) // 8) * 8)
     n_pad = (-n_rows) % block_n
     if n_pad:
         x = jnp.concatenate([x, jnp.zeros((n_pad, d), x.dtype)], axis=0)
+        targets = jnp.concatenate(
+            [targets, jnp.full((n_pad,), -1, jnp.int32)], axis=0)
     n = n_rows + n_pad
     nr = n // block_n
 
@@ -168,27 +186,24 @@ def lens_stats(
     # and the small x blocks (N x D, a few MB) stream in the inner loop —
     # ~3x less HBM traffic than streaming the whole embedding per row block
     # (measured 1.41 s -> ~0.8 s per 26-layer lens pass at B=48 on v5e).
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nt, nr),
-        in_specs=[
-            pl.BlockSpec((block_n, d), lambda j, i, *_: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_v, d), lambda j, i, *_: (j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n), lambda j, i, *_: (j, 0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i, *_: (j, 0, i, 0), memory_space=pltpu.VMEM),
-        ),
-    )
     tile_max, tile_sumexp, tile_tgt, cand_vals, cand_ids = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        grid_spec=grid_spec,
+        grid=(nt, nr),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_v, d), lambda j, i: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 8, block_n), lambda j, i: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda j, i: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n), lambda j, i: (j, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i: (j, 0, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_n, top_k), lambda j, i: (j, 0, i, 0), memory_space=pltpu.VMEM),
+        ),
         interpret=interpret,
-    )(jnp.reshape(target_id.astype(jnp.int32), (1, 1)), x, embed)
+    )(x, embed, targets[:, None])
 
     # --- XLA epilogue over [NT, N] partials (tiny; drop the sublane pad). ---
     tile_max = tile_max[:, 0]
@@ -223,7 +238,15 @@ def lens_stats_reference(
     if logit_cap is not None:
         logits = jnp.tanh(logits / logit_cap) * logit_cap
     lse = jax.nn.logsumexp(logits, axis=-1)
-    tgt = logits[:, target_id]
+    target_id = jnp.asarray(target_id, jnp.int32)
+    if target_id.ndim == 0:
+        tgt = logits[:, target_id]
+    else:                        # per-row targets (NLL readout); -1 = no target
+        tgt = jnp.where(
+            target_id >= 0,
+            jnp.take_along_axis(
+                logits, jnp.maximum(target_id, 0)[:, None], axis=-1)[:, 0],
+            NEG_INF)
     vals, ids = lax.top_k(logits, top_k)
     return LensStats(logsumexp=lse, target_logit=tgt,
                      topk_vals=vals, topk_ids=ids)

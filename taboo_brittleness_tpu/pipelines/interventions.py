@@ -48,6 +48,7 @@ from taboo_brittleness_tpu import metrics as metrics_mod
 from taboo_brittleness_tpu.config import Config
 from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params, forward
 from taboo_brittleness_tpu.ops import lens, projection, sae as sae_ops
+from taboo_brittleness_tpu.parallel.mesh import dp_pad, pad_rows
 from taboo_brittleness_tpu.runtime import chat, decode
 from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike, target_token_id
 
@@ -126,14 +127,27 @@ class WordState:
     spike_pos: np.ndarray          # [B, K] spike positions per prompt
     response_texts: List[str]
     guesses: List[List[str]]       # baseline LL-Top-k guesses
+    resp_start: int = 0            # first column of the vocab-readout window
+    #                                (= prompt columns - 1; left padding aligns
+    #                                every row's response to the same columns)
 
 
-# Rows per chunk for the [T, V]-shaped readout/NLL intermediates: at Gemma-2
-# vocab scale one row's [T, 256k] f32 slab is ~84 MB (T=82), so 8 rows bound
-# the transient at ~0.7 GB regardless of how many arms fold into the batch
-# (a full-batch vmap at 80 rows would transiently want ~6.7 GB — more than
-# the HBM left next to the 2B-shape params on one v5e chip).
-_ROW_CHUNK = 8
+# Byte budget for the [rows_chunk, T_resp, V]-shaped readout/NLL transients:
+# at Gemma-2 vocab scale one row-column's [256k] f32 slab is 1 MB, so the
+# chunk bounds the transient at ~0.7 GB regardless of how many arms fold into
+# the batch (a full-batch readout at 80 rows x T=82 would transiently want
+# ~6.7 GB — more than the HBM left next to the 2B-shape params on one v5e
+# chip).
+_READOUT_CHUNK_BYTES = 0.7e9
+
+
+def _row_chunk(t_cols: int, vocab: int) -> int:
+    """Rows per lax.map chunk so the [chunk, t_cols, V] f32 transient stays
+    under the budget.  Bigger chunks also mean fewer streams of the V x D
+    embedding through HBM (it is re-read once per chunk), so the chunk is as
+    large as the budget allows, capped to keep tiny-vocab test programs sane."""
+    per_row = max(t_cols * vocab * 4, 1)
+    return max(1, min(32, int(_READOUT_CHUNK_BYTES // per_row)))
 
 
 def _teacher_forced_nll(
@@ -142,32 +156,74 @@ def _teacher_forced_nll(
     next_mask: jax.Array,             # [B, T] True where seqs[:, t+1] is a response token
     edit_fn: Optional[Callable] = None,
     edit_params: Any = None,
+    *,
+    resp_start: int = 0,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Per-position NLL of the *next* token, masked to the response region.
 
     The model forward runs full-batch (per-layer activations are [B, T, D] —
-    cheap); only the vocab-width readout chunks over rows: logsumexp - target
-    logit per chunk, so no [B, T, V] logits or log-softmax tensor ever
-    materializes (two of those at 80 rows is ~13 GB f32)."""
+    cheap).  The vocab-width readout only covers columns that can predict a
+    response token — ``[resp_start, T-1)``, i.e. the last prompt column plus
+    the generated ones (``resp_start`` = prompt columns - 1; left padding puts
+    every row's response in the same columns) — which cuts ~40% of the unembed
+    FLOPs at the sweep's shapes (T=82, 50 new tokens).  The returned [B, T]
+    NLL is zero outside that window, exactly where ``next_mask`` is False.
+
+    ``use_pallas=True`` (TPU, unsharded) computes logsumexp - target via the
+    fused lens kernel: the embedding streams through VMEM once for ALL rows
+    and the [T, V] logits never exist in HBM.  The XLA path chunks rows so
+    the logits transient stays bounded (``_row_chunk``)."""
     bound = (lambda h, i: edit_fn(h, i, edit_params)) if (edit_fn and edit_params is not None) else edit_fn
     res = forward(params, cfg, seqs, positions=positions,
                   attn_validity=valid, edit_fn=bound, compute_logits=False)
-    nxt = jnp.roll(seqs, -1, axis=1)
+    B, T = seqs.shape
+    s = resp_start
+    h_s = res.last_hidden[:, s:T - 1]                       # [B, Ts, D]
+    nxt_s = seqs[:, s + 1:T]                                # [B, Ts]
+    m_s = next_mask[:, s:T - 1]
+    Ts = T - 1 - s
 
-    from taboo_brittleness_tpu.models.gemma2 import unembed
+    from taboo_brittleness_tpu.models.gemma2 import rms_norm, unembed
 
-    def row(args):
-        h, nxt_r, m = args                                  # [T, D], [T], [T]
-        logits = unembed(params, cfg, h[None])[0]           # [T, V] f32
-        tgt = jnp.take_along_axis(logits, nxt_r[:, None], axis=-1)[:, 0]
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        return jnp.where(m, lse - tgt, 0.0)
+    if use_pallas:
+        from taboo_brittleness_tpu.ops import pallas_lens
 
-    return jax.lax.map(row, (res.last_hidden, nxt, next_mask),
-                       batch_size=_ROW_CHUNK)
+        x = rms_norm(h_s.reshape(B * Ts, -1), params["final_norm"],
+                     cfg.rms_norm_eps)
+        stats = pallas_lens.lens_stats(
+            x, params["embed"].astype(cfg.compute_dtype),
+            nxt_s.reshape(B * Ts), top_k=1,
+            logit_cap=cfg.final_logit_softcap,
+            block_v=min(1024, cfg.vocab_size),
+            interpret=jax.default_backend() == "cpu")
+        nll_s = (stats.logsumexp - stats.target_logit).reshape(B, Ts)
+        nll_s = jnp.where(m_s, nll_s, 0.0)
+    else:
+        def row(args):
+            h, nxt_r, m = args                              # [Ts, D], [Ts], [Ts]
+            logits = unembed(params, cfg, h[None])[0]       # [Ts, V] f32
+            tgt = jnp.take_along_axis(logits, nxt_r[:, None], axis=-1)[:, 0]
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            return jnp.where(m, lse - tgt, 0.0)
+
+        nll_s = jax.lax.map(row, (h_s, nxt_s, m_s),
+                            batch_size=_row_chunk(Ts, cfg.vocab_size))
+    return jnp.zeros((B, T), jnp.float32).at[:, s:T - 1].set(nll_s)
 
 
-_nll_jit = jax.jit(_teacher_forced_nll, static_argnames=("cfg", "edit_fn"))
+_nll_jit = jax.jit(_teacher_forced_nll,
+                   static_argnames=("cfg", "edit_fn", "resp_start",
+                                    "use_pallas"))
+
+
+def _nll_use_pallas(params: Params, mesh) -> bool:
+    """Route the NLL readout through the fused kernel when it can run: TPU
+    backend, concrete single-device params, no mesh (the kernel has no GSPMD
+    partitioning rule — sharded launches keep the XLA row-chunk path)."""
+    from taboo_brittleness_tpu.ops.lens import _pallas_auto_ok
+
+    return mesh is None and _pallas_auto_ok(params)
 
 
 def _dp_sharding(mesh, ndim: int, rows: int):
@@ -177,8 +233,8 @@ def _dp_sharding(mesh, ndim: int, rows: int):
     propagates shardings through the compiled programs.
 
     Rows that do not divide dp are a hard error, never a silent fallback: the
-    callers pad their row axis to the dp multiple first (``_dp_pad`` /
-    ``_pad_rows``, mirroring ``analyze_word_on_device``), so a 110-row launch
+    callers pad their row axis to the dp multiple first (``dp_pad`` /
+    ``pad_rows``, mirroring ``analyze_word_on_device``), so a 110-row launch
     on a dp=4 mesh runs *sharded* instead of quietly single-device."""
     if mesh is None:
         return None
@@ -195,28 +251,13 @@ def _dp_sharding(mesh, ndim: int, rows: int):
     return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
 
 
-def _dp_pad(mesh, rows: int) -> int:
-    """Rows to append so ``rows`` divides the mesh's dp axis — the shared
-    repeat-last-row recipe (parallel.mesh.dp_pad), also used by
-    ``logit_lens.analyze_word_on_device``."""
-    from taboo_brittleness_tpu.parallel.mesh import dp_pad
-
-    return dp_pad(mesh, rows)
-
-
-def _pad_rows(x, pad: int) -> np.ndarray:
-    from taboo_brittleness_tpu.parallel.mesh import pad_rows
-
-    return pad_rows(x, pad)
-
-
 def _place_rows(x, mesh):
     arr = jnp.asarray(x)
     sh = _dp_sharding(mesh, arr.ndim, arr.shape[0])
     return arr if sh is None else jax.device_put(arr, sh)
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k"))
+@partial(jax.jit, static_argnames=("cfg", "top_k", "resp_start"))
 def _residual_measure(
     params: Params,
     cfg: Gemma2Config,
@@ -226,6 +267,7 @@ def _residual_measure(
     target_ids: jax.Array,    # [B]
     *,
     top_k: int,
+    resp_start: int = 0,
 ) -> Dict[str, jax.Array]:
     """Tap-layer statistics + in-graph LL-Top-k aggregation straight from the
     residual that ``greedy_decode(capture_residual_layer=...)`` captured.
@@ -233,25 +275,47 @@ def _residual_measure(
     This replaces the sweep's second full-model lens pass entirely: the
     decode already ran the (edited) forward over every position, and the
     sweep consumes only the tap layer — so the measurement left to do is one
-    [T, V] lens readout per row (norm → unembed → softmax → target/top-k),
-    ~1/42nd of the all-layer readout, with zero extra model FLOPs.  vmapped
-    per row inside ONE jitted program so no persistent [B, T, V] buffer
-    exists (same fusion argument as lens.aggregate_from_residual).
+    lens readout per row (norm → unembed → softmax → target/top-k), ~1/42nd
+    of the all-layer readout, with zero extra model FLOPs.  vmapped per row
+    inside ONE jitted program so no persistent [B, T, V] buffer exists (same
+    fusion argument as lens.aggregate_from_residual).
+
+    ``resp_start`` restricts the vocab-width readout to columns that can
+    carry a response token (left padding aligns every row's response to the
+    same columns).  It must be ≤ the first response column MINUS ONE: the
+    aggregation zeroes the PREVIOUS position's token per response position,
+    so the last prompt column must stay inside the slice.  Prompt columns
+    before it contribute nothing (the response mask is False there) — slicing
+    them away cuts ~40% of the readout matmul at sweep shapes.  ``tap_prob``
+    is returned at full [B, T] (zeros before the slice) so spike finding and
+    plotting are unaffected.
+
+    NOT routed through the Pallas lens kernel, deliberately: the aggregation
+    is a top-k over the *position-summed* probabilities, and the sum needs
+    every position's global logsumexp before any probability can be formed —
+    a single fused pass can't have it (the kernel's flash partials produce
+    the lse), and a two-pass kernel would recompute the unembed matmul, which
+    dominates this phase.  The fused kernel serves the phases whose integrand
+    it already computes (decode lens, NLL) instead.
     """
+    B, T = seqs.shape
+    s = resp_start
 
     def one(args):
-        h, ids, m, tgt = args
-        probs = lens.lens_probs(params, cfg, h[None])[0]       # [T, V] f32
-        tgt_p = probs[:, tgt]                                  # [T]
+        h, ids, m, tgt = args                                  # sliced [Ts, ...]
+        probs = lens.lens_probs(params, cfg, h[None])[0]       # [Ts, V] f32
+        tgt_p = probs[:, tgt]                                  # [Ts]
         rm = m.astype(jnp.float32)
         agg_ids, agg_probs = lens.aggregate_masked_sum(
             probs, ids, m, top_k=top_k)
         return tgt_p, jnp.sum(tgt_p * rm), jnp.sum(rm), agg_ids, agg_probs
 
-    # lax.map with a row chunk (not full-batch vmap) bounds the [rows, T, V]
-    # transient — see _ROW_CHUNK.
-    tap_prob, row_sum, row_cnt, agg_ids, agg_probs = jax.lax.map(
-        one, (residual, seqs, resp_mask, target_ids), batch_size=_ROW_CHUNK)
+    # lax.map with a row chunk (not full-batch vmap) bounds the [rows, Ts, V]
+    # transient — see _row_chunk.
+    tap_prob_s, row_sum, row_cnt, agg_ids, agg_probs = jax.lax.map(
+        one, (residual[:, s:], seqs[:, s:], resp_mask[:, s:], target_ids),
+        batch_size=_row_chunk(T - s, cfg.vocab_size))
+    tap_prob = jnp.zeros((B, T), tap_prob_s.dtype).at[:, s:].set(tap_prob_s)
     return {
         "tap_prob": tap_prob,                                  # [B, T]
         "row_prob_sum": row_sum,                               # [B]
@@ -279,7 +343,7 @@ def prepare_word_state(
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     B = len(config.prompts)
-    pad = _dp_pad(mesh, B)
+    pad = dp_pad(mesh, B)
     prompts = list(config.prompts) + [config.prompts[-1]] * pad
     dec, texts, prompt_ids = decode.generate(
         params, cfg, tok, prompts,
@@ -291,12 +355,14 @@ def prepare_word_state(
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
     rows = seqs.shape[0]
+    resp_start = max(layout.prompt_len - 1, 0)
 
     tid = target_token_id(tok, word)
     out = _residual_measure(
         params, cfg, dec.residual, _place_rows(seqs, mesh),
         _place_rows(resp.astype(bool), mesh),
-        _place_rows(np.full((rows,), tid, np.int32), mesh), top_k=top_k)
+        _place_rows(np.full((rows,), tid, np.int32), mesh), top_k=top_k,
+        resp_start=resp_start)
 
     target_prob = np.asarray(out["tap_prob"])[:B]              # [B, T]
     secret_prob = float(np.asarray(out["row_prob_sum"])[:B].sum()
@@ -313,7 +379,8 @@ def prepare_word_state(
     nll = np.asarray(_nll_jit(
         params, cfg, _place_rows(seqs, mesh),
         _place_rows(valid.astype(bool), mesh),
-        _place_rows(positions, mesh), _place_rows(next_mask, mesh)))[:B]
+        _place_rows(positions, mesh), _place_rows(next_mask, mesh),
+        resp_start=resp_start, use_pallas=_nll_use_pallas(params, mesh)))[:B]
 
     guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"])[:B])
 
@@ -322,7 +389,7 @@ def prepare_word_state(
         sequences=seqs[:B], valid=valid[:B], positions=positions[:B],
         response_mask=resp[:B], residual=np.asarray(dec.residual)[:B],
         secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
-        response_texts=texts[:B], guesses=guesses,
+        response_texts=texts[:B], guesses=guesses, resp_start=resp_start,
     )
 
 
@@ -475,12 +542,12 @@ def _measure_rows(
     # Pad the row axis (repeating the last row) to the dp multiple so the
     # launch always runs sharded; pad rows are stripped by the per-arm slices
     # below (they sit past the last real arm).
-    pad = _dp_pad(mesh, A * B)
+    pad = dp_pad(mesh, A * B)
 
     def pad_per_row(v):
         """Pad + place arrays whose leading axis is the A*B row axis."""
         if getattr(v, "ndim", 0) >= 1 and v.shape[0] == A * B:
-            return _place_rows(_pad_rows(v, pad), mesh)
+            return _place_rows(pad_rows(v, pad), mesh)
         return v
 
     rows_ep_p = jax.tree_util.tree_map(pad_per_row, rows_ep)
@@ -499,14 +566,16 @@ def _measure_rows(
     seqs, valid, positions, resp = (layout.sequences, layout.valid,
                                     layout.positions, layout.response_mask)
     rows = seqs.shape[0]
+    resp_start = max(layout.prompt_len - 1, 0)
 
-    # (b) Tap-layer readout from the captured residual — one [T, V] readout
-    # per row, shared by every arm/budget of the sweep (no model FLOPs).
+    # (b) Tap-layer readout from the captured residual — one response-column
+    # readout per row, shared by every arm/budget of the sweep (no model
+    # FLOPs).
     out = _residual_measure(
         params, cfg, dec.residual, _place_rows(seqs, mesh),
         _place_rows(resp.astype(bool), mesh),
         _place_rows(np.full((rows,), state.target_id, np.int32), mesh),
-        top_k=top_k)
+        top_k=top_k, resp_start=resp_start)
     # The readout is dispatched; drop the [rows, T, D] f32 residual reference
     # so its ~0.9 GB (110 rows at 9B) frees before the NLL forward peaks.
     dec = dec._replace(residual=None)
@@ -514,16 +583,18 @@ def _measure_rows(
     # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
     next_mask = np.zeros_like(state.response_mask)
     next_mask[:, :-1] = state.response_mask[:, 1:]
-    base_pos = _pad_rows(np.tile(state.positions, (A, 1)), pad)
+    base_pos = pad_rows(np.tile(state.positions, (A, 1)), pad)
     edited_nll = np.asarray(_nll_jit(
         params, cfg,
-        _place_rows(_pad_rows(np.tile(state.sequences, (A, 1)), pad), mesh),
-        _place_rows(_pad_rows(np.tile(state.valid, (A, 1)), pad).astype(bool),
+        _place_rows(pad_rows(np.tile(state.sequences, (A, 1)), pad), mesh),
+        _place_rows(pad_rows(np.tile(state.valid, (A, 1)), pad).astype(bool),
                     mesh),
         _place_rows(base_pos, mesh),
-        _place_rows(_pad_rows(np.tile(next_mask, (A, 1)), pad), mesh),
+        _place_rows(pad_rows(np.tile(next_mask, (A, 1)), pad), mesh),
         edit_fn=edit_fn,
-        edit_params=_with_chunk_positions(rows_ep_p, base_pos)))
+        edit_params=_with_chunk_positions(rows_ep_p, base_pos),
+        resp_start=state.resp_start,
+        use_pallas=_nll_use_pallas(params, mesh)))
 
     row_prob_sum = np.asarray(out["row_prob_sum"])
     row_resp = np.asarray(out["row_resp"])

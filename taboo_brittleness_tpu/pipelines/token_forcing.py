@@ -291,9 +291,11 @@ def run_token_forcing(
         params, cfg, tok = model_loader(word)
         # Overlap the next *running* word's checkpoint IO with this word's
         # compute (a to-be-skipped word would pin the pending slot forever).
-        todo = [w for w in words[i + 1:] if not done(w)]
-        if todo:
-            prefetch_next(model_loader, [word, todo[0]], 0)
+        # next() stops at the first pending word — no full O(words²) rescan
+        # (and re-parse of every done word's JSON) per iteration.
+        nxt = next((w for w in words[i + 1:] if not done(w)), None)
+        if nxt is not None:
+            prefetch_next(model_loader, [word, nxt], 0)
         entry: Dict[str, Any] = {}
         if "pregame" in modes:
             entry["pregame"] = pregame_forcing(

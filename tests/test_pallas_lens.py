@@ -32,6 +32,33 @@ def test_lens_stats_matches_reference(n_rows, d, v, k, cap):
                                   np.asarray(exp.topk_ids))
 
 
+@pytest.mark.parametrize("cap", [None, 30.0])
+def test_lens_stats_per_row_targets_match_reference(cap):
+    """[N] next-token targets (the NLL readout's shape), incl. -1 = no target
+    and rows whose targets fall in different vocab tiles."""
+    rng = np.random.default_rng(4)
+    n, d, v = 11, 32, 512
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    embed = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
+    targets = jnp.asarray(
+        np.concatenate([rng.integers(0, v, size=n - 2), [-1, v - 1]]),
+        jnp.int32)
+
+    got = pallas_lens.lens_stats(
+        x, embed, targets, top_k=2, logit_cap=cap, block_v=128, interpret=True)
+    exp = pallas_lens.lens_stats_reference(x, embed, targets, top_k=2,
+                                           logit_cap=cap)
+    np.testing.assert_allclose(np.asarray(got.logsumexp),
+                               np.asarray(exp.logsumexp), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.target_logit),
+                               np.asarray(exp.target_logit), rtol=1e-5, atol=1e-5)
+    # lse - target_logit IS the per-position NLL the sweep's third phase needs.
+    nll = np.asarray(got.logsumexp - got.target_logit)[:-2]
+    np.testing.assert_allclose(
+        nll, np.asarray(exp.logsumexp - exp.target_logit)[:-2],
+        rtol=1e-5, atol=1e-5)
+
+
 def test_lens_stats_probabilities_normalize():
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
