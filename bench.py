@@ -22,11 +22,23 @@ prompts/sec.  No faster number is published ("published": {} in BASELINE.json),
 so 0.07 prompts/sec is the reference point; vs_baseline = ours / 0.07.
 
 Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"} plus the
-north-star projection: a measured sweep *budget cell* (decode + readout + NLL
-for a launch of batched arms — the unit the intervention study repeats 10x per
-word) extrapolated to the full 20-word study, per-phase split included, on one
-chip and on a v5e-8 dp mesh ("projected_full_sweep_hours"; BASELINE.json
-north_star is "< 1 h on v5e-8").
+north-star account (BASELINE.json north_star: "< 1 h on v5e-8"), in three
+blocks:
+
+- "sweep": measured sweep launches (decode + readout + NLL, the three
+  compiled programs of pipelines.interventions) at one-cell (11 arms) and
+  production (22 arms) row counts, extrapolated to the full 20-word study on
+  one chip and as a [ideal, derated] v5e-8 band (decode latency intercept +
+  tp=4 ICI collectives charged).
+- "study": the REAL ``run_intervention_studies`` driver run end-to-end on
+  synthetic bench-shape words — "measured_study_seconds_per_word" is a
+  measurement of everything the cell projection extrapolates (host-side
+  scoring, PCA, JSON, figures included).
+- Timing loops interleave the phases within each rep AND regenerate inputs
+  per rep from fresh seeds: the axon TPU runtime dedupes repeated executions
+  with byte-identical inputs (~0.1 ms), which would turn any fixed-input
+  timing loop into fiction; "timing_suspect_dedup" flags any rep under the
+  per-phase floor.
 """
 
 from __future__ import annotations
@@ -111,18 +123,29 @@ def _arm_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
     return f["decode"] + f["lens"]
 
 
-def _sweep_bench(params, cfg, sae, tap_layer: int,
-                 on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
-    """Measure one batched-arm launch of the intervention sweep (decode with
-    in-flight residual capture + tap-layer readout + NLL, the three compiled
-    programs of pipelines.interventions) and project the full study's
-    wall-clock.
+# Per-phase floor (seconds) below which a measured rep is treated as a dedup
+# artifact on the accelerator: every real phase at bench shapes costs >= tens
+# of milliseconds, while a deduped re-execution returns in ~0.1 ms.
+_DEDUP_FLOOR_S = 2e-3
 
-    Study shape (Execution Plan / BASELINE.json): 20 words x (6 ablation
-    budgets + 4 projection ranks) cells, each cell = 1 targeted + 10 random
-    arms over 10 prompts, plus one baseline pass per word.  Arms fold into the
-    row axis (round-3 batching), so the launch below IS the sweep's steady
-    state; per-arm seconds scale linearly in rows until HBM caps the batch.
+# v5e ICI: ~45 GB/s per link per direction; ring all-reduce moves
+# 2*(tp-1)/tp of the payload per chip.  Per-collective launch latency ~1 us.
+_ICI_LINK_BW = 45e9
+_COLL_LATENCY_S = 1e-6
+
+
+def _sweep_phase_times(params, cfg, sae, tap_layer: int, prompt_len: int,
+                       new_tokens: int, arms: int, prompts_per_word: int,
+                       reps: int, use_pallas_nll: bool,
+                       dedup_floor: float = 0.0) -> dict:
+    """Measure the sweep's three compiled programs at ``arms`` arms/launch.
+
+    Dedup-proof by construction (this host's TPU runtime can dedupe repeated
+    executions with byte-identical inputs to ~0.1 ms): every rep regenerates
+    the prompt ids and latent ids from a fresh seed, and the three phases
+    interleave WITHIN each rep — the readout and NLL consume the decode output
+    of their own rep, so no program ever sees the same input buffers twice.
+    A per-rep floor check flags any residual dedup as suspect.
     """
     import jax
     import jax.numpy as jnp
@@ -130,68 +153,185 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
     from taboo_brittleness_tpu.pipelines import interventions as iv
     from taboo_brittleness_tpu.runtime import decode
 
-    prompts_per_word = int(os.environ.get("BENCH_SWEEP_PROMPTS", "10"))
-    # Default = the real sweep's full budget cell (1 targeted + 10 random
-    # arms) in ONE launch; measured per-arm seconds at 4/8/11 arms on v5e:
-    # 0.285 / 0.187 / 0.163 — the sequential decode amortizes with rows, and
-    # the row-chunked readout/NLL keep the [rows, T, V] transient bounded.
-    arms_per_launch = int(
-        os.environ.get("BENCH_SWEEP_ARMS", "11" if on_accel else "2"))
-    reps = int(os.environ.get("BENCH_SWEEP_REPS", "2" if on_accel else "1"))
-    arms_per_cell = 11          # targeted + R=10 random draws
-    cells_per_word = 6 + 4      # ablation budgets + projection ranks
-    n_words = 20
-    rows = arms_per_launch * prompts_per_word
+    rows = arms * prompts_per_word
+    resp_start = prompt_len - 1
 
-    rng = np.random.default_rng(1)
-    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
-               for _ in range(rows)]
-    padded, valid, positions = decode.pad_prompts(prompts)
-    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
-    ep = {"sae": sae,
-          "latent_ids": jnp.asarray(
-              rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)), jnp.int32),
-          "layer": tap_layer}
+    def make_inputs(seed: int):
+        rng = np.random.default_rng(seed)
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+                   for _ in range(rows)]
+        padded, valid, positions = decode.pad_prompts(prompts)
+        args = (jnp.asarray(padded), jnp.asarray(valid),
+                jnp.asarray(positions))
+        ep = {"sae": sae,
+              "latent_ids": jnp.asarray(
+                  rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)),
+                  jnp.int32),
+              "layer": tap_layer}
+        return args, ep
+
     targets = jnp.zeros((rows,), jnp.int32)
 
-    state = {}
-
-    def decode_phase():
+    def run_decode(args, ep):
         dec = decode.greedy_decode(
             params, cfg, *args, max_new_tokens=new_tokens,
             edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
             capture_residual_layer=tap_layer)
         jax.block_until_ready((dec.tokens, dec.residual))
-        state["dec"] = dec
+        return dec
 
-    decode_phase()  # compile + capture sequences for the downstream phases
-    dec = state["dec"]
-    seqs, seq_valid = dec.sequences, dec.sequence_valid
-    pos2 = jnp.maximum(jnp.cumsum(seq_valid, axis=1) - 1, 0).astype(jnp.int32)
-    resp = jnp.zeros_like(seq_valid).at[:, prompt_len:].set(True)
-    next_mask = jnp.zeros_like(seq_valid).at[:, prompt_len - 1:-1].set(True)
-    ep_l = {**ep, "chunk_positions": pos2}
-
-    def readout_phase():
+    def run_readout(dec, resp):
         out = iv._residual_measure(
-            params, cfg, dec.residual, seqs, resp, targets, top_k=5)
+            params, cfg, dec.residual, dec.sequences, resp, targets,
+            top_k=5, resp_start=resp_start)
         jax.block_until_ready(out["agg_ids"])
 
-    def nll_phase():
-        nll = iv._nll_jit(params, cfg, seqs, seq_valid, pos2, next_mask,
-                          edit_fn=iv.sae_ablation_edit, edit_params=ep_l)
+    def run_nll(dec, ep, pos2, next_mask):
+        nll = iv._nll_jit(params, cfg, dec.sequences, dec.sequence_valid,
+                          pos2, next_mask,
+                          edit_fn=iv.sae_ablation_edit,
+                          edit_params={**ep, "chunk_positions": pos2},
+                          resp_start=resp_start, use_pallas=use_pallas_nll)
         jax.block_until_ready(nll)
 
-    readout_phase()
-    nll_phase()
+    def layout(dec):
+        pos2 = jnp.maximum(
+            jnp.cumsum(dec.sequence_valid, axis=1) - 1, 0).astype(jnp.int32)
+        resp = jnp.zeros_like(dec.sequence_valid).at[:, prompt_len:].set(True)
+        next_mask = jnp.zeros_like(
+            dec.sequence_valid).at[:, prompt_len - 1:-1].set(True)
+        return pos2, resp, next_mask
 
-    phase_seconds = {}
-    for name, fn in (("decode", decode_phase), ("readout", readout_phase),
-                     ("nll", nll_phase)):
+    # Compile warm-up (seed outside the rep range).
+    args, ep = make_inputs(10_000)
+    dec = run_decode(args, ep)
+    pos2, resp, next_mask = layout(dec)
+    run_readout(dec, resp)
+    run_nll(dec, ep, pos2, next_mask)
+
+    acc = {"decode": [], "readout": [], "nll": []}
+    for r in range(reps):
+        args, ep = make_inputs(20_000 + r)          # fresh inputs per rep
         t0 = time.perf_counter()
-        for _ in range(reps):
-            fn()
-        phase_seconds[name] = round((time.perf_counter() - t0) / reps, 4)
+        dec = run_decode(args, ep)
+        t1 = time.perf_counter()
+        pos2, resp, next_mask = layout(dec)         # host-cheap, not timed
+        t2 = time.perf_counter()
+        run_readout(dec, resp)
+        t3 = time.perf_counter()
+        run_nll(dec, ep, pos2, next_mask)
+        t4 = time.perf_counter()
+        acc["decode"].append(t1 - t0)
+        acc["readout"].append(t3 - t2)
+        acc["nll"].append(t4 - t3)
+
+    suspect = any(min(v) < dedup_floor for v in acc.values())
+    return {
+        "arms": arms,
+        "rows": rows,
+        "phase_seconds": {k: round(float(np.mean(v)), 4)
+                          for k, v in acc.items()},
+        "phase_seconds_min": {k: round(float(np.min(v)), 4)
+                              for k, v in acc.items()},
+        "timing_suspect_dedup": suspect,
+    }
+
+
+def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
+               new_tokens: int, cfg9) -> dict:
+    """[ideal, derated] per-launch seconds on a v5e-8 (dp=2 x tp=4) slice.
+
+    ideal: every phase /8 (pure throughput scaling).
+    derated:
+    - decode = a/4 + b*(rows/2)/4 + comm.  The row-independent intercept `a`
+      (per-step weight streaming through HBM + dispatch) shards over tp only:
+      each dp replica still streams its full tp shard of the weights every
+      step.  The per-row slope shards over both dp (rows/2) and tp.
+    - readout: throughput-bound /8 (tp collectives are O(k) candidate merges
+      + [rows, T] softmax-stat psums — negligible bytes).
+    - nll: /8 plus the teacher-forced forward's tp collectives.
+    - comm: Megatron-style tp inserts 2 all-reduces per layer (attn out +
+      MLP down); ring all-reduce moves 2*(tp-1)/tp of the bf16 activation
+      payload per chip over ICI (_ICI_LINK_BW), _COLL_LATENCY_S per launch.
+    """
+    dp, tp = 2, 4
+    L, D = cfg9.num_layers, cfg9.hidden_size
+    rows_dp = rows // dp
+    T = prompt_len + new_tokens
+    ring = 2 * (tp - 1) / tp
+
+    def ar(payload_bytes: float) -> float:
+        return ring * payload_bytes / _ICI_LINK_BW + _COLL_LATENCY_S
+
+    # Decode: per step, 2 collectives/layer of [rows_dp, 1, D] bf16; prefill,
+    # one forward of [rows_dp, prompt_len, D].
+    comm_decode = 2 * L * (new_tokens * ar(rows_dp * D * 2)
+                           + ar(rows_dp * prompt_len * D * 2))
+    # NLL: one teacher-forced forward over the full sequence.
+    comm_nll = 2 * L * ar(rows_dp * T * D * 2)
+
+    ideal = sum(phase_9b.values()) / 8.0
+    if decode_fit_9b is not None:
+        a9, b9 = decode_fit_9b
+        decode_der = a9 / tp + b9 * rows_dp / tp + comm_decode
+    else:
+        decode_der = phase_9b["decode"] / 8.0 + comm_decode
+    derated = (decode_der + phase_9b["readout"] / 8.0
+               + phase_9b["nll"] / 8.0 + comm_nll)
+    return {
+        "ideal_launch_seconds": round(ideal, 4),
+        "derated_launch_seconds": round(derated, 4),
+        "comm_seconds": {"decode": round(comm_decode, 4),
+                         "nll": round(comm_nll, 4)},
+        "decode_intercept_note": (
+            "derated decode = a/tp + b*rows/(dp*tp) + comm from the measured "
+            "a + b*rows fit" if decode_fit_9b is not None else
+            "single arms config measured - no latency fit; decode derated by "
+            "comm only"),
+    }
+
+
+def _sweep_bench(params, cfg, sae, tap_layer: int,
+                 on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
+    """Measure the intervention sweep's batched-arm launch (decode with
+    in-flight residual capture + tap-layer readout + NLL, the three compiled
+    programs of pipelines.interventions) and project the full study's
+    wall-clock.
+
+    Study shape (Execution Plan / BASELINE.json): 20 words x (6 ablation
+    budgets + 4 projection ranks) cells, each cell = 1 targeted + 10 random
+    arms over 10 prompts, plus one baseline pass per word.  All budgets' arms
+    stack and launch ``arm_chunk`` (22) at a time, so the LARGEST arms config
+    below is the sweep's steady state; measuring a second, smaller config
+    fits the decode phase's latency intercept (decode = a + b*rows), which
+    feeds the v5e-8 derate model.
+    """
+    prompts_per_word = int(os.environ.get("BENCH_SWEEP_PROMPTS", "10"))
+    # Default: one budget cell (11 = targeted + R=10) for the latency fit,
+    # then the production launch (arm_chunk=22: two budget cells folded into
+    # one 220-row launch).  Measured arm-seconds on v5e: 0.285/0.187/0.163/
+    # ~0.125 at 4/8/11/22 arms — rows amortize the latency-bound decode.
+    arms_list = [int(a) for a in os.environ.get(
+        "BENCH_SWEEP_ARMS", "11,22" if on_accel else "2").split(",")]
+    reps = int(os.environ.get("BENCH_SWEEP_REPS", "2" if on_accel else "1"))
+    arms_per_cell = 11          # targeted + R=10 random draws
+    cells_per_word = 6 + 4      # ablation budgets + projection ranks
+    n_words = 20
+
+    from taboo_brittleness_tpu.pipelines.interventions import _nll_use_pallas
+
+    use_pallas_nll = _nll_use_pallas(params, None)
+    runs = [
+        _sweep_phase_times(params, cfg, sae, tap_layer, prompt_len,
+                           new_tokens, arms, prompts_per_word, reps,
+                           use_pallas_nll,
+                           dedup_floor=_DEDUP_FLOOR_S if on_accel else 0.0)
+        for arms in arms_list
+    ]
+    primary = max(runs, key=lambda r: r["rows"])   # production launch
+    arms_per_launch = primary["arms"]
+    rows = primary["rows"]
+    phase_seconds = primary["phase_seconds"]
 
     launch_seconds = sum(phase_seconds.values())
     arm_seconds = launch_seconds / arms_per_launch
@@ -199,6 +339,19 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
     # Baseline pass per word ~= one arm's work (same three programs at B=10).
     word_seconds = cells_per_word * cell_seconds + arm_seconds
     study_hours_1chip = n_words * word_seconds / 3600.0
+
+    # Decode latency fit a + b*rows from the two arms configs (dedup-proof
+    # measurements; the intercept is the per-step weight-stream + dispatch
+    # floor that dp scaling can NOT shrink — see _v5e8_band).
+    decode_fit = None
+    by_rows = sorted(runs, key=lambda r: r["rows"])   # env order-agnostic
+    if len(by_rows) >= 2 and by_rows[-1]["rows"] != by_rows[0]["rows"]:
+        r1, d1 = by_rows[0]["rows"], by_rows[0]["phase_seconds"]["decode"]
+        r2, d2 = by_rows[-1]["rows"], by_rows[-1]["phase_seconds"]["decode"]
+        b = (d2 - d1) / (r2 - r1)
+        a = d1 - b * r1
+        if a > 0 and b > 0:
+            decode_fit = (a, b)
 
     # Scale the bench shape's measured time to the 9B by analytic matmul
     # FLOPs — PER PHASE, since the lens phase is vocab-readout-bound while
@@ -208,25 +361,41 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
 
     f_bench = _phase_flops(cfg, prompts_per_word, prompt_len, new_tokens,
                            sae.w_enc.shape[1])
-    f_9b = _phase_flops(gemma2_mod.PRESETS["gemma2_9b"], prompts_per_word,
-                        prompt_len, new_tokens, sae.w_enc.shape[1])
+    cfg9 = gemma2_mod.PRESETS["gemma2_9b"]
+    f_9b = _phase_flops(cfg9, prompts_per_word, prompt_len, new_tokens,
+                        sae.w_enc.shape[1])
     phase_ratio = {k: f_9b[k] / f_bench[k] for k in f_bench}
-    launch_seconds_9b = sum(
-        phase_seconds[k] * phase_ratio[k] for k in phase_seconds)
+    phase_9b = {k: phase_seconds[k] * phase_ratio[k] for k in phase_seconds}
+    launch_seconds_9b = sum(phase_9b.values())
     arm_seconds_9b = launch_seconds_9b / arms_per_launch
     word_seconds_9b = (cells_per_word * arms_per_cell + 1) * arm_seconds_9b
     hours_9b_1chip = n_words * word_seconds_9b / 3600.0
+
     # v5e-8: the (word x cell x arm) grid is embarrassingly data-parallel; the
     # 9B itself needs tp=4 within the slice (proven in __graft_entry__), so
-    # dp=2 x tp=4 — ideal scaling over 8 chips is the extrapolation.
-    hours_9b_v5e8 = hours_9b_1chip / 8.0
+    # dp=2 x tp=4.  Ideal /8 scaling is the upper bound; the derate model
+    # charges the decode latency intercept and the tp collectives (VERDICT
+    # round-3 item 9: report a band, not a single ideal number).
+    decode_fit_9b = (tuple(x * phase_ratio["decode"] for x in decode_fit)
+                     if decode_fit else None)
+    band = _v5e8_band(phase_9b, decode_fit_9b, rows, prompt_len, new_tokens,
+                      cfg9)
+    scale = (band["derated_launch_seconds"]
+             / max(band["ideal_launch_seconds"], 1e-9))
+    hours_9b_v5e8_ideal = hours_9b_1chip / 8.0
+    hours_9b_v5e8_derated = hours_9b_v5e8_ideal * scale
 
     return {
         "rows_per_launch": rows,
         "arms_per_launch": arms_per_launch,
         "prompts_per_word": prompts_per_word,
         "reps": reps,
+        "runs": runs,
         "phase_seconds_per_launch": phase_seconds,
+        "timing_suspect_dedup": any(r["timing_suspect_dedup"] for r in runs),
+        "decode_latency_fit_a_b": (
+            [round(decode_fit[0], 4), round(decode_fit[1], 6)]
+            if decode_fit else None),
         "arm_seconds": round(arm_seconds, 4),
         "cell_seconds_11_arms": round(cell_seconds, 3),
         "word_seconds_10_cells_plus_baseline": round(word_seconds, 2),
@@ -234,11 +403,111 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
         "flops_ratio_9b_over_bench_shape_per_phase": {
             k: round(v, 2) for k, v in phase_ratio.items()},
         "projected_full_sweep_hours_1chip_9b": round(hours_9b_1chip, 3),
-        "projected_full_sweep_hours_v5e8_9b": round(hours_9b_v5e8, 3),
+        "projected_full_sweep_hours_v5e8_9b": round(hours_9b_v5e8_ideal, 3),
+        "projected_full_sweep_hours_v5e8_9b_band": {
+            "ideal": round(hours_9b_v5e8_ideal, 3),
+            "derated": round(hours_9b_v5e8_derated, 3),
+        },
+        "v5e8_derate_model": band,
         "assumptions": "steady-state (compile amortized; 3 programs total for "
-                       "the whole study), checkpoint load/host IO excluded, "
-                       "9B scaled by per-phase analytic matmul FLOPs at equal "
-                       "MFU, v5e-8 = ideal dp=2 x tp=4 scaling",
+                       "the whole study), checkpoint load/host IO excluded "
+                       "(measured separately by the mini-study block), 9B "
+                       "scaled by per-phase analytic matmul FLOPs at equal "
+                       "MFU, v5e-8 band = [ideal /8, derated by decode "
+                       "latency intercept + tp=4 ICI collectives]",
+    }
+
+
+def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
+                 new_tokens: int, projection_word_seconds: float) -> dict:
+    """Run the REAL ``run_intervention_studies`` end-to-end on synthetic
+    bench-shape words and MEASURE seconds/word — the number the cell-level
+    projection only extrapolates (VERDICT round-3 item 1).
+
+    Everything the projection excludes is on the clock here: latent scoring
+    (streamed correlation over the calibration residuals), PCA of spike
+    residuals, per-arm guess decoding (B x K host-side ``tok.decode`` calls
+    per arm), JSON writes, brittleness-curve figure rendering (the CLI's
+    ``_save_study_plots``), and the resume bookkeeping.  Checkpoint IO is the
+    one real-study cost with no synthetic counterpart (the loader returns
+    in-memory params; the real driver prefetches the next word's checkpoint
+    on a host thread while the current word computes).
+
+    Word 1 pays all compiles; the steady-state number is the mean of the
+    remaining words.  Shapes match the sweep bench cell: 10 prompts padded to
+    ``prompt_len`` columns, ``new_tokens`` generated, 256k vocab, 16k SAE,
+    budgets {1..32} x R=10 + ranks {1,2,4,8} with arm_chunk=22.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from taboo_brittleness_tpu.cli import _save_study_plots
+    from taboo_brittleness_tpu.config import (
+        Config, ExperimentConfig, InterventionConfig, ModelConfig)
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines.interventions import (
+        run_intervention_studies)
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    n_words = int(os.environ.get("BENCH_STUDY_WORDS", "3"))
+    words = [f"benchword{i}" for i in range(n_words)]
+    # Each word costs two tokenizer ids ('w' and '▁w'); ids start at 109 —
+    # shrink the prompt lexicon on tiny test vocabs.
+    lex_n = max(4, min(64, (cfg.vocab_size - 109) // 2 - n_words - 2))
+    lex = [f"w{i:02d}" for i in range(lex_n)]
+    tok = WordTokenizer(words + lex, vocab_size=cfg.vocab_size)
+    rng = np.random.default_rng(7)
+    # ~prompt_len real tokens per row once the chat template's ~8 markers are
+    # added; pad_to_multiple=prompt_len buckets T to the sweep bench's cell.
+    prompts = [" ".join(rng.choice(lex, size=max(prompt_len - 8, 2)))
+               for _ in range(10)]
+    config = Config(
+        model=ModelConfig(layer_idx=tap_layer, top_k=5,
+                          arch="gemma2_bench", dtype="bfloat16",
+                          param_dtype="bfloat16"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=new_tokens,
+                                    pad_to_multiple=prompt_len),
+        intervention=InterventionConfig(),    # full grid, arm_chunk default
+        word_plurals={w: [w] for w in words},
+        prompts=prompts,
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(2), cfg.hidden_size, 16384)
+
+    def model_loader(word):
+        return params, cfg, tok
+
+    out_dir = tempfile.mkdtemp(prefix="tbx_study_bench_")
+    word_seconds = []
+    try:
+        for w in words:
+            t0 = time.perf_counter()
+            res = run_intervention_studies(
+                config, model_loader=model_loader, sae=sae, words=[w],
+                output_dir=out_dir)
+            _save_study_plots(config, res[w], out_dir, w)
+            word_seconds.append(round(time.perf_counter() - t0, 2))
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    steady = (float(np.mean(word_seconds[1:])) if len(word_seconds) > 1
+              else float(word_seconds[0]))
+    return {
+        "n_words": n_words,
+        "word_seconds": word_seconds,
+        "first_word_seconds_incl_compile": word_seconds[0],
+        "measured_study_seconds_per_word": round(steady, 2),
+        "projection_word_seconds": round(projection_word_seconds, 2),
+        "host_overhead_ratio": (
+            round(steady / projection_word_seconds, 3)
+            if projection_word_seconds > 0 else None),
+        "measured_full_study_hours_1chip_bench_shape": round(
+            20 * steady / 3600.0, 3),
+        "note": "real run_intervention_studies + figure rendering on "
+                "synthetic bench-shape words; checkpoint IO excluded (the "
+                "loader is in-memory; the real driver prefetches on a host "
+                "thread)",
     }
 
 
@@ -266,16 +535,23 @@ def main() -> int:
     params = gemma2.init_params(key, cfg)
     sae = sae_ops.init_random(jax.random.PRNGKey(1), cfg.hidden_size, 16384)
     tap_layer = min(31, cfg.num_layers - 1)
-
-    rng = np.random.default_rng(0)
-    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
-               for _ in range(batch)]
-    padded, valid, positions = decode.pad_prompts(prompts)
-    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
-    ep = {"sae": sae,
-          "latent_ids": jnp.asarray([11, 222, 3333, 4444], jnp.int32),
-          "layer": tap_layer}
     targets = jnp.zeros((batch,), jnp.int32)
+
+    def make_inputs(seed: int):
+        """Fresh prompt/latent ids per rep: the axon TPU runtime can dedupe
+        repeated executions with byte-identical inputs to ~0.1 ms, so timing
+        loops must never replay the same buffers."""
+        rng = np.random.default_rng(seed)
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+                   for _ in range(batch)]
+        padded, valid, positions = decode.pad_prompts(prompts)
+        args = (jnp.asarray(padded), jnp.asarray(valid),
+                jnp.asarray(positions))
+        ep = {"sae": sae,
+              "latent_ids": jnp.asarray(
+                  rng.integers(0, sae.w_enc.shape[1], size=(4,)), jnp.int32),
+              "layer": tap_layer}
+        return args, ep
 
     use_pallas = os.environ.get("TBX_PALLAS_LENS", "1" if on_accel else "0") == "1"
     lens_step = jax.jit(
@@ -284,7 +560,7 @@ def main() -> int:
             positions=pos, attn_validity=v, use_pallas=use_pallas),
         static_argnames=())
 
-    def arm_step():
+    def arm_step(args, ep):
         dec = decode.greedy_decode(
             params, cfg, *args, max_new_tokens=new_tokens,
             edit_fn=sae_ablation_edit, edit_params=ep,
@@ -294,11 +570,15 @@ def main() -> int:
         res = lens_step(params, dec.sequences, seq_valid, pos)
         jax.block_until_ready((dec.tokens, res.tap.topk_ids, res.residual))
 
-    arm_step()  # compile
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        arm_step()
-    dt = (time.perf_counter() - t0) / reps
+    arm_step(*make_inputs(0))  # compile
+    rep_seconds = []
+    for r in range(reps):
+        inputs = make_inputs(100 + r)
+        t0 = time.perf_counter()
+        arm_step(*inputs)
+        rep_seconds.append(time.perf_counter() - t0)
+    dt = float(np.mean(rep_seconds))
+    dedup_suspect = on_accel and min(rep_seconds) < _DEDUP_FLOOR_S
 
     prompts_per_sec = batch / dt
 
@@ -318,6 +598,13 @@ def main() -> int:
                              on_accel=on_accel,
                              prompt_len=prompt_len, new_tokens=new_tokens)
 
+    study = None
+    if os.environ.get("BENCH_STUDY", "1" if on_accel else "0") == "1":
+        study = _study_bench(
+            params, cfg, tap_layer, prompt_len, new_tokens,
+            projection_word_seconds=(
+                sweep["word_seconds_10_cells_plus_baseline"] if sweep else 0.0))
+
     print(json.dumps({
         "metric": "ablation-sweep prompts/sec/chip "
                   f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
@@ -327,12 +614,21 @@ def main() -> int:
         "tflops_per_sec": round(tflops, 2),
         "mfu": mfu,
         "pallas_lens": use_pallas,
+        "timing_suspect_dedup": bool(
+            dedup_suspect or (sweep and sweep["timing_suspect_dedup"])),
         "config": {"preset": preset, "batch": batch, "new_tokens": new_tokens,
                    "prompt_len": prompt_len, "reps": reps},
         # North-star account (BASELINE.json: full sweep "< 1 h on v5e-8").
+        # Headline = the DERATED v5e-8 projection (decode latency intercept +
+        # tp collectives charged); the band and the measured mini-study are in
+        # the sweep/study blocks.
         "projected_full_sweep_hours": (
-            sweep and sweep["projected_full_sweep_hours_v5e8_9b"]),
+            sweep and
+            sweep["projected_full_sweep_hours_v5e8_9b_band"]["derated"]),
+        "measured_study_seconds_per_word": (
+            study and study["measured_study_seconds_per_word"]),
         "sweep": sweep,
+        "study": study,
     }))
     return 0
 

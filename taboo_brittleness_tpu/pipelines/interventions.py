@@ -191,14 +191,13 @@ def _teacher_forced_nll(
 
         x = rms_norm(h_s.reshape(B * Ts, -1), params["final_norm"],
                      cfg.rms_norm_eps)
-        stats = pallas_lens.lens_stats(
+        lse, tgt = pallas_lens.nll_stats(
             x, params["embed"].astype(cfg.compute_dtype),
-            nxt_s.reshape(B * Ts), top_k=1,
+            nxt_s.reshape(B * Ts),
             logit_cap=cfg.final_logit_softcap,
             block_v=min(1024, cfg.vocab_size),
             interpret=jax.default_backend() == "cpu")
-        nll_s = (stats.logsumexp - stats.target_logit).reshape(B, Ts)
-        nll_s = jnp.where(m_s, nll_s, 0.0)
+        nll_s = jnp.where(m_s, (lse - tgt).reshape(B, Ts), 0.0)
     else:
         def row(args):
             h, nxt_r, m = args                              # [Ts, D], [Ts], [Ts]
@@ -218,11 +217,23 @@ _nll_jit = jax.jit(_teacher_forced_nll,
 
 
 def _nll_use_pallas(params: Params, mesh) -> bool:
-    """Route the NLL readout through the fused kernel when it can run: TPU
-    backend, concrete single-device params, no mesh (the kernel has no GSPMD
-    partitioning rule — sharded launches keep the XLA row-chunk path)."""
+    """Route the NLL readout through the fused ``nll_stats`` kernel — opt-in
+    via TBX_PALLAS_NLL=1, and only where it can run (TPU backend, concrete
+    single-device params, no mesh: the kernel has no GSPMD partitioning rule).
+
+    Opt-in rather than auto, unlike the lens tap: on the current v5e runtime
+    the kernel's online-merge schedule executes ~20x below the matmul bound
+    (measured ~1.0 s vs the XLA path's ~0.3 s at the sweep's 110-row launch;
+    the per-tile-partials layout that IS fast for the lens tap costs ~225 MB
+    of HBM partials here, which tipped a 16 GB chip over when compiled next
+    to the params).  The default XLA path chunks rows and slices response
+    columns instead — revisit if a profiler shows the schedule fixable."""
+    import os
+
     from taboo_brittleness_tpu.ops.lens import _pallas_auto_ok
 
+    if os.environ.get("TBX_PALLAS_NLL", "0") != "1":
+        return False
     return mesh is None and _pallas_auto_ok(params)
 
 

@@ -125,6 +125,60 @@ def test_tp_aggregate_from_residual_matches_single_device():
                                atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("spike_masked", [False, True])
+def test_tp_decode_with_arm_edits_matches_single_device(spike_masked):
+    """EXECUTED value parity for the 9B chain's last link: tp=4 (x dp=2)
+    ``greedy_decode`` with per-row arm edit_params and in-flight residual
+    capture must produce the single-device tokens, lengths and residuals —
+    previously tp decode was only compile-proven (AOT .lower at 9B shapes)
+    and smoke-run without assertions in the dryrun."""
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode
+
+    cfg = gemma2.PRESETS["gemma2_tiny"].replace(vocab_size=200)
+    params = gemma2.init_params(jax.random.PRNGKey(4), cfg)
+    sae = sae_ops.init_random(jax.random.PRNGKey(5), cfg.hidden_size, 32)
+    rng = np.random.default_rng(6)
+    B, tap = 4, 2
+    prompts = [list(rng.integers(1, 200, size=n)) for n in (5, 7, 6, 7)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+
+    ep = {"sae": sae, "layer": tap,
+          "latent_ids": jnp.asarray(                    # a different arm per row
+              rng.integers(0, 32, size=(B, 3)), jnp.int32)}
+    if spike_masked:
+        ep["spike_positions"] = jnp.asarray(
+            rng.integers(0, 8, size=(B, 2)), jnp.int32)
+
+    def run(p, ids, val, pos, ep_):
+        return decode.greedy_decode(
+            p, cfg, ids, val, pos, max_new_tokens=4,
+            edit_fn=iv.sae_ablation_edit, edit_params=ep_, stop_ids=(-1,),
+            capture_residual_layer=tap)
+
+    base = run(params, jnp.asarray(padded), jnp.asarray(valid),
+               jnp.asarray(positions), ep)
+
+    m = meshlib.make_mesh(MeshConfig(dp=2, tp=4, sp=1))
+    sp = meshlib.shard_params(params, cfg, m)
+    ep_sharded = {**ep, "latent_ids": meshlib.shard_batch(ep["latent_ids"], m)}
+    if spike_masked:
+        ep_sharded["spike_positions"] = meshlib.shard_batch(
+            ep["spike_positions"], m)
+    got = run(sp, meshlib.shard_batch(jnp.asarray(padded), m),
+              meshlib.shard_batch(jnp.asarray(valid), m),
+              meshlib.shard_batch(jnp.asarray(positions), m), ep_sharded)
+
+    np.testing.assert_array_equal(np.asarray(got.tokens),
+                                  np.asarray(base.tokens))
+    np.testing.assert_array_equal(np.asarray(got.lengths),
+                                  np.asarray(base.lengths))
+    np.testing.assert_allclose(np.asarray(got.residual),
+                               np.asarray(base.residual),
+                               atol=2e-5, rtol=1e-4)
+
+
 def test_analyze_word_on_device_tp_mesh_odd_batch():
     """Pipeline-level tp path with a batch that does NOT divide dp: rows are
     padded for the shard_map and stripped from the outputs."""
