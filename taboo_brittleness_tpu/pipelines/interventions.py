@@ -356,24 +356,32 @@ def prepare_word_state(
     B = len(config.prompts)
     pad = dp_pad(mesh, B)
     prompts = list(config.prompts) + [config.prompts[-1]] * pad
-    dec, texts, prompt_ids = decode.generate(
+    # Dispatch the decode and enqueue the readout behind it via the device
+    # layout before any host sync (same overlap as _measure_rows).
+    dec, _, _ = decode.generate(
         params, cfg, tok, prompts,
         max_new_tokens=config.experiment.max_new_tokens,
         pad_to_multiple=config.experiment.pad_to_multiple,
         capture_residual_layer=layer_idx,
-        input_sharding=_dp_sharding(mesh, 2, B + pad))
-    layout = decode.response_layout(dec)
-    seqs, valid, positions, resp = (layout.sequences, layout.valid,
-                                    layout.positions, layout.response_mask)
-    rows = seqs.shape[0]
-    resp_start = max(layout.prompt_len - 1, 0)
+        input_sharding=_dp_sharding(mesh, 2, B + pad),
+        return_texts=False)
+    layout_d = decode.response_layout_device(dec)
+    rows = layout_d.sequences.shape[0]
+    resp_start = max(layout_d.prompt_len - 1, 0)
 
     tid = target_token_id(tok, word)
     out = _residual_measure(
-        params, cfg, dec.residual, _place_rows(seqs, mesh),
-        _place_rows(resp.astype(bool), mesh),
+        params, cfg, dec.residual, _place_rows(layout_d.sequences, mesh),
+        _place_rows(layout_d.response_mask, mesh),
         _place_rows(np.full((rows,), tid, np.int32), mesh), top_k=top_k,
         resp_start=resp_start)
+
+    # The readout is queued; now pull the host-side view (blocks on the
+    # decode only) and decode texts while the device runs the readout.
+    layout = decode.response_layout(dec)
+    seqs, valid, positions, resp = (layout.sequences, layout.valid,
+                                    layout.positions, layout.response_mask)
+    texts = decode.decode_texts(tok, dec)
 
     target_prob = np.asarray(out["tap_prob"])[:B]              # [B, T]
     secret_prob = float(np.asarray(out["row_prob_sum"])[:B].sum()
@@ -529,7 +537,7 @@ def _tile_rows_ep(shared_ep: Any, per_arm: Dict[str, Any], n_arms: int,
     return rows
 
 
-def _measure_rows(
+def _dispatch_rows(
     params: Params,
     cfg: Gemma2Config,
     tok: TokenizerLike,
@@ -539,16 +547,24 @@ def _measure_rows(
     rows_ep: Any,
     n_arms: int,
     mesh: Any = None,
-) -> List[ArmResult]:
-    """Measure ``n_arms`` arms folded into the row axis (arm-major tile of the
-    word's prompts): one batched decode (which captures the tap-layer
-    residual as it runs), one jitted readout, one jitted NLL pass for ALL
-    arms — neither the per-arm Python loop of round 2 nor the full-model
-    lens re-run of early round 3 remains."""
+) -> Dict[str, Any]:
+    """Enqueue ``n_arms`` arms' worth of device work (decode with in-flight
+    residual capture, tap-layer readout, NLL) WITHOUT waiting for any of it,
+    and return the in-flight handles for :func:`_collect_rows`.  The split
+    lets ``measure_arms`` software-pipeline chunks: chunk i+1's three
+    programs join the device queue while chunk i's results are still being
+    pulled and assembled on the host.
+
+    Peak-memory cost of the depth-2 pipeline: chunk i's captured residual
+    stays allocated until its queued readout executes, so two chunks'
+    residuals + small I/O can coexist — [220, 82, D] f32 is ~166 MB at the
+    bench shape and ~129 MB per chip at the 9B production shape (rows
+    dp-sharded), bounded by the fixed pipeline depth.  Execution-time
+    transients (KV cache, [chunk, T, V] readout slabs) never overlap — the
+    device runs one program at a time."""
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     A, B = n_arms, state.sequences.shape[0]
-    valid_forms = {f.lower() for f in config.word_plurals.get(state.word, [state.word])}
 
     # Pad the row axis (repeating the last row) to the dp multiple so the
     # launch always runs sharded; pad rows are stripped by the per-arm slices
@@ -565,37 +581,42 @@ def _measure_rows(
 
     # (a) Regenerate under the edit — every arm's rows in one decode launch;
     # the tap-layer residual (post-edit) rides out on the decode's carry tap.
-    dec, texts, _ = decode.generate(
+    # return_texts=False + the DEVICE layout keep the host from blocking on
+    # the decode: the readout and NLL programs enqueue right behind it, and
+    # the host decodes response texts while the device runs all three (the
+    # three blocking boundaries per chunk cost ~1-2 s/word of idle dispatch
+    # gaps on the remote runtime otherwise).
+    dec, _, _ = decode.generate(
         params, cfg, tok, list(config.prompts) * A + [config.prompts[-1]] * pad,
         max_new_tokens=config.experiment.max_new_tokens,
         pad_to_multiple=config.experiment.pad_to_multiple,
         edit_fn=edit_fn,
         edit_params=rows_ep_p,
         capture_residual_layer=layer_idx,
-        input_sharding=_dp_sharding(mesh, 2, A * B + pad))
-    layout = decode.response_layout(dec)
-    seqs, valid, positions, resp = (layout.sequences, layout.valid,
-                                    layout.positions, layout.response_mask)
-    rows = seqs.shape[0]
+        input_sharding=_dp_sharding(mesh, 2, A * B + pad),
+        return_texts=False)
+    layout = decode.response_layout_device(dec)
+    rows = layout.sequences.shape[0]
     resp_start = max(layout.prompt_len - 1, 0)
 
     # (b) Tap-layer readout from the captured residual — one response-column
     # readout per row, shared by every arm/budget of the sweep (no model
     # FLOPs).
     out = _residual_measure(
-        params, cfg, dec.residual, _place_rows(seqs, mesh),
-        _place_rows(resp.astype(bool), mesh),
+        params, cfg, dec.residual, _place_rows(layout.sequences, mesh),
+        _place_rows(layout.response_mask, mesh),
         _place_rows(np.full((rows,), state.target_id, np.int32), mesh),
         top_k=top_k, resp_start=resp_start)
     # The readout is dispatched; drop the [rows, T, D] f32 residual reference
-    # so its ~0.9 GB (110 rows at 9B) frees before the NLL forward peaks.
+    # (~166 MB at 220 bench-shape rows) so it frees as soon as the queued
+    # readout has consumed it.
     dec = dec._replace(residual=None)
 
     # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
     next_mask = np.zeros_like(state.response_mask)
     next_mask[:, :-1] = state.response_mask[:, 1:]
     base_pos = pad_rows(np.tile(state.positions, (A, 1)), pad)
-    edited_nll = np.asarray(_nll_jit(
+    edited_nll_dev = _nll_jit(
         params, cfg,
         _place_rows(pad_rows(np.tile(state.sequences, (A, 1)), pad), mesh),
         _place_rows(pad_rows(np.tile(state.valid, (A, 1)), pad).astype(bool),
@@ -605,8 +626,30 @@ def _measure_rows(
         edit_fn=edit_fn,
         edit_params=_with_chunk_positions(rows_ep_p, base_pos),
         resp_start=state.resp_start,
-        use_pallas=_nll_use_pallas(params, mesh)))
+        use_pallas=_nll_use_pallas(params, mesh))
 
+    # All three programs are now in the device queue; hand the in-flight
+    # values to the collect half.
+    return {"dec": dec, "out": out, "edited_nll": edited_nll_dev,
+            "next_mask": next_mask, "n_arms": A}
+
+
+def _collect_rows(
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    handle: Dict[str, Any],
+) -> List[ArmResult]:
+    """Pull a :func:`_dispatch_rows` handle's results and assemble the
+    per-arm measurements (host tokenizer work overlaps the device queue)."""
+    A = handle["n_arms"]
+    B = state.sequences.shape[0]
+    next_mask = handle["next_mask"]
+    valid_forms = {f.lower()
+                   for f in config.word_plurals.get(state.word, [state.word])}
+    texts = decode.decode_texts(tok, handle["dec"])
+    edited_nll = np.asarray(handle["edited_nll"])
+    out = handle["out"]
     row_prob_sum = np.asarray(out["row_prob_sum"])
     row_resp = np.asarray(out["row_resp"])
     agg_ids = np.asarray(out["agg_ids"])
@@ -631,6 +674,26 @@ def _measure_rows(
             guesses=guesses,
         ))
     return results
+
+
+def _measure_rows(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    edit_fn: Callable,
+    rows_ep: Any,
+    n_arms: int,
+    mesh: Any = None,
+) -> List[ArmResult]:
+    """Measure ``n_arms`` arms folded into the row axis (arm-major tile of the
+    word's prompts): one batched decode (which captures the tap-layer
+    residual as it runs), one jitted readout, one jitted NLL pass for ALL
+    arms — neither the per-arm Python loop of round 2 nor the full-model
+    lens re-run of early round 3 remains."""
+    return _collect_rows(tok, config, state, _dispatch_rows(
+        params, cfg, tok, config, state, edit_fn, rows_ep, n_arms, mesh))
 
 
 def measure_arm(
@@ -682,7 +745,13 @@ def measure_arms(
     chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
              or min(A, _DEFAULT_ARM_CHUNK))
 
+    # Software-pipelined chunk loop: chunk i+1's decode/readout/NLL enqueue
+    # BEFORE chunk i's results are pulled, so the device never idles through
+    # the host-side assembly (text decode, metrics, guess decoding) between
+    # chunks.  Depth is fixed at 2, bounding the overlap cost to one extra
+    # chunk's residual + I/O buffers (see _dispatch_rows).
     results: List[ArmResult] = []
+    pending: Optional[Tuple[Dict[str, Any], int]] = None
     for s in range(0, A, chunk):
         pa = {k: jnp.asarray(v)[s:s + chunk] for k, v in per_arm.items()}
         a = int(next(iter(pa.values())).shape[0])
@@ -694,9 +763,15 @@ def measure_arms(
             pa = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
                   for k, v in pa.items()}
         rows_ep = _tile_rows_ep(shared_ep, pa, a + pad, B)
-        results.extend(_measure_rows(
-            params, cfg, tok, config, state, edit_fn, rows_ep, a + pad,
-            mesh)[:a])
+        handle = _dispatch_rows(params, cfg, tok, config, state, edit_fn,
+                                rows_ep, a + pad, mesh)
+        if pending is not None:
+            results.extend(
+                _collect_rows(tok, config, state, pending[0])[:pending[1]])
+        pending = (handle, a)
+    if pending is not None:
+        results.extend(
+            _collect_rows(tok, config, state, pending[0])[:pending[1]])
     return results
 
 

@@ -121,15 +121,13 @@ def analyze_word_on_device(
     the behavior the reference *intended* (SURVEY.md anti-goals; its
     string-based version is kept only on the cached path for parity).
     """
-    dec, texts, prompt_ids = decode.generate(
+    dec, _, prompt_ids = decode.generate(
         params, model_cfg, tok, list(prompts),
         max_new_tokens=max_new_tokens, edit_fn=edit_fn,
         pad_to_multiple=pad_to_multiple,
+        return_texts=False,
     )
-    layout = decode.response_layout(dec)
-    seqs, valid = layout.sequences, layout.valid
-    B = seqs.shape[0]
-
+    B = dec.sequences.shape[0]
     tid = target_token_id(tok, word)
 
     # The tp lens path shards the batch over dp; pad (repeating the last row,
@@ -137,18 +135,29 @@ def analyze_word_on_device(
     from taboo_brittleness_tpu.parallel.mesh import dp_pad, pad_rows as _pr
 
     pad_rows = dp_pad(mesh, B)
-
-    def padded(x):
-        return _pr(x, pad_rows)
+    if pad_rows == 0:
+        # Single-chip / dp-dividing fast path: the lens + aggregation enqueue
+        # behind the decode via the DEVICE layout — no host sync until the
+        # text decode below, which then overlaps the queued device work.
+        layout_dev = decode.response_layout_device(dec)
+        seqs_in = layout_dev.sequences
+        pos_in, valid_in = layout_dev.positions, layout_dev.valid
+        resp_in = layout_dev.response_mask
+    else:
+        layout_host = decode.response_layout(dec)        # blocks (mesh path)
+        seqs_in = jnp.asarray(_pr(layout_host.sequences, pad_rows))
+        pos_in = jnp.asarray(_pr(layout_host.positions, pad_rows))
+        valid_in = jnp.asarray(_pr(layout_host.valid, pad_rows), bool)
+        resp_in = jnp.asarray(_pr(layout_host.response_mask, pad_rows))
 
     Bp = B + pad_rows
     target_ids = jnp.full((Bp,), tid, jnp.int32)
 
     res = lens.lens_forward(
-        params, model_cfg, jnp.asarray(padded(seqs)), target_ids,
+        params, model_cfg, seqs_in, target_ids,
         tap_layer=layer_idx, top_k=top_k,
-        positions=jnp.asarray(padded(layout.positions)),
-        attn_validity=jnp.asarray(padded(valid), bool),
+        positions=pos_in,
+        attn_validity=valid_in,
         use_pallas=use_pallas,
         tp_mesh=mesh,
     )
@@ -158,12 +167,15 @@ def analyze_word_on_device(
     # vocab-sharded variant merges candidates via tp_topk.
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         top_ids, _ = lens.aggregate_from_residual_tp(
-            params, model_cfg, res.residual, jnp.asarray(padded(seqs)),
-            jnp.asarray(padded(layout.response_mask)), top_k=top_k, mesh=mesh)
+            params, model_cfg, res.residual, seqs_in,
+            resp_in, top_k=top_k, mesh=mesh)
     else:
         top_ids, _ = lens.aggregate_from_residual(
-            params, model_cfg, res.residual, jnp.asarray(padded(seqs)),
-            jnp.asarray(padded(layout.response_mask)), top_k=top_k)
+            params, model_cfg, res.residual, seqs_in,
+            resp_in, top_k=top_k)
+    texts = decode.decode_texts(tok, dec)    # overlaps the queued lens work
+    layout = (layout_host if pad_rows else decode.response_layout(dec))
+    seqs, valid = layout.sequences, layout.valid
     top_ids = np.asarray(top_ids)[:B]                      # [B, K]
 
     guesses = [[tok.decode([int(i)]).strip() for i in row] for row in top_ids]

@@ -242,13 +242,14 @@ def greedy_decode(
 
 
 class ResponseLayout(NamedTuple):
-    """Host-side view of a batched decode used by every analysis pipeline."""
+    """View of a batched decode used by every analysis pipeline.  Arrays are
+    numpy (host path) or jax (``response_layout_device``) — same fields."""
 
-    sequences: np.ndarray      # [B, T] full ids (left-padded prompt + generation)
-    valid: np.ndarray          # [B, T] bool: real tokens (prompt or generated)
-    positions: np.ndarray      # [B, T] RoPE positions (cumsum of valid - 1)
+    sequences: Any             # [B, T] full ids (left-padded prompt + generation)
+    valid: Any                 # [B, T] bool: real tokens (prompt or generated)
+    positions: Any             # [B, T] RoPE positions (cumsum of valid - 1)
     prompt_len: int            # number of prompt columns (T - max_new_tokens)
-    response_mask: np.ndarray  # [B, T] generated tokens, stop ids excluded
+    response_mask: Any         # [B, T] generated tokens, stop ids excluded
 
 
 def response_layout(
@@ -257,7 +258,11 @@ def response_layout(
     stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
 ) -> ResponseLayout:
     """One canonical reconstruction of (positions, response mask, ...) from a
-    DecodeResult — previously re-derived ad hoc by each pipeline."""
+    DecodeResult — previously re-derived ad hoc by each pipeline.
+
+    BLOCKS on the decode (host numpy).  Measurement paths that want to
+    dispatch follow-up device programs without waiting for the decode should
+    use :func:`response_layout_device` instead."""
     seqs = np.asarray(result.sequences)
     valid = np.asarray(result.sequence_valid)
     toks = np.asarray(result.tokens)
@@ -265,6 +270,28 @@ def response_layout(
     prompt_len = seqs.shape[1] - toks.shape[1]
     resp = np.zeros_like(valid)
     resp[:, prompt_len:] = (toks != chat.PAD_ID) & ~np.isin(toks, stop_ids)
+    return ResponseLayout(sequences=seqs, valid=valid, positions=positions,
+                          prompt_len=prompt_len, response_mask=resp)
+
+
+def response_layout_device(
+    result: DecodeResult,
+    *,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+) -> ResponseLayout:
+    """:func:`response_layout` computed WITH jax ops on the decode's own
+    (possibly still in-flight) arrays: nothing syncs to host, so readout /
+    NLL programs can be enqueued right behind the decode and the host is
+    free to do tokenizer work while the device runs all three.  Semantics
+    identical to the numpy version (asserted in tests)."""
+    seqs, valid, toks = result.sequences, result.sequence_valid, result.tokens
+    positions = jnp.maximum(
+        jnp.cumsum(valid, axis=1) - 1, 0).astype(jnp.int32)
+    prompt_len = seqs.shape[1] - toks.shape[1]
+    stop = jnp.asarray(stop_ids, jnp.int32)
+    gen_resp = (toks != chat.PAD_ID) & jnp.all(
+        toks[:, :, None] != stop[None, None, :], axis=-1)
+    resp = jnp.zeros(valid.shape, bool).at[:, prompt_len:].set(gen_resp)
     return ResponseLayout(sequences=seqs, valid=valid, positions=positions,
                           prompt_len=prompt_len, response_mask=resp)
 
@@ -294,7 +321,8 @@ def generate(
     pad_to_multiple: Optional[int] = None,
     capture_residual_layer: Optional[int] = None,
     input_sharding: Optional[Any] = None,
-) -> Tuple[DecodeResult, List[str], List[List[int]]]:
+    return_texts: bool = True,
+) -> Tuple[DecodeResult, Optional[List[str]], List[List[int]]]:
     """Chat-format, tokenize, batch-decode.  Returns (result, response_texts,
     full_sequences_ids) — the response text is the *generation only* (the
     reference's response is the full templated text; use ``full_text`` below
@@ -302,6 +330,12 @@ def generate(
 
     ``prefills[b]``, when set, opens the model turn with forced text (token
     forcing, paper App. D.4); generation continues from the prefill.
+
+    ``return_texts=False`` skips the host-side token decode and returns
+    ``None`` texts WITHOUT blocking on the device: callers that want to
+    enqueue more device programs behind the decode (the sweep measurement
+    path) decode texts themselves afterwards (``decode_texts``), overlapping
+    the tokenizer work with the device queue.
     """
     rendered = []
     for i, p in enumerate(prompts):
@@ -332,7 +366,7 @@ def generate(
         decode_edit=decode_edit,
         capture_residual_layer=capture_residual_layer,
     )
-    texts = decode_texts(tok, result)
+    texts = decode_texts(tok, result) if return_texts else None
     return result, texts, ids
 
 

@@ -215,3 +215,36 @@ def test_capture_residual_matches_teacher_forced_lens():
     dec2, _, _ = decode.generate(
         params, cfg, tok, ["Give me a hint"], max_new_tokens=3)
     assert dec2.residual is None
+
+
+def test_response_layout_device_matches_host():
+    """The device-side layout (no host sync; lets readout/NLL enqueue behind
+    the decode) must reproduce the numpy layout field for field — including
+    stop-token exclusion from the response mask and left-pad positions."""
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+    from taboo_brittleness_tpu.runtime import chat
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(29), cfg)
+    tok = WordTokenizer(["Give", "me", "a", "hint", "clue"],
+                        vocab_size=cfg.vocab_size)
+    dec, _, _ = decode.generate(
+        params, cfg, tok, ["Give me a hint", "a clue"], max_new_tokens=6,
+        return_texts=False)
+
+    host = decode.response_layout(dec)
+    dev = decode.response_layout_device(dec)
+    assert dev.prompt_len == host.prompt_len
+    np.testing.assert_array_equal(np.asarray(dev.sequences), host.sequences)
+    np.testing.assert_array_equal(np.asarray(dev.valid), host.valid)
+    np.testing.assert_array_equal(np.asarray(dev.positions), host.positions)
+    np.testing.assert_array_equal(np.asarray(dev.response_mask),
+                                  host.response_mask)
+
+    # Force a stop token into the generation and re-check the exclusion path.
+    toks = np.asarray(dec.tokens).copy()
+    toks[0, 1] = chat.END_OF_TURN_ID
+    dec2 = dec._replace(tokens=jnp.asarray(toks))
+    np.testing.assert_array_equal(
+        np.asarray(decode.response_layout_device(dec2).response_mask),
+        decode.response_layout(dec2).response_mask)
