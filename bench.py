@@ -478,24 +478,39 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
     def model_loader(word):
         return params, cfg, tok
 
+    from concurrent.futures import ThreadPoolExecutor
+
     out_dir = tempfile.mkdtemp(prefix="tbx_study_bench_")
     word_seconds = []
     try:
-        for w in words:
+        # Figures render on a background thread as each word completes,
+        # exactly as the CLI sweep does; the final join is timed and
+        # amortized into the steady-state number so nothing escapes the
+        # clock.
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            futures = []
+            for w in words:
+                t0 = time.perf_counter()
+                run_intervention_studies(
+                    config, model_loader=model_loader, sae=sae, words=[w],
+                    output_dir=out_dir,
+                    on_word_done=lambda word, study: futures.append(
+                        pool.submit(_save_study_plots, config, study,
+                                    out_dir, word)))
+                word_seconds.append(round(time.perf_counter() - t0, 2))
             t0 = time.perf_counter()
-            res = run_intervention_studies(
-                config, model_loader=model_loader, sae=sae, words=[w],
-                output_dir=out_dir)
-            _save_study_plots(config, res[w], out_dir, w)
-            word_seconds.append(round(time.perf_counter() - t0, 2))
+            for f in futures:
+                f.result()
+            join_seconds = time.perf_counter() - t0
     finally:
         shutil.rmtree(out_dir, ignore_errors=True)
 
     steady = (float(np.mean(word_seconds[1:])) if len(word_seconds) > 1
-              else float(word_seconds[0]))
+              else float(word_seconds[0])) + join_seconds / max(n_words, 1)
     return {
         "n_words": n_words,
         "word_seconds": word_seconds,
+        "figure_join_seconds": round(join_seconds, 2),
         "first_word_seconds_incl_compile": word_seconds[0],
         "measured_study_seconds_per_word": round(steady, 2),
         "projection_word_seconds": round(projection_word_seconds, 2),

@@ -247,16 +247,32 @@ def cmd_interventions(args) -> int:
         out_dir = os.path.dirname(out)
     else:
         # Full sweep over config.words: resumable (skip-if-exists per word),
-        # next checkpoint prefetched while the current word computes.
+        # next checkpoint prefetched while the current word computes.  Each
+        # word's figures render on ONE background thread as its results land
+        # (the device keeps computing the next word meanwhile) — matplotlib
+        # is ~2 s/word, a pure serial tail otherwise.
+        from concurrent.futures import ThreadPoolExecutor
+
         out_dir = args.output or os.path.join("results", "interventions")
-        with maybe_profile(args.trace_dir), manifest.stage("study-sweep"):
+        plot_paths: list = []
+        with maybe_profile(args.trace_dir), manifest.stage("study-sweep"), \
+                ThreadPoolExecutor(max_workers=1) as pool:
+            futures = []
+
+            def render_when_done(word, study):
+                futures.append(pool.submit(
+                    _save_study_plots, config, study, out_dir, word))
+
             results = interventions.run_intervention_studies(
                 config, model_loader=loader, sae=sae, output_dir=out_dir,
-                mesh=mesh, forcing=args.forcing)
+                mesh=mesh, forcing=args.forcing,
+                on_word_done=render_when_done)
+            for f in futures:
+                plot_paths.extend(f.result())
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
-            for p_ in _save_study_plots(config, results[w], out_dir, w):
-                manifest.add_artifact(p_)
+        for p_ in plot_paths:
+            manifest.add_artifact(p_)
         print(f"studies ({len(results)} words) -> {out_dir}")
     _finish(args, manifest, out_dir)
     return 0

@@ -412,8 +412,22 @@ def prepare_word_state(
     )
 
 
-def _decode_guess_rows(tok, agg_ids: np.ndarray) -> List[List[str]]:
-    return [[tok.decode([int(i)]).strip() for i in row] for row in agg_ids]
+def _decode_guess_rows(tok, agg_ids: np.ndarray,
+                       memo: Optional[Dict[int, str]] = None) -> List[List[str]]:
+    """Single-token decode per guess id, memoized: a 22-arm chunk decodes
+    1100 ids of which most repeat across arms (similar edits rank similar
+    tokens), and per-id HF ``decode`` calls are the cost that matters on the
+    real tokenizer."""
+    if memo is None:
+        memo = {}
+
+    def one(i: int) -> str:
+        got = memo.get(i)
+        if got is None:
+            got = memo[i] = tok.decode([i]).strip()
+        return got
+
+    return [[one(int(i)) for i in row] for row in agg_ids]
 
 
 # ---------------------------------------------------------------------------
@@ -656,9 +670,10 @@ def _collect_rows(
     n_resp = max(int(next_mask.sum()), 1)
 
     results: List[ArmResult] = []
+    guess_memo: Dict[int, str] = {}        # ids repeat heavily across arms
     for a in range(A):
         sl = slice(a * B, (a + 1) * B)
-        guesses = _decode_guess_rows(tok, agg_ids[sl])
+        guesses = _decode_guess_rows(tok, agg_ids[sl], memo=guess_memo)
         secret_prob = float(row_prob_sum[sl].sum()
                             / max(float(row_resp[sl].sum()), 1.0))
         dnll = float((edited_nll[sl] - state.baseline_nll).sum() / n_resp)
@@ -1029,6 +1044,7 @@ def run_intervention_studies(
     force: bool = False,
     mesh: Any = None,
     forcing: bool = False,
+    on_word_done: Optional[Callable[[str, Dict[str, Any]], None]] = None,
 ) -> Dict[str, Any]:
     """The full 20-word study: per word, load that word's checkpoint and run
     both sweeps, prefetching the NEXT word's checkpoint on a host thread while
@@ -1037,6 +1053,11 @@ def run_intervention_studies(
     Resumable the same way the generation cache is: a word whose results JSON
     already exists is skipped (delete it or pass ``force`` to redo), so a
     crashed sweep restarts where it stopped.
+
+    ``on_word_done(word, results)`` fires as each word's results exist
+    (computed or resumed) — the CLI uses it to render that word's figures on
+    a background thread while the NEXT word computes, instead of paying a
+    serial render tail after the sweep.
     """
     words = list(words if words is not None else config.words)
 
@@ -1049,6 +1070,8 @@ def run_intervention_studies(
         if done(word):
             with open(path) as f:
                 out[word] = json.load(f)
+            if on_word_done is not None:
+                on_word_done(word, out[word])
             continue
         params, cfg, tok = model_loader(word)
         # Overlap the next word's checkpoint IO with this word's compute —
@@ -1062,4 +1085,6 @@ def run_intervention_studies(
         out[word] = run_intervention_study(
             params, cfg, tok, config, word, sae, output_path=path, mesh=mesh,
             forcing=forcing)
+        if on_word_done is not None:
+            on_word_done(word, out[word])
     return out
