@@ -301,18 +301,19 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
     Study shape (Execution Plan / BASELINE.json): 20 words x (6 ablation
     budgets + 4 projection ranks) cells, each cell = 1 targeted + 10 random
     arms over 10 prompts, plus one baseline pass per word.  All budgets' arms
-    stack and launch ``arm_chunk`` (22) at a time, so the LARGEST arms config
-    below is the sweep's steady state; measuring a second, smaller config
-    fits the decode phase's latency intercept (decode = a + b*rows), which
-    feeds the v5e-8 derate model.
+    stack and launch up to ``arm_chunk`` (33) at a time, so the LARGEST arms
+    config below is the sweep's steady state; measuring a second, smaller
+    config fits the decode phase's latency intercept (decode = a + b*rows),
+    which feeds the v5e-8 derate model.
     """
     prompts_per_word = int(os.environ.get("BENCH_SWEEP_PROMPTS", "10"))
     # Default: one budget cell (11 = targeted + R=10) for the latency fit,
-    # then the production launch (arm_chunk=22: two budget cells folded into
-    # one 220-row launch).  Measured arm-seconds on v5e: 0.285/0.187/0.163/
-    # ~0.125 at 4/8/11/22 arms — rows amortize the latency-bound decode.
+    # then the production launch (arm_chunk=33: three budget cells folded
+    # into one 330-row launch).  Measured arm-seconds on v5e (post KV-carry
+    # fix): 0.14/0.108/0.096 at 11/22/33 arms — and a cliff at 44, see
+    # interventions._DEFAULT_ARM_CHUNK.
     arms_list = [int(a) for a in os.environ.get(
-        "BENCH_SWEEP_ARMS", "11,22" if on_accel else "2").split(",")]
+        "BENCH_SWEEP_ARMS", "11,33" if on_accel else "2").split(",")]
     reps = int(os.environ.get("BENCH_SWEEP_REPS", "2" if on_accel else "1"))
     arms_per_cell = 11          # targeted + R=10 random draws
     cells_per_word = 6 + 4      # ablation budgets + projection ranks
@@ -436,14 +437,14 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
     Word 1 pays all compiles; the steady-state number is the mean of the
     remaining words.  Shapes match the sweep bench cell: 10 prompts padded to
     ``prompt_len`` columns, ``new_tokens`` generated, 256k vocab, 16k SAE,
-    budgets {1..32} x R=10 + ranks {1,2,4,8} with arm_chunk=22.
+    budgets {1..32} x R=10 + ranks {1,2,4,8} with the default balanced
+    chunking (ablation 66 arms -> 2x33, projection 44 -> 2x22).
     """
     import shutil
     import tempfile
 
     import jax
 
-    from taboo_brittleness_tpu.cli import _save_study_plots
     from taboo_brittleness_tpu.config import (
         Config, ExperimentConfig, InterventionConfig, ModelConfig)
     from taboo_brittleness_tpu.ops import sae as sae_ops
@@ -478,31 +479,28 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
     def model_loader(word):
         return params, cfg, tok
 
-    from concurrent.futures import ThreadPoolExecutor
-
     out_dir = tempfile.mkdtemp(prefix="tbx_study_bench_")
     word_seconds = []
     try:
-        # Figures render on a background thread as each word completes,
-        # exactly as the CLI sweep does; the final join is timed and
-        # amortized into the steady-state number so nothing escapes the
+        # Figures render via the CLI's own background renderer (the SAME
+        # pipeline shape the sweep command runs); the final join is timed
+        # and amortized into the steady-state number so nothing escapes the
         # clock.
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            futures = []
+        from taboo_brittleness_tpu.cli import StudyPlotRenderer
+
+        with StudyPlotRenderer(config, out_dir) as renderer:
             for w in words:
                 t0 = time.perf_counter()
                 run_intervention_studies(
                     config, model_loader=model_loader, sae=sae, words=[w],
-                    output_dir=out_dir,
-                    on_word_done=lambda word, study: futures.append(
-                        pool.submit(_save_study_plots, config, study,
-                                    out_dir, word)))
+                    output_dir=out_dir, on_word_done=renderer.on_word_done)
                 word_seconds.append(round(time.perf_counter() - t0, 2))
             t0 = time.perf_counter()
-            for f in futures:
-                f.result()
+            renderer.join()
             join_seconds = time.perf_counter() - t0
     finally:
+        # The renderer context has drained its queue (even on exceptions)
+        # before this cleanup runs.
         shutil.rmtree(out_dir, ignore_errors=True)
 
     steady = (float(np.mean(word_seconds[1:])) if len(word_seconds) > 1

@@ -213,6 +213,45 @@ def _save_study_plots(config: Config, study, out_dir: str, word: str) -> list:
     return paths
 
 
+class StudyPlotRenderer:
+    """One-worker background renderer for per-word study figures.
+
+    Shared by the CLI sweep and bench.py's study block so both run the SAME
+    pipeline shape: each word's figures render while the next word computes;
+    ``join()`` waits for the queue to drain and returns the figure paths.
+    """
+
+    def __init__(self, config: Config, out_dir: str):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._config = config
+        self._out_dir = out_dir
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._futures: list = []
+
+    def on_word_done(self, word: str, study) -> None:
+        self._futures.append(self._pool.submit(
+            _save_study_plots, self._config, study, self._out_dir, word))
+
+    def join(self) -> list:
+        paths: list = []
+        try:
+            for f in self._futures:
+                paths.extend(f.result())
+        finally:
+            self._pool.shutdown(wait=True)
+        return paths
+
+    # Context-manager form so exception paths still drain the render queue
+    # (otherwise a raising word leaves a live worker thread writing into a
+    # directory the caller may be about to delete).
+    def __enter__(self) -> "StudyPlotRenderer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.join()
+
+
 def cmd_interventions(args) -> int:
     from taboo_brittleness_tpu.pipelines import interventions
 
@@ -250,25 +289,15 @@ def cmd_interventions(args) -> int:
         # next checkpoint prefetched while the current word computes.  Each
         # word's figures render on ONE background thread as its results land
         # (the device keeps computing the next word meanwhile) — matplotlib
-        # is ~2 s/word, a pure serial tail otherwise.
-        from concurrent.futures import ThreadPoolExecutor
-
+        # is a pure serial tail otherwise.
         out_dir = args.output or os.path.join("results", "interventions")
-        plot_paths: list = []
         with maybe_profile(args.trace_dir), manifest.stage("study-sweep"), \
-                ThreadPoolExecutor(max_workers=1) as pool:
-            futures = []
-
-            def render_when_done(word, study):
-                futures.append(pool.submit(
-                    _save_study_plots, config, study, out_dir, word))
-
+                StudyPlotRenderer(config, out_dir) as renderer:
             results = interventions.run_intervention_studies(
                 config, model_loader=loader, sae=sae, output_dir=out_dir,
                 mesh=mesh, forcing=args.forcing,
-                on_word_done=render_when_done)
-            for f in futures:
-                plot_paths.extend(f.result())
+                on_word_done=renderer.on_word_done)
+            plot_paths = renderer.join()
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
         for p_ in plot_paths:

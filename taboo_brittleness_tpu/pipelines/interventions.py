@@ -522,13 +522,15 @@ def _with_chunk_positions(ep: Any, chunk_positions) -> Any:
 _PER_PROMPT_KEYS = ("spike_positions", "positions")
 
 # Default max arms per batched launch when neither the caller nor the config
-# bounds it.  22 arms x 10 prompts = 220 rows: two full budget cells
-# (1 targeted + 10 random each) share one decode launch, which amortizes the
-# latency-bound sequential decode phase (VERDICT round-3: arm-seconds
-# 0.285/0.187/0.163 at 4/8/11 arms — rows keep paying off) while the row
-# count stays inside one chip's HBM at 9B shapes (~6 GB KV + ~1.8 GB captured
-# residual next to the tp-sharded params).
-_DEFAULT_ARM_CHUNK = 22
+# bounds it.  33 arms x 10 prompts = 330 rows: three full budget cells
+# (1 targeted + 10 random each) share one decode launch.  Measured per-arm
+# seconds on v5e (post KV-carry fix): 0.108 at 22 arms, 0.096 at 33 — and a
+# CLIFF at 44 (0.49 s/arm: the 440-row launch's KV + buffers exceed what
+# fits cleanly next to the params, and the compiler falls off its fast
+# path).  At 9B shapes 330 rows ≈ 4.8 GB of tp=4-sharded KV next to
+# 4.3 GB of params per chip; the AOT lowering in __graft_entry__ proves the
+# production programs at exactly this shape.
+_DEFAULT_ARM_CHUNK = 33
 
 
 def _tile_rows_ep(shared_ep: Any, per_arm: Dict[str, Any], n_arms: int,
@@ -747,18 +749,26 @@ def measure_arms(
     ``per_arm`` holds the arm-varying arrays with a leading arm axis (e.g.
     ``latent_ids`` [A, m] or ``basis`` [A, D, r]); ``shared_ep`` holds the
     rest (SAE weights, layer, spike positions).  Arms fold into the row axis
-    in chunks of ``arm_chunk`` (default: ``_DEFAULT_ARM_CHUNK``, sized so a
-    whole sweep's arm stack — all budgets at once — launches a few budgets'
-    worth of rows at a time): more rows per launch amortize the
-    latency-bound sequential decode (measured arm-seconds on v5e:
-    0.285/0.187/0.163/0.125 at 4/8/11/22 arms of 10 prompts), while the
-    chunk bound keeps the decode batch inside HBM (at 9B with B=10, 22 arms
-    = 220 rows ≈ 6 GB of KV cache — fine under tp sharding).
+    in chunks bounded by ``arm_chunk`` (default ``_DEFAULT_ARM_CHUNK`` = 33,
+    a few budget cells per launch), BALANCED over the minimum launch count:
+    more rows per launch amortize the latency-bound sequential decode
+    (measured arm-seconds on v5e: 0.285/0.187/0.163/0.108/0.096 at
+    4/8/11/22/33 arms of 10 prompts), while the chunk bound keeps the
+    decode batch inside HBM (at 9B with B=10, 33 arms = 330 rows ≈ 4.8 GB
+    of tp=4-sharded KV per chip — and 44 arms measurably falls off an HBM
+    cliff at the bench shape, see ``_DEFAULT_ARM_CHUNK``).
     """
     A = int(next(iter(per_arm.values())).shape[0])
     B = state.sequences.shape[0]
-    chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
-             or min(A, _DEFAULT_ARM_CHUNK))
+    max_chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
+                 or min(A, _DEFAULT_ARM_CHUNK))
+    # Balance the arms over the minimum number of launches instead of
+    # greedily filling to max_chunk: the ablation stack (66 arms) and the
+    # projection stack (44) then split 2x33 and 2x22 at the default instead
+    # of 44 chunking as 33 + 11-padded-to-33 (a whole budget cell of wasted
+    # decode rows, measured at ~2 s/word).
+    n_launches = -(-A // max_chunk)
+    chunk = -(-A // n_launches)
 
     # Software-pipelined chunk loop: chunk i+1's decode/readout/NLL enqueue
     # BEFORE chunk i's results are pulled, so the device never idles through

@@ -163,19 +163,23 @@ def forcing_under_arms(
     ``arm_chunk`` bounds the rows per launch exactly like
     ``interventions.measure_arms`` (same HBM argument; the postgame rows are
     longer than hint prompts — 3 warm-up turns of dialogue + the final
-    prompt); ragged tails pad by repeating the last arm so chunks share one
-    compiled program.
+    prompt), and like it the arms BALANCE over the minimum launch count so
+    a stack just over the bound splits into near-equal chunks instead of a
+    full chunk plus a mostly-padded tail; ragged tails pad by repeating the
+    last arm so chunks share one compiled program.
     """
     import jax.numpy as jnp
 
     A = int(next(iter(per_arm.values())).shape[0])
     if arm_chunk and arm_chunk < A:
+        n_launches = -(-A // arm_chunk)
+        chunk = -(-A // n_launches)
         out: List[Dict[str, float]] = []
-        for start in range(0, A, arm_chunk):
-            sub = {k: jnp.asarray(v)[start:start + arm_chunk]
+        for start in range(0, A, chunk):
+            sub = {k: jnp.asarray(v)[start:start + chunk]
                    for k, v in per_arm.items()}
             a = int(next(iter(sub.values())).shape[0])
-            pad = arm_chunk - a
+            pad = chunk - a
             if pad:
                 sub = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
                        for k, v in sub.items()}

@@ -278,6 +278,36 @@ def test_full_study_writes_json(setup, tmp_path):
     assert loaded["word"] == WORD
 
 
+def test_study_json_schema_is_stable(setup, tmp_path):
+    """Downstream analysis (plots, reports, cross-round comparisons) keys on
+    this exact structure — a silent schema change would orphan every
+    previously written study JSON, so pin it field by field."""
+    params, cfg, tok, config, sae = setup
+    res = iv.run_intervention_study(
+        params, cfg, tok, config, WORD, sae,
+        output_path=str(tmp_path / "s.json"))
+
+    assert set(res["baseline"]) == {"secret_prob", "guesses", "response_texts"}
+    assert set(res["ablation"]) == {"word", "scoring", "budgets"}
+    assert res["ablation"]["scoring"] in ("correlation", "cosine")
+    assert set(res["projection"]) == {"word", "ranks"}
+
+    arm_keys = {"secret_prob", "secret_prob_drop", "delta_nll", "leak_rate",
+                "prompt_accuracy", "any_pass", "guesses"}
+    mean_keys = arm_keys - {"guesses"}
+    for grid, key in ((res["ablation"]["budgets"], "budgets"),
+                      (res["projection"]["ranks"], "ranks")):
+        expected = {str(v) for v in getattr(config.intervention, key)}
+        assert set(grid) == expected
+        for cell in grid.values():
+            assert set(cell) == {"targeted", "random_mean", "random"}
+            assert set(cell["targeted"]) == arm_keys
+            assert set(cell["random_mean"]) == mean_keys
+            assert len(cell["random"]) == config.intervention.random_trials
+            for r in cell["random"]:
+                assert set(r) == arm_keys
+
+
 # ---------------------------------------------------------------------------
 # Round-3: one compiled program across arms/budgets; batched-arm parity.
 # ---------------------------------------------------------------------------
