@@ -234,9 +234,13 @@ class StudyPlotRenderer:
             _save_study_plots, self._config, study, self._out_dir, word))
 
     def join(self) -> list:
+        """Drain the queue and return figure paths.  Idempotent: the normal
+        flow calls join() explicitly and then again via __exit__ — the second
+        call must not re-iterate (or re-raise from) consumed futures."""
+        futures, self._futures = self._futures, []
         paths: list = []
         try:
-            for f in self._futures:
+            for f in futures:
                 paths.extend(f.result())
         finally:
             self._pool.shutdown(wait=True)

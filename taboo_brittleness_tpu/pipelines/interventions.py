@@ -533,6 +533,17 @@ _PER_PROMPT_KEYS = ("spike_positions", "positions")
 _DEFAULT_ARM_CHUNK = 33
 
 
+def _balanced_chunk(n_arms: int, max_chunk: int) -> int:
+    """Arms per launch, BALANCED over the minimum launch count: a stack just
+    over the bound splits into near-equal chunks (66 at max 33 → 2x33; 44 →
+    2x22) instead of a full chunk plus a mostly-padded tail (44 → 33 + 11
+    padded to 33 wastes a whole budget cell of decode rows, ~2 s/word).
+    Shared by ``measure_arms`` and ``token_forcing.forcing_under_arms`` so
+    the two chunkers can never drift apart."""
+    n_launches = -(-n_arms // max_chunk)
+    return -(-n_arms // n_launches)
+
+
 def _tile_rows_ep(shared_ep: Any, per_arm: Dict[str, Any], n_arms: int,
                   batch: int) -> Any:
     """Build the row-axis edit_params for ``n_arms`` arms x ``batch`` prompts
@@ -750,25 +761,19 @@ def measure_arms(
     ``latent_ids`` [A, m] or ``basis`` [A, D, r]); ``shared_ep`` holds the
     rest (SAE weights, layer, spike positions).  Arms fold into the row axis
     in chunks bounded by ``arm_chunk`` (default ``_DEFAULT_ARM_CHUNK`` = 33,
-    a few budget cells per launch), BALANCED over the minimum launch count:
-    more rows per launch amortize the latency-bound sequential decode
-    (measured arm-seconds on v5e: 0.285/0.187/0.163/0.108/0.096 at
-    4/8/11/22/33 arms of 10 prompts), while the chunk bound keeps the
-    decode batch inside HBM (at 9B with B=10, 33 arms = 330 rows ≈ 4.8 GB
-    of tp=4-sharded KV per chip — and 44 arms measurably falls off an HBM
-    cliff at the bench shape, see ``_DEFAULT_ARM_CHUNK``).
+    a few budget cells per launch), BALANCED over the minimum launch count
+    (``_balanced_chunk``): more rows per launch amortize the latency-bound
+    sequential decode (measured arm-seconds on v5e, post KV-carry fix:
+    0.14/0.108/0.096 at 11/22/33 arms of 10 prompts), while the chunk bound
+    keeps the decode batch inside HBM (at 9B with B=10, 33 arms = 330 rows
+    ≈ 4.8 GB of tp=4-sharded KV per chip — and 44 arms measurably falls off
+    an HBM cliff at the bench shape, see ``_DEFAULT_ARM_CHUNK``).
     """
     A = int(next(iter(per_arm.values())).shape[0])
     B = state.sequences.shape[0]
     max_chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
                  or min(A, _DEFAULT_ARM_CHUNK))
-    # Balance the arms over the minimum number of launches instead of
-    # greedily filling to max_chunk: the ablation stack (66 arms) and the
-    # projection stack (44) then split 2x33 and 2x22 at the default instead
-    # of 44 chunking as 33 + 11-padded-to-33 (a whole budget cell of wasted
-    # decode rows, measured at ~2 s/word).
-    n_launches = -(-A // max_chunk)
-    chunk = -(-A // n_launches)
+    chunk = _balanced_chunk(A, max_chunk)
 
     # Software-pipelined chunk loop: chunk i+1's decode/readout/NLL enqueue
     # BEFORE chunk i's results are pulled, so the device never idles through

@@ -172,8 +172,10 @@ def forcing_under_arms(
 
     A = int(next(iter(per_arm.values())).shape[0])
     if arm_chunk and arm_chunk < A:
-        n_launches = -(-A // arm_chunk)
-        chunk = -(-A // n_launches)
+        from taboo_brittleness_tpu.pipelines.interventions import (
+            _balanced_chunk)
+
+        chunk = _balanced_chunk(A, arm_chunk)
         out: List[Dict[str, float]] = []
         for start in range(0, A, chunk):
             sub = {k: jnp.asarray(v)[start:start + chunk]
