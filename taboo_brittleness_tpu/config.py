@@ -142,8 +142,9 @@ class InterventionConfig:
     # spike-localized arm) instead of every position of every forward.
     spike_masked: bool = False
     # Max arms folded into one batched launch (None = the pipeline default,
-    # interventions._DEFAULT_ARM_CHUNK: a couple of budget cells' worth of
-    # rows per decode; lower it if the batch exceeds HBM on one chip).
+    # interventions._DEFAULT_ARM_CHUNK = 33: three budget cells' worth of
+    # rows per decode, balanced over the minimum launch count; lower it if
+    # the batch exceeds HBM on one chip).
     arm_chunk: Optional[int] = None
     # Targeted-latent scoring estimator (Execution Plan scoring section):
     # "correlation" (plan-faithful default) = mean spike activation x positive
