@@ -1,0 +1,125 @@
+"""Multi-host entry points: process initialization + host-aware meshes.
+
+The reference has no distributed backend at all (single process, batch 1 —
+SURVEY.md §2.4); this framework's collectives are XLA-emitted over ICI
+within a slice (parallel/mesh.py).  Scaling past one host is, the JAX way,
+NOT a new communication backend: ``jax.distributed`` brings every host's
+devices into one global ``jax.devices()`` view, and the same
+``NamedSharding`` annotations then emit DCN collectives wherever a sharded
+axis crosses hosts.  What this module adds is the glue that decides WHICH
+axes cross hosts:
+
+- ``initialize()`` — one call per process before any jax use; no-op for
+  single-process runs so every entry point can call it unconditionally.
+- ``make_host_mesh()`` — a (dp, tp, sp) mesh laid out so tp/sp (the
+  per-matmul, latency-sensitive axes) stay WITHIN a host's slice (ICI) and
+  only dp — the embarrassingly parallel sweep grid, one all-reduce-free
+  word/prompt shard per host group — spans hosts over DCN.  This is the
+  layout the v5e-8 derate model assumes, extended to N slices.
+
+The 20-word study needs none of this (one v5e-8 host beats the < 1 h north
+star ~13x); it exists so a multi-slice run is a config change, not an
+architecture change.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from taboo_brittleness_tpu.config import MeshConfig
+from taboo_brittleness_tpu.parallel.mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-process JAX runtime; returns True when it did.
+
+    Single-process runs (no arguments AND no cluster environment) are a
+    NO-OP, so pipelines can call this unconditionally.  With arguments — or
+    inside a recognized cluster environment (GKE/SLURM, where
+    ``jax.distributed.initialize`` auto-detects everything) — every process
+    must call it BEFORE any other jax API touches a backend.
+    """
+    explicit = any(a is not None
+                   for a in (coordinator_address, num_processes, process_id))
+    cluster_env = any(v in os.environ for v in (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID"))
+    if not explicit and not cluster_env:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_host_mesh(
+    mesh_cfg: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(dp, tp, sp) mesh over ALL processes' devices, host-locality-aware.
+
+    Devices group by ``process_index`` first, so with dp a multiple of the
+    host count the model axes (tp, sp — per-matmul collectives every layer)
+    always land inside one host's slice and ride ICI, while dp crosses
+    hosts over DCN only at the (rare) sweep-grid boundaries.  Requires
+    tp * sp to divide the per-host device count for that reason — a mesh
+    that would stripe a matmul over DCN is a configuration error, not a
+    slow mode.
+
+    Single-process: identical to ``parallel.mesh.make_mesh``.
+    """
+    mesh_cfg = mesh_cfg or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    n_hosts = len({d.process_index for d in devs})
+    if n_hosts <= 1:
+        return make_mesh(mesh_cfg, devices=devs)
+
+    if len(devs) % n_hosts:
+        # Uneven hosts would force some (tp, sp) column across a host
+        # boundary no matter how we reshape — reject instead of silently
+        # striping per-matmul collectives over DCN.
+        raise ValueError(
+            f"{len(devs)} devices across {n_hosts} hosts are uneven; "
+            "every host must contribute the same device count")
+    per_host = len(devs) // n_hosts
+    # -1 model axes absorb the PER-HOST remainder (the multi-host analogue
+    # of make_mesh's "-1 = all remaining devices"): tp=-1 takes what sp
+    # leaves within a host, never devices on another host.
+    sp = mesh_cfg.sp
+    tp = mesh_cfg.tp
+    if sp == -1 and tp == -1:
+        raise ValueError("at most one of tp/sp may be -1")
+    if sp == -1:
+        sp = per_host // max(tp, 1)
+    if tp == -1:
+        tp = per_host // max(sp, 1)
+    if per_host % (tp * sp):
+        raise ValueError(
+            f"tp*sp={tp * sp} must divide the {per_host} devices per host: "
+            "the model axes must stay on ICI (one host's slice); only dp "
+            "may cross hosts over DCN")
+    # Host-major device order: [host0's devices, host1's, ...] — reshaped to
+    # (dp, tp, sp), consecutive tp/sp coordinates then stay within a host.
+    ordered = sorted(devs, key=lambda d: (d.process_index, d.id))
+    dp = mesh_cfg.dp
+    if dp == -1:
+        dp = len(devs) // (tp * sp)
+    if dp * tp * sp != len(devs):
+        raise ValueError(
+            f"mesh dp={dp} tp={tp} sp={sp} needs {dp * tp * sp} devices, "
+            f"have {len(devs)} across {n_hosts} hosts")
+    arr = np.asarray(ordered).reshape(dp, tp, sp)
+    return Mesh(arr, ("dp", "tp", "sp"))

@@ -450,3 +450,58 @@ def test_sp_lens_route_rejects_unsupported_flags():
     with pytest.raises(ValueError, match="Pallas"):
         lens_ops.lens_forward(params, cfg, ids, targets, tap_layer=2,
                               use_pallas=True, tp_mesh=m)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host glue (parallel/multihost.py).  Virtual CPU devices all share
+# process_index 0, so the host-grouping branch is exercised by spoofing the
+# index; the single-process paths run for real.
+# ---------------------------------------------------------------------------
+
+def test_multihost_initialize_is_noop_single_process(monkeypatch):
+    from taboo_brittleness_tpu.parallel import multihost
+
+    for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID"):
+        monkeypatch.delenv(v, raising=False)
+    assert multihost.initialize() is False    # no cluster env -> no-op
+
+
+def test_multihost_mesh_single_process_matches_make_mesh():
+    from taboo_brittleness_tpu.parallel import multihost
+
+    m = multihost.make_host_mesh(MeshConfig(dp=2, tp=4, sp=1))
+    assert dict(m.shape) == {"dp": 2, "tp": 4, "sp": 1}
+
+
+def test_multihost_mesh_keeps_model_axes_on_host():
+    """With devices spoofed onto 2 hosts, every (tp, sp) column of the mesh
+    must sit on ONE host — the model axes ride ICI, dp crosses DCN."""
+    from taboo_brittleness_tpu.parallel import multihost
+
+    class Dev:
+        def __init__(self, i, host):
+            self.id = i
+            self.process_index = host
+
+        def __repr__(self):
+            return f"Dev({self.id},h{self.process_index})"
+
+    devs = [Dev(i, i // 4) for i in range(8)]      # 2 hosts x 4 devices
+    m = multihost.make_host_mesh(MeshConfig(dp=2, tp=4, sp=1), devices=devs)
+    arr = np.asarray(m.devices)
+    assert arr.shape == (2, 4, 1)
+    for d in range(2):                              # each dp row = one host
+        hosts = {arr[d, t, 0].process_index for t in range(4)}
+        assert len(hosts) == 1
+
+    with pytest.raises(ValueError, match="must divide"):
+        multihost.make_host_mesh(MeshConfig(dp=1, tp=8, sp=1), devices=devs)
+
+    # -1 model axes absorb the PER-HOST remainder (tp=4 here), never another
+    # host's devices; uneven hosts are rejected outright.
+    m2 = multihost.make_host_mesh(MeshConfig(dp=-1, tp=-1, sp=1), devices=devs)
+    assert dict(m2.shape) == {"dp": 2, "tp": 4, "sp": 1}
+    with pytest.raises(ValueError, match="uneven"):
+        multihost.make_host_mesh(MeshConfig(dp=-1, tp=1, sp=1),
+                                 devices=devs[:7])
