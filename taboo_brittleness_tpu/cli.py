@@ -63,14 +63,19 @@ def _load(args) -> Config:
 
 def _mesh(config: Config):
     """Build the (dp, tp, sp) device mesh from config when the host has more
-    than one device; None on a single chip (plain single-device execution)."""
+    than one device; None on a single chip (plain single-device execution).
+
+    Host-locality-aware across processes (``parallel/multihost.py``); the
+    multi-process runtime itself is joined at the top of ``main`` — it must
+    run before ANY jax API touches a backend, and some subcommands build
+    their run manifest (which queries jax.devices) before their mesh."""
     import jax
 
     if len(jax.devices()) <= 1:
         return None
-    from taboo_brittleness_tpu.parallel import mesh as meshlib
+    from taboo_brittleness_tpu.parallel import multihost
 
-    return meshlib.make_mesh(config.mesh)
+    return multihost.make_host_mesh(config.mesh)
 
 
 def _loader(config: Config, args, mesh=None):
@@ -382,6 +387,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # Join the multi-process runtime BEFORE anything touches a jax backend
+    # (manifest env-info queries jax.devices before some subcommands build
+    # their mesh); no-op outside a cluster environment.
+    from taboo_brittleness_tpu.parallel import multihost
+
+    multihost.initialize()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -43,17 +43,23 @@ def initialize(
 ) -> bool:
     """Join the multi-process JAX runtime; returns True when it did.
 
-    Single-process runs (no arguments AND no cluster environment) are a
-    NO-OP, so pipelines can call this unconditionally.  With arguments — or
-    inside a recognized cluster environment (GKE/SLURM, where
-    ``jax.distributed.initialize`` auto-detects everything) — every process
-    must call it BEFORE any other jax API touches a backend.
+    Single-process runs (no arguments AND no coordinator address in the
+    environment) are a NO-OP, so pipelines can call this unconditionally.
+    With arguments — or with a coordinator address exported — every process
+    must call it BEFORE any other jax API touches a backend (the CLI calls
+    it first thing in ``main``).
+
+    Deliberately keyed on COORDINATOR addresses only, NOT on scheduler
+    markers like SLURM_JOB_ID: a single-process run inside an ordinary
+    sbatch/salloc allocation must stay single-process instead of hanging in
+    coordinator auto-detection — multi-process SLURM launches export a
+    coordinator address (or pass explicit arguments) to opt in.
     """
     explicit = any(a is not None
                    for a in (coordinator_address, num_processes, process_id))
     cluster_env = any(v in os.environ for v in (
         "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-        "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID"))
+        "MEGASCALE_COORDINATOR_ADDRESS"))
     if not explicit and not cluster_env:
         return False
     jax.distributed.initialize(

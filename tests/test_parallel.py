@@ -505,3 +505,30 @@ def test_multihost_mesh_keeps_model_axes_on_host():
     with pytest.raises(ValueError, match="uneven"):
         multihost.make_host_mesh(MeshConfig(dp=-1, tp=1, sp=1),
                                  devices=devs[:7])
+
+
+def test_forward_sp_long_context_sp8():
+    """Long-context scale check for the ring path: T=256 over sp=8 (32
+    positions per shard, ~85x the tiny config's sliding window) — ring
+    attention must still match the dense forward bit-for-tolerance.  The
+    smaller sp tests catch boundary logic; this one catches accumulation
+    drift and window handling across MANY shard hops."""
+    from taboo_brittleness_tpu.parallel import sp as splib
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(8), cfg)
+    rng = np.random.default_rng(9)
+    B, T = 1, 256
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(B, T)))
+
+    dense = gemma2.forward(params, cfg, ids, per_layer_fn=lambda h, i: h)
+
+    m = meshlib.make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    got = splib.forward_sp(params, cfg, ids, m, tap_layer=2)
+
+    np.testing.assert_allclose(np.asarray(got.logits),
+                               np.asarray(dense.logits),
+                               atol=5e-5, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(got.residual),
+                               np.asarray(dense.taps[2]),
+                               atol=5e-5, rtol=2e-4)
