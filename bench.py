@@ -21,9 +21,13 @@ envelope that is ~2 s decode + ~3 s trace/transfer + ~10 s compression ≈ 0.07
 prompts/sec.  No faster number is published ("published": {} in BASELINE.json),
 so 0.07 prompts/sec is the reference point; vs_baseline = ours / 0.07.
 
-Output: ONE JSON line {"metric", "value", "unit", "vs_baseline"} plus the
-north-star account (BASELINE.json north_star: "< 1 h on v5e-8"), in three
-blocks:
+Output contract: the FINAL stdout line is ONE compact JSON headline
+{"metric", "value", "unit", "vs_baseline", "mfu",
+"projected_full_sweep_hours", "measured_study_seconds_per_word", ...}; the
+full sweep/study detail blocks go to results/bench_detail.json (round-4
+lesson: the driver's finite stdout tail window truncated a mega-line and the
+round recorded no parseable value).  The detail file carries the north-star
+account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
 
 - "sweep": measured sweep launches (decode + readout + NLL, the three
   compiled programs of pipelines.interventions) at one-cell (11 arms) and
@@ -618,7 +622,7 @@ def main() -> int:
             projection_word_seconds=(
                 sweep["word_seconds_10_cells_plus_baseline"] if sweep else 0.0))
 
-    print(json.dumps({
+    headline = {
         "metric": "ablation-sweep prompts/sec/chip "
                   f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
         "value": round(prompts_per_sec, 3),
@@ -633,16 +637,34 @@ def main() -> int:
                    "prompt_len": prompt_len, "reps": reps},
         # North-star account (BASELINE.json: full sweep "< 1 h on v5e-8").
         # Headline = the DERATED v5e-8 projection (decode latency intercept +
-        # tp collectives charged); the band and the measured mini-study are in
-        # the sweep/study blocks.
+        # tp collectives charged); the band and the measured mini-study live
+        # in results/bench_detail.json.
         "projected_full_sweep_hours": (
             sweep and
             sweep["projected_full_sweep_hours_v5e8_9b_band"]["derated"]),
         "measured_study_seconds_per_word": (
             study and study["measured_study_seconds_per_word"]),
-        "sweep": sweep,
-        "study": study,
-    }))
+        "detail": "results/bench_detail.json",
+    }
+
+    # Round-4 lesson (VERDICT r04 weak #1): the driver captures a finite TAIL
+    # window of stdout, and one mega-line with the sweep/study blocks inline
+    # overflowed it — the headline was truncated away and the round recorded
+    # "parsed: null".  Contract since: the compact headline is the LAST stdout
+    # line (printed first, flushed — the detail write emits nothing to
+    # stdout), detail blocks go to a FILE, and a detail-write failure must
+    # not void the already-printed headline.
+    print(json.dumps(headline), flush=True)
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results", "bench_detail.json")
+    try:
+        os.makedirs(os.path.dirname(detail_path), exist_ok=True)
+        with open(detail_path, "w") as f:
+            json.dump({"headline": headline, "sweep": sweep, "study": study},
+                      f, indent=1)
+    except OSError as e:
+        print(f"bench_detail.json write failed (headline unaffected): {e}",
+              file=sys.stderr)
     return 0
 
 
