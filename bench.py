@@ -622,6 +622,8 @@ def main() -> int:
             projection_word_seconds=(
                 sweep["word_seconds_10_cells_plus_baseline"] if sweep else 0.0))
 
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results", "bench_detail.json")
     headline = {
         "metric": "ablation-sweep prompts/sec/chip "
                   f"({preset}, {new_tokens} new tokens, in-graph SAE ablation + 256k lens)",
@@ -644,7 +646,7 @@ def main() -> int:
             sweep["projected_full_sweep_hours_v5e8_9b_band"]["derated"]),
         "measured_study_seconds_per_word": (
             study and study["measured_study_seconds_per_word"]),
-        "detail": "results/bench_detail.json",
+        "detail": detail_path,
     }
 
     # Round-4 lesson (VERDICT r04 weak #1): the driver captures a finite TAIL
@@ -655,14 +657,15 @@ def main() -> int:
     # stdout), detail blocks go to a FILE, and a detail-write failure must
     # not void the already-printed headline.
     print(json.dumps(headline), flush=True)
-    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "results", "bench_detail.json")
     try:
+        from taboo_brittleness_tpu.pipelines.interventions import (
+            _atomic_json_dump)
+
         os.makedirs(os.path.dirname(detail_path), exist_ok=True)
-        with open(detail_path, "w") as f:
-            json.dump({"headline": headline, "sweep": sweep, "study": study},
-                      f, indent=1)
-    except OSError as e:
+        _atomic_json_dump(
+            {"headline": headline, "sweep": sweep, "study": study},
+            detail_path)
+    except Exception as e:  # noqa: BLE001 — detail is best-effort by contract
         print(f"bench_detail.json write failed (headline unaffected): {e}",
               file=sys.stderr)
     return 0

@@ -60,17 +60,16 @@ def _strip_stop(text: str) -> str:
     return text.replace(chat.END_OF_TURN, "").replace("<eos>", "").strip()
 
 
-def pregame_forcing(
-    params: Params,
-    cfg: Gemma2Config,
-    tok: TokenizerLike,
-    config: Config,
-    word: str,
-    *,
-    edit_fn: Optional[Callable] = None,
-    edit_params: Any = None,
-) -> Dict[str, Any]:
-    """All prefill phrases at once; completion = prefill + generation."""
+def _pregame_completions(
+    params: Params, cfg: Gemma2Config, tok: TokenizerLike, config: Config,
+    *, edit_fn: Optional[Callable] = None, edit_params: Any = None,
+) -> List[str]:
+    """All prefill phrases at once; completion = prefill + generation.
+
+    Word-independent given the model: the rendered rows mention no secret,
+    so for one ``params`` the completions serve EVERY word (the per-word
+    part is only the valid-forms scoring) — ``run_token_forcing`` exploits
+    this to fold a shared-model word list into one launch."""
     phrases = list(config.token_forcing.prefill_phrases)
     rendered = [
         chat.render_chat([chat.Turn("user", "")], prefill=p) for p in phrases
@@ -80,34 +79,23 @@ def pregame_forcing(
         max_new_tokens=config.experiment.max_new_tokens,
         edit_fn=edit_fn, edit_params=edit_params,
         pad_to_multiple=config.experiment.pad_to_multiple)
-    completions = [f"{p}{g}" for p, g in zip(phrases, gens)]
-    valid_forms = {f.lower() for f in config.word_plurals.get(word, [word])}
-    success = metrics_mod.forcing_success(completions, valid_forms)
-    return {
-        "word": word,
-        "mode": "pregame",
-        "success_rate": success,
-        "completions": completions,
-    }
+    return [f"{p}{g}" for p, g in zip(phrases, gens)]
 
 
-def postgame_forcing(
-    params: Params,
-    cfg: Gemma2Config,
-    tok: TokenizerLike,
-    config: Config,
-    word: str,
-    *,
-    edit_fn: Optional[Callable] = None,
-    edit_params: Any = None,
-) -> Dict[str, Any]:
-    """Warm-up dialogue first (model actually answers each hint turn), then the
-    final adversarial turn with each forcing prefill, batched."""
+def _postgame_completions(
+    params: Params, cfg: Gemma2Config, tok: TokenizerLike, config: Config,
+    *, edit_fn: Optional[Callable] = None, edit_params: Any = None,
+):
+    """Warm-up dialogue first (model actually answers each hint turn), then
+    the final adversarial turn with each forcing prefill, batched.  Returns
+    ``(completions, warmup_transcript)``; word-independent like the pregame
+    (fixed warm-up prompts, greedy decode)."""
     kw = dict(edit_fn=edit_fn, edit_params=edit_params,
               pad_to_multiple=config.experiment.pad_to_multiple)
     mnt = config.experiment.max_new_tokens
 
-    # Warm-up: 3 sequential turns, each one batched decode of a single row.
+    # Warm-up: 3 sequential turns (turn t+1 depends on turn t's reply), each
+    # one decode of the single evolving conversation row.
     turns: List[chat.Turn] = []
     for user_msg in config.token_forcing.warmup_prompts:
         turns.append(chat.Turn("user", user_msg))
@@ -121,17 +109,51 @@ def postgame_forcing(
     rendered = [chat.render_chat(turns, prefill=p) for p in phrases]
     gens = _decode_rendered(params, cfg, tok, rendered, max_new_tokens=mnt, **kw)
     completions = [f"{p}{g}" for p, g in zip(phrases, gens)]
+    transcript = [{"role": t.role, "content": t.content} for t in turns]
+    return completions, transcript
 
+
+def _score_entry(config: Config, word: str, mode: str,
+                 completions: List[str], **extra: Any) -> Dict[str, Any]:
     valid_forms = {f.lower() for f in config.word_plurals.get(word, [word])}
     return {
         "word": word,
-        "mode": "postgame",
+        "mode": mode,
         "success_rate": metrics_mod.forcing_success(completions, valid_forms),
         "completions": completions,
-        "warmup_transcript": [
-            {"role": t.role, "content": t.content} for t in turns
-        ],
+        **extra,
     }
+
+
+def pregame_forcing(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    *,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+) -> Dict[str, Any]:
+    completions = _pregame_completions(
+        params, cfg, tok, config, edit_fn=edit_fn, edit_params=edit_params)
+    return _score_entry(config, word, "pregame", completions)
+
+
+def postgame_forcing(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    *,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+) -> Dict[str, Any]:
+    completions, transcript = _postgame_completions(
+        params, cfg, tok, config, edit_fn=edit_fn, edit_params=edit_params)
+    return _score_entry(config, word, "postgame", completions,
+                        warmup_transcript=transcript)
 
 
 def forcing_under_arms(
@@ -258,6 +280,18 @@ def run_token_forcing(
     (ablated / projected model) — the Execution Plan measures forcing success
     per arm, so the driver composes this with the intervention sweeps.
 
+    Launch economics (VERDICT r04 #8): the forcing decodes are
+    word-independent given the model (empty-prompt prefills, fixed warm-up
+    turns, greedy decode), so completions are memoized on the loaded
+    ``params`` object's identity.  A shared-model loader (tests, bench,
+    arm studies) therefore pays ONE set of launches — 3 warm-up decodes
+    total, not 3 per word — for the entire word list; only the per-word
+    valid-forms scoring repeats.  Real per-word taboo checkpoints yield a
+    fresh ``params`` per word and recompute, which is forced: batching the
+    warm-up across words with distinct checkpoints would need every
+    checkpoint resident at once (stacked params — the 9B HBM budget rules
+    it out), so per-word launches are already the batching optimum there.
+
     Resumable exactly like ``run_intervention_studies``: with ``output_dir``
     each word's results write atomically to ``<output_dir>/<word>.json`` as
     soon as they exist, and a word whose file exists is skipped (its model is
@@ -289,12 +323,20 @@ def run_token_forcing(
         return load_done(w) is not None
 
     results: Dict[str, Any] = {}
+    # Completion memo for the CURRENT params object (see docstring): compare
+    # by identity, replace on miss so a real per-word loader never holds more
+    # than the in-flight checkpoint alive through this reference.
+    memo_params: Any = None
+    memo: Dict[str, Any] = {}
+    kw = dict(edit_fn=edit_fn, edit_params=edit_params)
     for i, word in enumerate(words):
         saved = load_done(word)
         if saved is not None:
             results[word] = saved
             continue
         params, cfg, tok = model_loader(word)
+        if params is not memo_params:
+            memo_params, memo = params, {}
         # Overlap the next *running* word's checkpoint IO with this word's
         # compute (a to-be-skipped word would pin the pending slot forever).
         # next() stops at the first pending word — no full O(words²) rescan
@@ -304,13 +346,19 @@ def run_token_forcing(
             prefetch_next(model_loader, [word, nxt], 0)
         entry: Dict[str, Any] = {}
         if "pregame" in modes:
-            entry["pregame"] = pregame_forcing(
-                params, cfg, tok, config, word,
-                edit_fn=edit_fn, edit_params=edit_params)
+            if "pregame" not in memo:
+                memo["pregame"] = _pregame_completions(
+                    params, cfg, tok, config, **kw)
+            entry["pregame"] = _score_entry(
+                config, word, "pregame", memo["pregame"])
         if "postgame" in modes:
-            entry["postgame"] = postgame_forcing(
-                params, cfg, tok, config, word,
-                edit_fn=edit_fn, edit_params=edit_params)
+            if "postgame" not in memo:
+                memo["postgame"] = _postgame_completions(
+                    params, cfg, tok, config, **kw)
+            completions, transcript = memo["postgame"]
+            entry["postgame"] = _score_entry(
+                config, word, "postgame", completions,
+                warmup_transcript=transcript)
         results[word] = entry
         if output_dir:
             _atomic_json_dump(entry, word_path(word))

@@ -183,3 +183,50 @@ def test_forcing_under_arms_identity_matches_unedited(setup):
     for arm in res:
         assert 0.0 <= arm["pregame"] <= 1.0
         assert 0.0 <= arm["postgame"] <= 1.0
+
+
+def test_run_token_forcing_memoizes_shared_model(setup, monkeypatch, tmp_path):
+    """A shared-model loader pays ONE set of forcing launches for the whole
+    word list (the decodes are word-independent given the model; VERDICT
+    r04 #8): 1 pregame + 3 warm-up + 1 final decode total, not per word.
+    A fresh params object (real per-word checkpoints) must recompute."""
+    import jax
+
+    from taboo_brittleness_tpu.config import Config
+    from taboo_brittleness_tpu.models import gemma2
+
+    params, cfg, tok, config = setup
+    config2 = Config(
+        model=config.model, experiment=config.experiment,
+        word_plurals={WORD: [WORD], "word2": ["word2"], "word3": ["word3"]},
+        prompts=config.prompts, token_forcing=config.token_forcing)
+
+    calls = []
+    real = tf._decode_rendered
+
+    def counting(params_, cfg_, tok_, rendered, **kw):
+        calls.append(len(rendered))
+        return real(params_, cfg_, tok_, rendered, **kw)
+
+    monkeypatch.setattr(tf, "_decode_rendered", counting)
+
+    res = tf.run_token_forcing(
+        config2, model_loader=lambda w: (params, cfg, tok),
+        words=[WORD, "word2", "word3"], modes=("pregame", "postgame"))
+    n_warmup = len(config.token_forcing.warmup_prompts)
+    n_phrases = len(config.token_forcing.prefill_phrases)
+    # One launch set for 3 words: pregame batch + per-turn warm-ups + final.
+    assert calls == [n_phrases] + [1] * n_warmup + [n_phrases]
+    # Scoring is still per word (same completions, different valid forms).
+    assert set(res["words"]) == {WORD, "word2", "word3"}
+    assert (res["words"][WORD]["pregame"]["completions"]
+            == res["words"]["word2"]["pregame"]["completions"])
+
+    # A DIFFERENT params object invalidates the memo.
+    calls.clear()
+    params2 = gemma2.init_params(jax.random.PRNGKey(99), cfg)
+    loaders = {WORD: params, "word2": params2}
+    tf.run_token_forcing(
+        config2, model_loader=lambda w: (loaders[w], cfg, tok),
+        words=[WORD, "word2"], modes=("pregame",))
+    assert calls == [n_phrases, n_phrases]
