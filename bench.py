@@ -108,10 +108,14 @@ def _phase_flops(cfg, batch: int, prompt_len: int, new_tokens: int,
     lens_f += toks_lens * L * 2 * D * V         # the dominant term
     lens_f += toks_lens * 2 * D * sae_width     # edit rides this pass too
 
-    # NLL pass: one teacher-forced forward + ONE unembed over the sequence.
-    nll_f = toks_lens * L * per_tok_layer + attn(toks_lens, t_total) * L
-    nll_f += toks_lens * 2 * D * V
-    nll_f += toks_lens * 2 * D * sae_width
+    # NLL pass: a teacher-forced CONTINUATION from the decode's prefill KV
+    # cache over the response window (cols [prompt_len-1, T); the prompt
+    # columns are never forwarded twice — interventions._nll_cached_jit),
+    # plus ONE unembed over the predictor columns.
+    toks_nll = batch * (new_tokens + 1)
+    nll_f = toks_nll * L * per_tok_layer + attn(toks_nll, t_total) * L
+    nll_f += batch * new_tokens * 2 * D * V
+    nll_f += toks_nll * 2 * D * sae_width
 
     # Readout: tap-layer stats from the decode-captured residual — one
     # [T, V] lens readout per row, NO model forward at all.
@@ -180,7 +184,7 @@ def _sweep_phase_times(params, cfg, sae, tap_layer: int, prompt_len: int,
         dec = decode.greedy_decode(
             params, cfg, *args, max_new_tokens=new_tokens,
             edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
-            capture_residual_layer=tap_layer)
+            capture_residual_layer=tap_layer, return_prefill_cache=True)
         jax.block_until_ready((dec.tokens, dec.residual))
         return dec
 
@@ -191,11 +195,15 @@ def _sweep_phase_times(params, cfg, sae, tap_layer: int, prompt_len: int,
         jax.block_until_ready(out["agg_ids"])
 
     def run_nll(dec, ep, pos2, next_mask):
-        nll = iv._nll_jit(params, cfg, dec.sequences, dec.sequence_valid,
-                          pos2, next_mask,
-                          edit_fn=iv.sae_ablation_edit,
-                          edit_params={**ep, "chunk_positions": pos2},
-                          resp_start=resp_start, use_pallas=use_pallas_nll)
+        # The production path: continue from the decode's prefill KV cache
+        # (pipelines.interventions._nll_cached_jit) instead of re-running the
+        # prompt columns.
+        nll = iv._nll_cached_jit(
+            params, cfg, *dec.prefill_cache,
+            dec.sequences, dec.sequence_valid, pos2, next_mask,
+            edit_fn=iv.sae_ablation_edit,
+            edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
+            resp_start=resp_start, use_pallas=use_pallas_nll)
         jax.block_until_ready(nll)
 
     def layout(dec):
@@ -261,7 +269,6 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
     dp, tp = 2, 4
     L, D = cfg9.num_layers, cfg9.hidden_size
     rows_dp = rows // dp
-    T = prompt_len + new_tokens
     ring = 2 * (tp - 1) / tp
 
     def ar(payload_bytes: float) -> float:
@@ -271,8 +278,8 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
     # one forward of [rows_dp, prompt_len, D].
     comm_decode = 2 * L * (new_tokens * ar(rows_dp * D * 2)
                            + ar(rows_dp * prompt_len * D * 2))
-    # NLL: one teacher-forced forward over the full sequence.
-    comm_nll = 2 * L * ar(rows_dp * T * D * 2)
+    # NLL: one teacher-forced continuation over the response window.
+    comm_nll = 2 * L * ar(rows_dp * (new_tokens + 1) * D * 2)
 
     ideal = sum(phase_9b.values()) / 8.0
     if decode_fit_9b is not None:

@@ -562,3 +562,18 @@ def spike_positions(
     pos = jnp.where(invalid, pos[0], pos)
     probs = jnp.where(invalid, 0.0, probs)
     return pos, probs
+
+
+@partial(jax.jit, static_argnames="top_k")
+def spike_positions_batch(
+    target_prob: jax.Array,    # [B, T]
+    response_mask: jax.Array,  # [B, T] bool
+    *,
+    top_k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched :func:`spike_positions` as ONE compiled program.  (An eager
+    ``jax.vmap`` call runs op-by-op — each op a separate dispatch on a
+    remote runtime, which is why the study's baseline pass jits it.)"""
+    return jax.vmap(
+        lambda t, m: spike_positions(t, m, top_k=top_k)
+    )(target_prob, response_mask)

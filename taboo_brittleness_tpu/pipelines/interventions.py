@@ -46,7 +46,8 @@ import jax.numpy as jnp
 
 from taboo_brittleness_tpu import metrics as metrics_mod
 from taboo_brittleness_tpu.config import Config
-from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params, forward
+from taboo_brittleness_tpu.models.gemma2 import (
+    Gemma2Config, KVCache, Params, forward)
 from taboo_brittleness_tpu.ops import lens, projection, sae as sae_ops
 from taboo_brittleness_tpu.parallel.mesh import dp_pad, pad_rows
 from taboo_brittleness_tpu.runtime import chat, decode
@@ -130,6 +131,9 @@ class WordState:
     resp_start: int = 0            # first column of the vocab-readout window
     #                                (= prompt columns - 1; left padding aligns
     #                                every row's response to the same columns)
+    residual_dev: Any = None       # device-side residual (incl. dp-pad rows):
+    #                                latent scoring reuses it without paying
+    #                                the [B, T, D] host->device re-upload
 
 
 # Byte budget for the [rows_chunk, T_resp, V]-shaped readout/NLL transients:
@@ -180,6 +184,15 @@ def _teacher_forced_nll(
     B, T = seqs.shape
     s = resp_start
     h_s = res.last_hidden[:, s:T - 1]                       # [B, Ts, D]
+    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s, use_pallas)
+
+
+def _nll_from_hidden(params: Params, cfg: Gemma2Config, h_s: jax.Array,
+                     seqs: jax.Array, next_mask: jax.Array, s: int,
+                     use_pallas: bool) -> jax.Array:
+    """The NLL readout shared by the full-forward and cache-continuation
+    variants: ``h_s`` holds the predictor columns ``[s, T-1)``."""
+    B, T = seqs.shape
     nxt_s = seqs[:, s + 1:T]                                # [B, Ts]
     m_s = next_mask[:, s:T - 1]
     Ts = T - 1 - s
@@ -214,6 +227,59 @@ def _teacher_forced_nll(
 _nll_jit = jax.jit(_teacher_forced_nll,
                    static_argnames=("cfg", "edit_fn", "resp_start",
                                     "use_pallas"))
+
+
+def _teacher_forced_nll_cached(
+    params: Params, cfg: Gemma2Config,
+    cache_k: jax.Array,               # [L, B, s, K, Dh] prefill KV, cols [0, s)
+    cache_v: jax.Array,
+    cache_valid: jax.Array,           # [B, s]
+    seqs: jax.Array, valid: jax.Array, positions: jax.Array,
+    next_mask: jax.Array,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+    *,
+    resp_start: int = 0,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """:func:`_teacher_forced_nll` CONTINUING from the arm decode's prefill KV
+    cache (``greedy_decode(return_prefill_cache=True)``) instead of re-running
+    the prompt columns.
+
+    The decode's prefill already ran the same edited model over the same
+    prompt rows, so this forward computes only columns ``[resp_start, T)`` —
+    the last prompt column (whose hidden state predicts the first response
+    token) plus the generated window — attending over cache + chunk.  Same
+    math as the full pass restricted to the emitted window (prompt-column
+    K/V are the same bf16 computation either way; parity asserted in
+    tests/test_interventions.py), and ~40% of the phase's forward FLOPs drop
+    at sweep shapes (T=82, 50 new tokens).  ``edit_params`` must carry
+    ``chunk_positions`` for the continuation columns only.
+    """
+    B, T = seqs.shape
+    s = resp_start
+    if cache_k.shape[2] != s:
+        raise ValueError(
+            f"prefill cache covers {cache_k.shape[2]} columns but resp_start "
+            f"is {s}; the decode and the baseline layout disagree on the "
+            "prompt column count")
+    bound = (lambda h, i: edit_fn(h, i, edit_params)) if (edit_fn and edit_params is not None) else edit_fn
+    pad = T - s
+    kv = KVCache(
+        k=jnp.pad(cache_k, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        v=jnp.pad(cache_v, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        valid=jnp.pad(cache_valid, ((0, 0), (0, pad))),
+        length=jnp.asarray(s, jnp.int32))
+    res = forward(params, cfg, seqs[:, s:], positions=positions[:, s:],
+                  attn_validity=valid[:, s:], cache=kv, edit_fn=bound,
+                  compute_logits=False)
+    h_s = res.last_hidden[:, :T - 1 - s]                    # cols [s, T-1)
+    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s, use_pallas)
+
+
+_nll_cached_jit = jax.jit(_teacher_forced_nll_cached,
+                          static_argnames=("cfg", "edit_fn", "resp_start",
+                                           "use_pallas"))
 
 
 def _nll_use_pallas(params: Params, mesh) -> bool:
@@ -370,7 +436,7 @@ def prepare_word_state(
         pad_to_multiple=config.experiment.pad_to_multiple,
         capture_residual_layer=layer_idx,
         input_sharding=_dp_sharding(mesh, 2, B + pad),
-        return_texts=False)
+        return_texts=False, return_prefill_cache=True)
     layout_d = decode.response_layout_device(dec)
     rows = layout_d.sequences.shape[0]
     resp_start = max(layout_d.prompt_len - 1, 0)
@@ -382,39 +448,40 @@ def prepare_word_state(
         _place_rows(np.full((rows,), tid, np.int32), mesh), top_k=top_k,
         resp_start=resp_start)
 
-    # The readout is queued; now pull the host-side view (blocks on the
-    # decode only) and decode texts while the device runs the readout.
-    layout = decode.response_layout(dec)
-    seqs, valid, positions, resp = (layout.sequences, layout.valid,
-                                    layout.positions, layout.response_mask)
-    texts = decode.decode_texts(tok, dec)
+    # ΔNLL and spike finding enqueue device-side straight behind the readout
+    # (next_mask[t] = True iff position t predicts a response token at t+1);
+    # no host sync happens until every program is in the queue.  The NLL
+    # continues from the decode's own prefill KV cache — the prompt columns
+    # are never forwarded twice.
+    resp_d = layout_d.response_mask
+    next_mask_d = jnp.zeros_like(resp_d).at[:, :-1].set(resp_d[:, 1:])
+    nll_d = _nll_cached_jit(
+        params, cfg, *dec.prefill_cache,
+        _place_rows(layout_d.sequences, mesh),
+        _place_rows(layout_d.valid.astype(bool), mesh),
+        _place_rows(layout_d.positions, mesh), _place_rows(next_mask_d, mesh),
+        resp_start=resp_start, use_pallas=_nll_use_pallas(params, mesh))
+    spike_d, _ = lens.spike_positions_batch(
+        out["tap_prob"], resp_d, top_k=config.intervention.spike_top_k)
 
-    target_prob = np.asarray(out["tap_prob"])[:B]              # [B, T]
-    secret_prob = float(np.asarray(out["row_prob_sum"])[:B].sum()
-                        / max(float(np.asarray(out["row_resp"])[:B].sum()), 1.0))
-
-    spikes = jax.vmap(
-        lambda t, m: lens.spike_positions(t, m, top_k=config.intervention.spike_top_k)
-    )(jnp.asarray(target_prob), jnp.asarray(resp[:B]))
-    spike_pos = np.asarray(spikes[0])
-
-    # next_mask[t] = True iff position t predicts a response token at t+1.
-    next_mask = np.zeros_like(resp)
-    next_mask[:, :-1] = resp[:, 1:]
-    nll = np.asarray(_nll_jit(
-        params, cfg, _place_rows(seqs, mesh),
-        _place_rows(valid.astype(bool), mesh),
-        _place_rows(positions, mesh), _place_rows(next_mask, mesh),
-        resp_start=resp_start, use_pallas=_nll_use_pallas(params, mesh)))[:B]
-
-    guesses = _decode_guess_rows(tok, np.asarray(out["agg_ids"])[:B])
+    # ONE batched pull for every host-side value (remote round-trips measured
+    # ~0.1 s EACH; this pass used to pay ~8 of them), then host assembly.
+    (tokens, lengths, seqs, valid, positions, resp, row_sum,
+     row_cnt, agg_ids, nll, residual, spike_pos) = jax.device_get(
+        (dec.tokens, dec.lengths, layout_d.sequences, layout_d.valid,
+         layout_d.positions, resp_d, out["row_prob_sum"],
+         out["row_resp"], out["agg_ids"], nll_d, dec.residual, spike_d))
+    texts = decode.texts_from_tokens(tok, tokens[:B], lengths[:B])
+    secret_prob = float(row_sum[:B].sum() / max(float(row_cnt[:B].sum()), 1.0))
+    guesses = _decode_guess_rows(tok, agg_ids[:B])
 
     return WordState(
         word=word, target_id=int(tid),
         sequences=seqs[:B], valid=valid[:B], positions=positions[:B],
-        response_mask=resp[:B], residual=np.asarray(dec.residual)[:B],
-        secret_prob=secret_prob, baseline_nll=nll, spike_pos=spike_pos,
-        response_texts=texts[:B], guesses=guesses, resp_start=resp_start,
+        response_mask=resp[:B], residual=residual[:B],
+        secret_prob=secret_prob, baseline_nll=nll[:B], spike_pos=spike_pos[:B],
+        response_texts=texts, guesses=guesses, resp_start=resp_start,
+        residual_dev=dec.residual[:B],
     )
 
 
@@ -469,34 +536,49 @@ def score_latents_for_word(
     structure up to the per-position RMS scale.
     """
     scoring = config.intervention.scoring if config is not None else "cosine"
-    B, K = state.spike_pos.shape
-    spikes = state.residual[np.arange(B)[:, None], state.spike_pos]  # [B, K, D]
-    acts = sae_ops.encode(sae, jnp.asarray(spikes.reshape(B * K, -1)))
-
-    if scoring == "cosine":
-        rel = sae_ops.latent_secret_alignment(
-            sae, params["embed"], jnp.asarray(state.target_id))
-    elif scoring == "correlation":
-        D = state.residual.shape[-1]
-        h = jnp.asarray(state.residual.reshape(-1, D))            # [N, D]
-        if cfg is not None:
-            from taboo_brittleness_tpu.models.gemma2 import rms_norm
-
-            x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-        else:
-            x = h
-        u = params["embed"][state.target_id].astype(jnp.float32)  # [D]
-        secret_logit = x.astype(jnp.float32) @ u                  # [N]
-        # Streamed: the [N, S] calibration-activation matrix (multi-GB at
-        # 9B x wide-SAE scale) never materializes, only O(S) moments.
-        rel = sae_ops.latent_secret_correlation_stream(
-            sae, h, secret_logit,
-            jnp.asarray(state.response_mask.reshape(-1)))
-    else:
+    if scoring not in ("correlation", "cosine"):
         raise ValueError(
             f"unknown intervention.scoring {scoring!r}; "
             "expected 'correlation' or 'cosine'")
-    return np.asarray(sae_ops.score_latents(acts, rel))
+    residual = (state.residual_dev if state.residual_dev is not None
+                else jnp.asarray(state.residual))
+    eps = float(cfg.rms_norm_eps) if cfg is not None else None
+    return np.asarray(_score_latents_jit(
+        sae, residual, jnp.asarray(state.spike_pos),
+        params["embed"], params.get("final_norm"),
+        jnp.asarray(state.target_id),
+        jnp.asarray(state.response_mask.reshape(-1)),
+        scoring=scoring, eps=eps))
+
+
+@partial(jax.jit, static_argnames=("scoring", "eps"))
+def _score_latents_jit(sae, residual, spike_pos, embed, final_norm,
+                       target_id, resp_mask_flat, *, scoring, eps):
+    """The whole scoring computation as ONE compiled program (the eager op
+    chain — spike gather, SAE encode, norm, matmul, streamed correlation —
+    cost ~1 s/word of per-op dispatches on the remote runtime)."""
+    B = spike_pos.shape[0]
+    D = residual.shape[-1]
+    spikes = residual[jnp.arange(B)[:, None], spike_pos]      # [B, K, D]
+    acts = sae_ops.encode(sae, spikes.reshape(-1, D))
+
+    if scoring == "cosine":
+        rel = sae_ops.latent_secret_alignment(sae, embed, target_id)
+    else:
+        h = residual.reshape(-1, D)                           # [N, D]
+        if eps is not None:
+            from taboo_brittleness_tpu.models.gemma2 import rms_norm
+
+            x = rms_norm(h, final_norm, eps)
+        else:
+            x = h
+        u = embed[target_id].astype(jnp.float32)              # [D]
+        secret_logit = x.astype(jnp.float32) @ u              # [N]
+        # Streamed: the [N, S] calibration-activation matrix (multi-GB at
+        # 9B x wide-SAE scale) never materializes, only O(S) moments.
+        rel = sae_ops.latent_secret_correlation_stream(
+            sae, h, secret_logit, resp_mask_flat)
+    return sae_ops.score_latents(acts, rel)
 
 
 # ---------------------------------------------------------------------------
@@ -627,7 +709,7 @@ def _dispatch_rows(
         edit_params=rows_ep_p,
         capture_residual_layer=layer_idx,
         input_sharding=_dp_sharding(mesh, 2, A * B + pad),
-        return_texts=False)
+        return_texts=False, return_prefill_cache=True)
     layout = decode.response_layout_device(dec)
     rows = layout.sequences.shape[0]
     resp_start = max(layout.prompt_len - 1, 0)
@@ -645,21 +727,28 @@ def _dispatch_rows(
     # readout has consumed it.
     dec = dec._replace(residual=None)
 
-    # (c) ΔNLL: the *baseline* continuation re-scored under each edited model.
+    # (c) ΔNLL: the *baseline* continuation re-scored under each edited model,
+    # CONTINUING from this decode's prefill KV cache (same prompt rows, same
+    # edit — the prompt columns are never forwarded twice; ~40% of the
+    # phase's forward FLOPs at sweep shapes).
     next_mask = np.zeros_like(state.response_mask)
     next_mask[:, :-1] = state.response_mask[:, 1:]
     base_pos = pad_rows(np.tile(state.positions, (A, 1)), pad)
-    edited_nll_dev = _nll_jit(
-        params, cfg,
+    s = state.resp_start
+    edited_nll_dev = _nll_cached_jit(
+        params, cfg, *dec.prefill_cache,
         _place_rows(pad_rows(np.tile(state.sequences, (A, 1)), pad), mesh),
         _place_rows(pad_rows(np.tile(state.valid, (A, 1)), pad).astype(bool),
                     mesh),
         _place_rows(base_pos, mesh),
         _place_rows(pad_rows(np.tile(next_mask, (A, 1)), pad), mesh),
         edit_fn=edit_fn,
-        edit_params=_with_chunk_positions(rows_ep_p, base_pos),
-        resp_start=state.resp_start,
+        edit_params=_with_chunk_positions(rows_ep_p, base_pos[:, s:]),
+        resp_start=s,
         use_pallas=_nll_use_pallas(params, mesh))
+    # NLL is dispatched; drop the cache reference (~1.1 GB at 330 bench-shape
+    # rows) so it frees as soon as the queued NLL has consumed it.
+    dec = dec._replace(prefill_cache=None)
 
     # All three programs are now in the device queue; hand the in-flight
     # values to the collect half.
@@ -680,12 +769,15 @@ def _collect_rows(
     next_mask = handle["next_mask"]
     valid_forms = {f.lower()
                    for f in config.word_plurals.get(state.word, [state.word])}
-    texts = decode.decode_texts(tok, handle["dec"])
-    edited_nll = np.asarray(handle["edited_nll"])
     out = handle["out"]
-    row_prob_sum = np.asarray(out["row_prob_sum"])
-    row_resp = np.asarray(out["row_resp"])
-    agg_ids = np.asarray(out["agg_ids"])
+    # ONE batched pull for all six host-side outputs: separate np.asarray
+    # pulls are a ~0.1 s round-trip EACH on the remote runtime (~0.5 s/chunk
+    # of pure latency at the study's four chunks/word).
+    (tokens, lengths, edited_nll, row_prob_sum, row_resp,
+     agg_ids) = jax.device_get(
+        (handle["dec"].tokens, handle["dec"].lengths, handle["edited_nll"],
+         out["row_prob_sum"], out["row_resp"], out["agg_ids"]))
+    texts = decode.texts_from_tokens(tok, tokens, lengths)
     n_resp = max(int(next_mask.sum()), 1)
 
     results: List[ArmResult] = []
@@ -775,39 +867,78 @@ def measure_arms(
     ≈ 4.8 GB of tp=4-sharded KV per chip — and 44 arms measurably falls off
     an HBM cliff at the bench shape, see ``_DEFAULT_ARM_CHUNK``).
     """
-    A = int(next(iter(per_arm.values())).shape[0])
-    B = state.sequences.shape[0]
-    max_chunk = (arm_chunk or getattr(config.intervention, "arm_chunk", None)
-                 or min(A, _DEFAULT_ARM_CHUNK))
-    chunk = _balanced_chunk(A, max_chunk)
+    return measure_arm_sets(params, cfg, tok, config, state,
+                            [(edit_fn, shared_ep, per_arm, arm_chunk)],
+                            mesh=mesh)[0]
 
-    # Software-pipelined chunk loop: chunk i+1's decode/readout/NLL enqueue
-    # BEFORE chunk i's results are pulled, so the device never idles through
-    # the host-side assembly (text decode, metrics, guess decoding) between
-    # chunks.  Depth is fixed at 2, bounding the overlap cost to one extra
-    # chunk's residual + I/O buffers (see _dispatch_rows).
-    results: List[ArmResult] = []
-    pending: Optional[Tuple[Dict[str, Any], int]] = None
-    for s in range(0, A, chunk):
-        pa = {k: jnp.asarray(v)[s:s + chunk] for k, v in per_arm.items()}
-        a = int(next(iter(pa.values())).shape[0])
-        # Pad a ragged final chunk back to `chunk` (repeating the last arm)
-        # so the row count — and therefore the compiled programs — never
-        # changes across chunks; the duplicate arms' results are discarded.
-        pad = chunk - a if A > chunk else 0
-        if pad:
-            pa = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
-                  for k, v in pa.items()}
-        rows_ep = _tile_rows_ep(shared_ep, pa, a + pad, B)
+
+def measure_arm_sets(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    sets: Sequence[Tuple[Callable, Dict[str, Any], Dict[str, Any],
+                         Optional[int]]],
+    *,
+    mesh: Any = None,
+) -> List[List[ArmResult]]:
+    """Measure several arm stacks — e.g. the ablation AND projection sweeps —
+    in ONE software-pipelined dispatch stream.
+
+    ``sets`` holds ``(edit_fn, shared_ep, per_arm, arm_chunk)`` per stack;
+    returns one ``List[ArmResult]`` per stack.  Each stack chunks exactly as
+    :func:`measure_arms` documents (balanced chunks, ragged-tail padding);
+    the win of taking several stacks at once is that the chunk stream crosses
+    stack boundaries without draining the device queue — chunk i+1's three
+    programs (possibly the next sweep's) enqueue BEFORE chunk i's results are
+    pulled, so the device never idles through the host-side assembly.  Depth
+    is fixed at 2, bounding the overlap cost to one extra chunk's residual +
+    I/O buffers (see _dispatch_rows).
+    """
+    B = state.sequences.shape[0]
+    # (set index, edit_fn, shared_ep, arm slice, launched arms, real arms)
+    # per chunk, all stacks.  The row-tiled edit params are NOT built here:
+    # tiling happens inside the dispatch loop, so at most the depth-2
+    # pipeline's two chunks' tiled arrays are ever resident (a plans list of
+    # pre-tiled [chunk*B, ...] bases for every chunk would sit next to the
+    # in-flight decode and defeat the HBM bound _DEFAULT_ARM_CHUNK exists
+    # for).
+    plans: List[Tuple[int, Callable, Dict[str, Any], Dict[str, Any],
+                      int, int]] = []
+    for si, (edit_fn, shared_ep, per_arm, arm_chunk) in enumerate(sets):
+        A = int(next(iter(per_arm.values())).shape[0])
+        max_chunk = (arm_chunk
+                     or getattr(config.intervention, "arm_chunk", None)
+                     or min(A, _DEFAULT_ARM_CHUNK))
+        chunk = _balanced_chunk(A, max_chunk)
+        for s in range(0, A, chunk):
+            pa = {k: jnp.asarray(v)[s:s + chunk] for k, v in per_arm.items()}
+            a = int(next(iter(pa.values())).shape[0])
+            # Pad a ragged final chunk back to `chunk` (repeating the last
+            # arm) so the row count — and therefore the compiled programs —
+            # never changes across chunks; duplicate arms' results are
+            # discarded.
+            pad = chunk - a if A > chunk else 0
+            if pad:
+                pa = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, axis=0)])
+                      for k, v in pa.items()}
+            plans.append((si, edit_fn, shared_ep, pa, a + pad, a))
+
+    results: List[List[ArmResult]] = [[] for _ in sets]
+    pending: Optional[Tuple[int, Dict[str, Any], int]] = None
+    for si, edit_fn, shared_ep, pa, n_launch, n_real in plans:
+        rows_ep = _tile_rows_ep(shared_ep, pa, n_launch, B)
         handle = _dispatch_rows(params, cfg, tok, config, state, edit_fn,
-                                rows_ep, a + pad, mesh)
+                                rows_ep, n_launch, mesh)
+        del rows_ep
         if pending is not None:
-            results.extend(
-                _collect_rows(tok, config, state, pending[0])[:pending[1]])
-        pending = (handle, a)
+            psi, ph, pn = pending
+            results[psi].extend(_collect_rows(tok, config, state, ph)[:pn])
+        pending = (si, handle, n_real)
     if pending is not None:
-        results.extend(
-            _collect_rows(tok, config, state, pending[0])[:pending[1]])
+        psi, ph, pn = pending
+        results[psi].extend(_collect_rows(tok, config, state, ph)[:pn])
     return results
 
 
@@ -850,6 +981,29 @@ def run_ablation_sweep(
     position (spike masks are keyed to the hint prompts' layouts and don't
     transfer to forcing dialogues).
     """
+    set_spec, assemble = plan_ablation_sweep(
+        params, cfg, tok, config, state, sae, seed=seed, forcing=forcing)
+    edit_fn, shared, per_arm, chunk = set_spec
+    return assemble(measure_arms(params, cfg, tok, config, state, edit_fn,
+                                 shared, per_arm, arm_chunk=chunk, mesh=mesh))
+
+
+def plan_ablation_sweep(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    sae: sae_ops.SAEParams,
+    *,
+    seed: Optional[int] = None,
+    forcing: bool = False,
+) -> Tuple[Tuple[Callable, Dict[str, Any], Dict[str, Any], Optional[int]],
+           Callable[[List[ArmResult]], Dict[str, Any]]]:
+    """Build the ablation sweep's arm stack and its ``assemble(arms)``
+    closure — split from :func:`run_ablation_sweep` so
+    :func:`run_intervention_study` can feed BOTH sweeps' stacks to one
+    :func:`measure_arm_sets` stream (no device-queue drain between sweeps)."""
     scores = score_latents_for_word(state, sae, params, config=config, cfg=cfg)
     order = np.argsort(-scores)
     S = scores.shape[0]
@@ -882,43 +1036,46 @@ def run_ablation_sweep(
         for _ in range(R):
             arm_ids.append(pad_ids(rng.choice(S, size=m, replace=False)))
     per_arm = {"latent_ids": jnp.asarray(np.stack(arm_ids), jnp.int32)}
-    arms = measure_arms(params, cfg, tok, config, state,
-                        sae_ablation_edit, shared, per_arm, mesh=mesh)
 
-    out: Dict[str, Any] = {"word": state.word,
-                           "scoring": config.intervention.scoring,
-                           "budgets": {}}
-    for i, m in enumerate(budgets):
-        block = arms[i * (R + 1):(i + 1) * (R + 1)]
-        targeted, randoms = block[0], block[1:]
-        out["budgets"][str(m)] = {
-            "targeted": dataclasses.asdict(targeted),
-            "random_mean": _mean_arms(randoms),
-            "random": [dataclasses.asdict(r) for r in randoms],
-        }
+    def assemble(arms: List[ArmResult]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"word": state.word,
+                               "scoring": config.intervention.scoring,
+                               "budgets": {}}
+        for i, m in enumerate(budgets):
+            block = arms[i * (R + 1):(i + 1) * (R + 1)]
+            targeted, randoms = block[0], block[1:]
+            out["budgets"][str(m)] = {
+                "targeted": dataclasses.asdict(targeted),
+                "random_mean": _mean_arms(randoms),
+                "random": [dataclasses.asdict(r) for r in randoms],
+            }
 
-    if forcing:
-        from taboo_brittleness_tpu.pipelines import token_forcing
+        if forcing:
+            from taboo_brittleness_tpu.pipelines import token_forcing
 
-        # One batched attack set for ALL budgets + the unedited baseline:
-        # arm 0 is the identity (all -1 ids), arm i+1 budget i's targeted row.
-        arm_stack = np.stack([np.full((mmax,), -1, np.int64)] + targeted_rows)
-        per_arm_forcing = {"latent_ids": jnp.asarray(arm_stack, jnp.int32)}
-        res = token_forcing.forcing_under_arms(
-            params, cfg, tok, config, state.word, sae_ablation_edit,
-            {"sae": sae, "layer": config.model.layer_idx}, per_arm_forcing,
-            arm_chunk=config.intervention.arm_chunk)
-        # Forcing dialogues have their own layouts, so spike masks (keyed to
-        # the hint prompts) do not transfer: the forcing edit always applies
-        # at every position.  Stamp the scope so a spike-masked sweep's
-        # brittleness score and its forcing score can't be conflated as the
-        # same edit footprint (ADVICE round-3).
-        scope = {"edit": "all-positions"}
-        out["baseline_forcing"] = {**res[0], "edit": "none"}
-        for i, m in enumerate(config.intervention.budgets):
-            out["budgets"][str(m)]["targeted"]["forcing"] = {**res[i + 1],
-                                                             **scope}
-    return out
+            # One batched attack set for ALL budgets + the unedited baseline:
+            # arm 0 is the identity (all -1 ids), arm i+1 budget i's targeted
+            # row.
+            arm_stack = np.stack(
+                [np.full((mmax,), -1, np.int64)] + targeted_rows)
+            per_arm_forcing = {"latent_ids": jnp.asarray(arm_stack, jnp.int32)}
+            res = token_forcing.forcing_under_arms(
+                params, cfg, tok, config, state.word, sae_ablation_edit,
+                {"sae": sae, "layer": config.model.layer_idx}, per_arm_forcing,
+                arm_chunk=config.intervention.arm_chunk)
+            # Forcing dialogues have their own layouts, so spike masks (keyed
+            # to the hint prompts) do not transfer: the forcing edit always
+            # applies at every position.  Stamp the scope so a spike-masked
+            # sweep's brittleness score and its forcing score can't be
+            # conflated as the same edit footprint (ADVICE round-3).
+            scope = {"edit": "all-positions"}
+            out["baseline_forcing"] = {**res[0], "edit": "none"}
+            for i, m in enumerate(config.intervention.budgets):
+                out["budgets"][str(m)]["targeted"]["forcing"] = {**res[i + 1],
+                                                                 **scope}
+        return out
+
+    return (sae_ablation_edit, shared, per_arm, None), assemble
 
 
 def run_projection_sweep(
@@ -935,6 +1092,26 @@ def run_projection_sweep(
     """Low-rank removal: PCA of spike residuals vs random orthonormal bases.
 
     ``forcing`` as in :func:`run_ablation_sweep` (targeted arms only)."""
+    set_spec, assemble = plan_projection_sweep(
+        params, cfg, tok, config, state, seed=seed, forcing=forcing)
+    edit_fn, shared, per_arm, chunk = set_spec
+    return assemble(measure_arms(params, cfg, tok, config, state, edit_fn,
+                                 shared, per_arm, arm_chunk=chunk, mesh=mesh))
+
+
+def plan_projection_sweep(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    *,
+    seed: Optional[int] = None,
+    forcing: bool = False,
+) -> Tuple[Tuple[Callable, Dict[str, Any], Dict[str, Any], Optional[int]],
+           Callable[[List[ArmResult]], Dict[str, Any]]]:
+    """Arm stack + ``assemble`` closure for the projection sweep (see
+    :func:`plan_ablation_sweep`)."""
     B, K = state.spike_pos.shape
     spikes = state.residual[np.arange(B)[:, None], state.spike_pos].reshape(B * K, -1)
     rng_seed = config.experiment.seed if seed is None else seed
@@ -965,36 +1142,37 @@ def run_projection_sweep(
             key = jax.random.PRNGKey(rng_seed * 1000 + r_i * 100 + t)
             bases.append(pad_cols(projection.random_subspace(key, D, r)))
     per_arm = {"basis": jnp.stack(bases)}                     # [A, D, rmax]
-    arms = measure_arms(params, cfg, tok, config, state,
-                        projection_edit, shared, per_arm, mesh=mesh)
 
-    out: Dict[str, Any] = {"word": state.word, "ranks": {}}
-    for i, r in enumerate(ranks):
-        block = arms[i * (R + 1):(i + 1) * (R + 1)]
-        targeted, randoms = block[0], block[1:]
-        out["ranks"][str(r)] = {
-            "targeted": dataclasses.asdict(targeted),
-            "random_mean": _mean_arms(randoms),
-            "random": [dataclasses.asdict(r_) for r_ in randoms],
-        }
+    def assemble(arms: List[ArmResult]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"word": state.word, "ranks": {}}
+        for i, r in enumerate(ranks):
+            block = arms[i * (R + 1):(i + 1) * (R + 1)]
+            targeted, randoms = block[0], block[1:]
+            out["ranks"][str(r)] = {
+                "targeted": dataclasses.asdict(targeted),
+                "random_mean": _mean_arms(randoms),
+                "random": [dataclasses.asdict(r_) for r_ in randoms],
+            }
 
-    if forcing:
-        from taboo_brittleness_tpu.pipelines import token_forcing
+        if forcing:
+            from taboo_brittleness_tpu.pipelines import token_forcing
 
-        # All ranks' targeted bases in one batched attack set (a zero basis
-        # would be the identity arm, but the baseline already rode along in
-        # the ablation sweep's batch — no need to pay it twice).
-        res = token_forcing.forcing_under_arms(
-            params, cfg, tok, config, state.word, projection_edit,
-            {"layer": config.model.layer_idx},
-            {"basis": jnp.stack(targeted_bases)},
-            arm_chunk=config.intervention.arm_chunk)
-        for i, r in enumerate(config.intervention.ranks):
-            # Spike masks don't transfer to forcing dialogues (see the
-            # ablation sweep): stamp the every-position scope.
-            out["ranks"][str(r)]["targeted"]["forcing"] = {
-                **res[i], "edit": "all-positions"}
-    return out
+            # All ranks' targeted bases in one batched attack set (a zero
+            # basis would be the identity arm, but the baseline already rode
+            # along in the ablation sweep's batch — no need to pay it twice).
+            res = token_forcing.forcing_under_arms(
+                params, cfg, tok, config, state.word, projection_edit,
+                {"layer": config.model.layer_idx},
+                {"basis": jnp.stack(targeted_bases)},
+                arm_chunk=config.intervention.arm_chunk)
+            for i, r in enumerate(config.intervention.ranks):
+                # Spike masks don't transfer to forcing dialogues (see the
+                # ablation sweep): stamp the every-position scope.
+                out["ranks"][str(r)]["targeted"]["forcing"] = {
+                    **res[i], "edit": "all-positions"}
+        return out
+
+    return (projection_edit, shared, per_arm, None), assemble
 
 
 def _mean_arms(arms: Sequence[ArmResult]) -> Dict[str, float]:
@@ -1019,6 +1197,12 @@ def run_intervention_study(
 ) -> Dict[str, Any]:
     """Full brittleness study for one word: baseline + both sweeps.
 
+    Both sweeps' arm stacks are planned up front (latent scoring + PCA happen
+    before any arm launches) and measured as ONE pipelined chunk stream
+    (:func:`measure_arm_sets`): the device crosses the ablation→projection
+    boundary without draining its queue for the host-side scoring/assembly
+    in between.
+
     ``forcing=True`` adds pre/postgame token-forcing success under each
     targeted arm (and for the unedited baseline, for reference)."""
     state = prepare_word_state(params, cfg, tok, config, word, mesh=mesh)
@@ -1027,8 +1211,13 @@ def run_intervention_study(
         "guesses": state.guesses,
         "response_texts": state.response_texts,
     }
-    ablation = run_ablation_sweep(params, cfg, tok, config, state, sae,
-                                  mesh=mesh, forcing=forcing)
+    abl_set, abl_assemble = plan_ablation_sweep(
+        params, cfg, tok, config, state, sae, forcing=forcing)
+    proj_set, proj_assemble = plan_projection_sweep(
+        params, cfg, tok, config, state, forcing=forcing)
+    abl_arms, proj_arms = measure_arm_sets(
+        params, cfg, tok, config, state, [abl_set, proj_set], mesh=mesh)
+    ablation = abl_assemble(abl_arms)
     if forcing:
         # The unedited baseline rode in the ablation batch as the identity
         # (all -1 ids) arm — surface it at the top level.
@@ -1037,8 +1226,7 @@ def run_intervention_study(
         "word": word,
         "baseline": baseline,
         "ablation": ablation,
-        "projection": run_projection_sweep(params, cfg, tok, config, state,
-                                           mesh=mesh, forcing=forcing),
+        "projection": proj_assemble(proj_arms),
     }
     if output_path:
         _atomic_json_dump(results, output_path)

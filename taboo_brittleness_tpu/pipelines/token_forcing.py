@@ -323,10 +323,13 @@ def run_token_forcing(
         return load_done(w) is not None
 
     results: Dict[str, Any] = {}
-    # Completion memo for the CURRENT params object (see docstring): compare
-    # by identity, replace on miss so a real per-word loader never holds more
-    # than the in-flight checkpoint alive through this reference.
-    memo_params: Any = None
+    # Completion memo for the CURRENT (params, tokenizer) pair (see
+    # docstring): compare by identity, replace on miss so a real per-word
+    # loader never holds more than the in-flight checkpoint alive through
+    # this reference.  The tokenizer is part of the key because the memoized
+    # completions are decoded TEXT — a loader pairing one params object with
+    # per-word tokenizers must not reuse them.
+    memo_key: Any = None
     memo: Dict[str, Any] = {}
     kw = dict(edit_fn=edit_fn, edit_params=edit_params)
     for i, word in enumerate(words):
@@ -335,8 +338,8 @@ def run_token_forcing(
             results[word] = saved
             continue
         params, cfg, tok = model_loader(word)
-        if params is not memo_params:
-            memo_params, memo = params, {}
+        if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
+            memo_key, memo = (params, tok), {}
         # Overlap the next *running* word's checkpoint IO with this word's
         # compute (a to-be-skipped word would pin the pending slot forever).
         # next() stops at the first pending word — no full O(words²) rescan

@@ -628,3 +628,60 @@ def test_study_with_forcing_per_targeted_arm(setup, tmp_path):
     assert set(p["forcing"]) == {"pregame", "postgame", "edit"}
     assert p["forcing"]["edit"] == "all-positions"
 
+
+
+def test_nll_cached_continuation_matches_full(setup):
+    """The prefill-KV continuation NLL (_nll_cached_jit, the production sweep
+    path) must reproduce the full-forward NLL — with and without an edit,
+    since the cache comes from the arm decode's EDITED prefill."""
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import sae as sae_ops  # noqa: F401
+    from taboo_brittleness_tpu.runtime import decode
+
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    B, T = state.sequences.shape
+    s = state.resp_start
+    next_mask = np.zeros_like(state.response_mask)
+    next_mask[:, :-1] = state.response_mask[:, 1:]
+    full_args = (params, cfg, jnp.asarray(state.sequences),
+                 jnp.asarray(state.valid.astype(bool)),
+                 jnp.asarray(state.positions), jnp.asarray(next_mask))
+
+    for ep in (None,
+               {"sae": sae,
+                "latent_ids": jnp.asarray(
+                    np.tile([[1, 3]], (B, 1)), jnp.int32),
+                "layer": config.model.layer_idx}):
+        edit = iv.sae_ablation_edit if ep is not None else None
+        # Prefill cache from a decode over the word's prompt rows under the
+        # same edit (the production flow: _dispatch_rows / prepare).
+        dec = decode.greedy_decode(
+            params, cfg, jnp.asarray(state.sequences[:, :s + 1]),
+            jnp.asarray(state.valid[:, :s + 1].astype(bool)),
+            jnp.asarray(state.positions[:, :s + 1]),
+            max_new_tokens=T - (s + 1),
+            edit_fn=edit,
+            edit_params=ep,
+            stop_ids=(-1,), return_prefill_cache=True)
+
+        full = np.asarray(iv._nll_jit(
+            *full_args, edit_fn=edit,
+            edit_params=(iv._with_chunk_positions(ep, jnp.asarray(state.positions))
+                         if ep is not None else None),
+            resp_start=s, use_pallas=False))
+        cached = np.asarray(iv._nll_cached_jit(
+            params, cfg, *dec.prefill_cache, *full_args[2:],
+            edit_fn=edit,
+            edit_params=(iv._with_chunk_positions(
+                ep, jnp.asarray(state.positions[:, s:]))
+                         if ep is not None else None),
+            resp_start=s, use_pallas=False))
+        np.testing.assert_allclose(cached, full, rtol=1e-4, atol=1e-5)
+
+    # Shape-mismatch guard: a cache that disagrees with resp_start is loud.
+    with pytest.raises(ValueError, match="prefill cache covers"):
+        iv._teacher_forced_nll_cached(
+            params, cfg, *dec.prefill_cache, *full_args[2:],
+            resp_start=s + 1)

@@ -84,7 +84,7 @@ def main() -> int:
         d = decode.greedy_decode(
             params, cfg, *ins, max_new_tokens=args.new_tokens,
             edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
-            capture_residual_layer=tap)
+            capture_residual_layer=tap, return_prefill_cache=True)
         jax.block_until_ready(d.tokens)
         return d
 
@@ -99,13 +99,15 @@ def main() -> int:
 
     def run_nll():
         pos2 = jnp.maximum(jnp.cumsum(dec.sequence_valid, 1) - 1, 0)
+        pos2 = pos2.astype(jnp.int32)
         nm = jnp.zeros_like(dec.sequence_valid).at[:, resp_start:-1].set(True)
-        nll = iv._nll_jit(params, cfg, dec.sequences, dec.sequence_valid,
-                          pos2.astype(jnp.int32), nm,
-                          edit_fn=iv.sae_ablation_edit,
-                          edit_params={**ep, "chunk_positions": pos2},
-                          resp_start=resp_start,
-                          use_pallas=iv._nll_use_pallas(params, None))
+        nll = iv._nll_cached_jit(
+            params, cfg, *dec.prefill_cache,
+            dec.sequences, dec.sequence_valid, pos2, nm,
+            edit_fn=iv.sae_ablation_edit,
+            edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
+            resp_start=resp_start,
+            use_pallas=iv._nll_use_pallas(params, None))
         jax.block_until_ready(nll)
 
     fn = {"decode": run_decode, "readout": run_readout, "nll": run_nll}[args.phase]
