@@ -144,8 +144,7 @@ _COLL_LATENCY_S = 1e-6
 
 def _sweep_phase_times(params, cfg, sae, tap_layer: int, prompt_len: int,
                        new_tokens: int, arms: int, prompts_per_word: int,
-                       reps: int, use_pallas_nll: bool,
-                       dedup_floor: float = 0.0) -> dict:
+                       reps: int, dedup_floor: float = 0.0) -> dict:
     """Measure the sweep's three compiled programs at ``arms`` arms/launch.
 
     Dedup-proof by construction (this host's TPU runtime can dedupe repeated
@@ -203,7 +202,7 @@ def _sweep_phase_times(params, cfg, sae, tap_layer: int, prompt_len: int,
             dec.sequences, dec.sequence_valid, pos2, next_mask,
             edit_fn=iv.sae_ablation_edit,
             edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
-            resp_start=resp_start, use_pallas=use_pallas_nll)
+            resp_start=resp_start)
         jax.block_until_ready(nll)
 
     def layout(dec):
@@ -263,8 +262,14 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
       + [rows, T] softmax-stat psums — negligible bytes).
     - nll: /8 plus the teacher-forced forward's tp collectives.
     - comm: Megatron-style tp inserts 2 all-reduces per layer (attn out +
-      MLP down); ring all-reduce moves 2*(tp-1)/tp of the bf16 activation
-      payload per chip over ICI (_ICI_LINK_BW), _COLL_LATENCY_S per launch.
+      MLP down); ring all-reduce moves 2*(tp-1)/tp of the activation payload
+      per chip over ICI (_ICI_LINK_BW), _COLL_LATENCY_S per launch.  The
+      payload is charged in F32, not bf16: the compiled dp=2 x tp=4 HLO
+      (tools/hlo_collectives.py -> results/hlo_collectives.json) shows XLA
+      hoists the norm's f32 cast through the linear all-reduce, so the
+      activation collectives move 4-byte elements — the f32 analytic terms
+      below match the HLO-derived bytes within ~2% (the bf16 assumption of
+      rounds <= 4 undercharged ICI 2x).
     """
     dp, tp = 2, 4
     L, D = cfg9.num_layers, cfg9.hidden_size
@@ -274,12 +279,12 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
     def ar(payload_bytes: float) -> float:
         return ring * payload_bytes / _ICI_LINK_BW + _COLL_LATENCY_S
 
-    # Decode: per step, 2 collectives/layer of [rows_dp, 1, D] bf16; prefill,
+    # Decode: per step, 2 collectives/layer of [rows_dp, 1, D] f32; prefill,
     # one forward of [rows_dp, prompt_len, D].
-    comm_decode = 2 * L * (new_tokens * ar(rows_dp * D * 2)
-                           + ar(rows_dp * prompt_len * D * 2))
+    comm_decode = 2 * L * (new_tokens * ar(rows_dp * D * 4)
+                           + ar(rows_dp * prompt_len * D * 4))
     # NLL: one teacher-forced continuation over the response window.
-    comm_nll = 2 * L * ar(rows_dp * (new_tokens + 1) * D * 2)
+    comm_nll = 2 * L * ar(rows_dp * (new_tokens + 1) * D * 4)
 
     ideal = sum(phase_9b.values()) / 8.0
     if decode_fit_9b is not None:
@@ -289,7 +294,7 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
         decode_der = phase_9b["decode"] / 8.0 + comm_decode
     derated = (decode_der + phase_9b["readout"] / 8.0
                + phase_9b["nll"] / 8.0 + comm_nll)
-    return {
+    out = {
         "ideal_launch_seconds": round(ideal, 4),
         "derated_launch_seconds": round(derated, 4),
         "comm_seconds": {"decode": round(comm_decode, 4),
@@ -299,6 +304,53 @@ def _v5e8_band(phase_9b: dict, decode_fit_9b, rows: int, prompt_len: int,
             "a + b*rows fit" if decode_fit_9b is not None else
             "single arms config measured - no latency fit; decode derated by "
             "comm only"),
+    }
+    hlo = _hlo_evidence()
+    if hlo is not None:
+        out["hlo_evidence"] = hlo
+        # The analytic/HLO ratio is only meaningful when the JSON was
+        # generated at THIS run's launch shapes (a stale or re-parameterized
+        # run would imply a bogus model error).
+        same_shapes = hlo.get("launch") == {
+            "rows": rows, "prompt_len": prompt_len, "new_tokens": new_tokens}
+        if same_shapes:
+            for prog, key in (("decode", "decode"), ("nll", "nll")):
+                got = hlo["programs"].get(prog)
+                if got:
+                    analytic = out["comm_seconds"][key]
+                    out["hlo_evidence"].setdefault(
+                        "analytic_over_hlo", {})[key] = (
+                        round(analytic / got["ici_seconds"], 3)
+                        if got["ici_seconds"] else None)
+        else:
+            out["hlo_evidence"]["analytic_over_hlo"] = (
+                "skipped: hlo_collectives.json launch shapes differ from "
+                "this bench run")
+    return out
+
+
+def _hlo_evidence():
+    """Compiled-HLO collective bytes for the dp=2 x tp=4 sweep programs
+    (tools/hlo_collectives.py writes results/hlo_collectives.json on the
+    virtual mesh — GSPMD partitioning is platform-independent).  Attached so
+    the derate model's ICI terms carry compiled evidence, not only analytic
+    ratios (VERDICT r04 #7)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "hlo_collectives.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {
+        "source": "results/hlo_collectives.json",
+        "launch": d.get("launch"),
+        "programs": {
+            p["program"]: {
+                "chip_mb": round(p["total_chip_bytes"] / 1e6, 1),
+                "ici_seconds": round(p["ici_seconds_ring_model"], 4),
+            } for p in d.get("programs", [])
+        },
     }
 
 
@@ -330,13 +382,9 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
     cells_per_word = 6 + 4      # ablation budgets + projection ranks
     n_words = 20
 
-    from taboo_brittleness_tpu.pipelines.interventions import _nll_use_pallas
-
-    use_pallas_nll = _nll_use_pallas(params, None)
     runs = [
         _sweep_phase_times(params, cfg, sae, tap_layer, prompt_len,
                            new_tokens, arms, prompts_per_word, reps,
-                           use_pallas_nll,
                            dedup_floor=_DEDUP_FLOOR_S if on_accel else 0.0)
         for arms in arms_list
     ]

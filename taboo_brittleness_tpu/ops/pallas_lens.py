@@ -234,150 +234,6 @@ def lens_stats(
     )
 
 
-def _nll_tile_kernel(
-    x_ref,                       # VMEM [RN, D]   — this row block's activations
-    e_ref,                       # VMEM [BV, D]   — this tile of the embedding
-    target_ref,                  # VMEM [RN, 1] int32 — per-row target id
-    lse_ref,                     # out [8, RN] (sublane pad; row 0 real)
-    tgt_ref,                     # out [8, RN]
-    m_sc,                        # scratch [8, RN] running max
-    s_sc,                        # scratch [8, RN] running sum-exp
-    t_sc,                        # scratch [8, RN] running target logit
-    *,
-    block_v: int,
-    block_n: int,
-    logit_cap: Optional[float],
-):
-    """Slim lens readout for the NLL integrand: ONLY logsumexp + target logit.
-
-    Unlike ``_lens_tile_kernel`` this merges the flash partials ONLINE in VMEM
-    scratch across the (sequential) vocab-tile grid instead of emitting
-    [NT, 8, N] per-tile partials to HBM — the full-stats kernel's partials
-    plus top-k candidates cost ~225 MB at sweep shapes (110 rows x 50
-    response columns), which tipped the 16 GB chip over when compiled next to
-    the model params; here the outputs are two [8, N] rows (~0.4 MB).
-
-    Grid order: row block OUTER, vocab tile inner — the opposite of the
-    full-stats kernel.  The accumulators and the output block of row block i
-    then live in VMEM across the whole vocab sweep (consecutive visits) and
-    flush once, while the embed tile re-streams per step, double-buffered
-    behind the matmul.  The j-outer order (embed resident, partials per tile)
-    needs either per-tile HBM partials (the OOM above) or accumulators
-    addressed at a per-step dynamic offset — measured 10-30x slower per step
-    than the matmul (lane-offset scratch dslices, revisited-output fetches).
-    """
-    i, j = pl.program_id(0), pl.program_id(1)
-    x = x_ref[:]                                            # [N, D]
-    e = e_ref[:]                                            # [BV, D]
-    logits = jax.lax.dot_general(
-        x, e, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                       # [N, BV] f32
-    if logit_cap is not None:
-        logits = jnp.tanh(logits / logit_cap) * logit_cap
-
-    n, bv = logits.shape
-    base = j * block_v
-    col = jax.lax.broadcasted_iota(jnp.int32, (n, bv), 1)
-
-    tile_max = jnp.max(logits, axis=1)                      # [N]
-    tile_sum = jnp.sum(jnp.exp(logits - tile_max[:, None]), axis=1)
-    local = target_ref[:, 0] - base
-    hit = (col == local[:, None])
-    tile_tgt = jnp.where(
-        jnp.logical_and(local >= 0, local < bv),
-        jnp.sum(jnp.where(hit, logits, 0.0), axis=1),
-        NEG_INF,
-    )
-    tile_max8 = jnp.broadcast_to(tile_max[None, :], (8, n))
-    tile_sum8 = jnp.broadcast_to(tile_sum[None, :], (8, n))
-    tile_tgt8 = jnp.broadcast_to(tile_tgt[None, :], (8, n))
-
-    @pl.when(j == 0)
-    def _init():
-        m_sc[...] = tile_max8
-        s_sc[...] = tile_sum8
-        t_sc[...] = tile_tgt8
-
-    @pl.when(j > 0)
-    def _merge():
-        m_old = m_sc[...]
-        m_new = jnp.maximum(m_old, tile_max8)
-        s_sc[...] = (s_sc[...] * jnp.exp(m_old - m_new)
-                     + tile_sum8 * jnp.exp(tile_max8 - m_new))
-        m_sc[...] = m_new
-        t_sc[...] = jnp.maximum(t_sc[...], tile_tgt8)
-
-    # Write the running state every visit; the block stays VMEM-resident
-    # across the vocab sweep (same index for all j) and the final visit's
-    # values are what flushes to HBM.
-    del i
-    lse_ref[...] = m_sc[...] + jnp.log(s_sc[...])
-    tgt_ref[...] = t_sc[...]
-
-
-@functools.partial(
-    jax.jit, static_argnames=("logit_cap", "block_v", "block_n", "interpret"))
-def nll_stats(
-    x: jax.Array,            # [N, D] final-norm'd rows
-    embed: jax.Array,        # [V, D]
-    target_ids: jax.Array,   # [N] int32 (next-token ids; -1 = no target)
-    *,
-    logit_cap: Optional[float] = None,
-    block_v: int = 1024,
-    block_n: int = 256,
-    interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
-    """(logsumexp [N], target_logit [N]) — the per-position NLL integrand
-    ``lse - tgt``, with O(N) HBM output (see ``_nll_tile_kernel``)."""
-    n_rows, d = x.shape
-    v = embed.shape[0]
-    if v % block_v:
-        raise ValueError(f"vocab {v} not divisible by block_v {block_v}")
-    nt = v // block_v
-
-    targets = jnp.asarray(target_ids, jnp.int32)
-    if targets.shape != (n_rows,):
-        raise ValueError(f"target_ids must be [N={n_rows}], got {targets.shape}")
-
-    block_n = min(block_n, ((n_rows + 7) // 8) * 8)
-    n_pad = (-n_rows) % block_n
-    if n_pad:
-        x = jnp.concatenate([x, jnp.zeros((n_pad, d), x.dtype)], axis=0)
-        targets = jnp.concatenate(
-            [targets, jnp.full((n_pad,), -1, jnp.int32)], axis=0)
-    n = n_rows + n_pad
-    nr = n // block_n
-
-    kernel = functools.partial(
-        _nll_tile_kernel, block_v=block_v, block_n=block_n,
-        logit_cap=logit_cap)
-    lse, tgt = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((8, n), jnp.float32),
-            jax.ShapeDtypeStruct((8, n), jnp.float32),
-        ),
-        grid=(nr, nt),          # row block OUTER (see kernel docstring)
-        in_specs=[
-            pl.BlockSpec((block_n, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_v, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((8, block_n), lambda i, j: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((8, block_n), lambda i, j: (0, i), memory_space=pltpu.VMEM),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((8, block_n), jnp.float32),
-            pltpu.VMEM((8, block_n), jnp.float32),
-            pltpu.VMEM((8, block_n), jnp.float32),
-        ],
-        interpret=interpret,
-    )(x, embed, targets[:, None])
-    return lse[0, :n_rows], tgt[0, :n_rows]
-
-
 def lens_stats_reference(
     x: jax.Array, embed: jax.Array, target_id: jax.Array,
     *, top_k: int = 5, logit_cap: Optional[float] = None,

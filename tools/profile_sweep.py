@@ -106,8 +106,7 @@ def main() -> int:
             dec.sequences, dec.sequence_valid, pos2, nm,
             edit_fn=iv.sae_ablation_edit,
             edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
-            resp_start=resp_start,
-            use_pallas=iv._nll_use_pallas(params, None))
+            resp_start=resp_start)
         jax.block_until_ready(nll)
 
     fn = {"decode": run_decode, "readout": run_readout, "nll": run_nll}[args.phase]

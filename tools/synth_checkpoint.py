@@ -1,0 +1,140 @@
+"""Synthesize a full-shape Gemma-2 safetensors snapshot on disk.
+
+The real-9B on-ramp (SURVEY.md §7 hard part #1; reference src/models.py:8-53
+loads `bcywinski/gemma-2-9b-it-taboo-<word>` from the hub) cannot run here —
+no hub egress — so the converter/loader path is proven at 9B *scale* with a
+synthetic checkpoint instead (VERDICT r04 next-round #3): same 42-layer ×
+3584-hidden × 256k-vocab shapes, same bf16 dtype, same sharded-safetensors
+layout (``model-0000N-of-0000M.safetensors`` + index + ``config.json``) that
+``models/params.py`` and ``tools/fetch_and_convert.py`` consume from a real
+snapshot.
+
+Writes shard-by-shard with bounded memory (one tensor at a time, shards cut
+at ~3.5 GB), deterministic under ``--seed``.
+
+Usage::
+
+    python tools/synth_checkpoint.py --out /tmp/synth9b [--preset gemma2_9b]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Iterator, Tuple
+
+_SHARD_BYTES = 3.5e9
+
+
+def hf_tensor_shapes(cfg) -> Iterator[Tuple[str, Tuple[int, ...]]]:
+    """(HF key, shape) for every tensor of a Gemma-2 checkpoint, in the
+    layer-major order real HF snapshots use.  Shapes are the torch
+    ``[out, in]`` convention (models/params.py transposes on load)."""
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    yield "model.embed_tokens.weight", (cfg.vocab_size, D)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        yield p + "input_layernorm.weight", (D,)
+        yield p + "mlp.down_proj.weight", (D, F)
+        yield p + "mlp.gate_proj.weight", (F, D)
+        yield p + "mlp.up_proj.weight", (F, D)
+        yield p + "post_attention_layernorm.weight", (D,)
+        yield p + "post_feedforward_layernorm.weight", (D,)
+        yield p + "pre_feedforward_layernorm.weight", (D,)
+        yield p + "self_attn.k_proj.weight", (K * Dh, D)
+        yield p + "self_attn.o_proj.weight", (D, H * Dh)
+        yield p + "self_attn.q_proj.weight", (H * Dh, D)
+        yield p + "self_attn.v_proj.weight", (K * Dh, D)
+    yield "model.norm.weight", (D,)
+
+
+def write_snapshot(out_dir: str, cfg, *, seed: int = 0,
+                   shard_bytes: float = _SHARD_BYTES) -> None:
+    """Write config.json + sharded bf16 safetensors with bounded memory."""
+    import torch
+    from safetensors.torch import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    gen = torch.Generator().manual_seed(seed)
+
+    def synth(name: str, shape) -> "torch.Tensor":
+        if name.endswith("norm.weight") or "layernorm" in name:
+            # Gemma RMSNorm stores weight-minus-one; zeros = unit scale.
+            return torch.zeros(shape, dtype=torch.bfloat16)
+        t = torch.empty(shape, dtype=torch.float32)
+        t.normal_(std=0.02, generator=gen)
+        return t.to(torch.bfloat16)
+
+    # Two passes so shards stream to disk as they fill (peak memory = one
+    # shard): pass 1 plans the key->shard split from shapes alone, pass 2
+    # synthesizes and writes one shard at a time.
+    plan: list = [[]]
+    planned_bytes = 0
+    for key, shape in hf_tensor_shapes(cfg):
+        nbytes = 2  # bf16
+        for d in shape:
+            nbytes *= d
+        if planned_bytes and planned_bytes + nbytes > shard_bytes:
+            plan.append([])
+            planned_bytes = 0
+        plan[-1].append((key, shape))
+        planned_bytes += nbytes
+
+    n = len(plan)
+    weight_map: Dict[str, str] = {}
+    total = 0
+    for i, entries in enumerate(plan):
+        fname = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        tensors = {key: synth(key, shape) for key, shape in entries}
+        save_file(tensors, os.path.join(out_dir, fname))
+        for k, t in tensors.items():
+            weight_map[k] = fname
+            total += t.numel() * t.element_size()
+        del tensors
+
+    with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {"total_size": total},
+                   "weight_map": weight_map}, f)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["Gemma2ForCausalLM"],
+            "model_type": "gemma2",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "num_key_value_heads": cfg.num_kv_heads,
+            "head_dim": cfg.head_dim,
+            "intermediate_size": cfg.intermediate_size,
+            "sliding_window": cfg.sliding_window,
+            "attn_logit_softcapping": cfg.attn_logit_softcap,
+            "final_logit_softcapping": cfg.final_logit_softcap,
+            "query_pre_attn_scalar": cfg.query_pre_attn_scalar,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.rms_norm_eps,
+            "torch_dtype": "bfloat16",
+        }, f, indent=1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--preset", default="gemma2_9b")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from taboo_brittleness_tpu.models import gemma2
+
+    cfg = gemma2.PRESETS[args.preset]
+    write_snapshot(args.out, cfg, seed=args.seed)
+    size = sum(os.path.getsize(os.path.join(args.out, f))
+               for f in os.listdir(args.out))
+    print(f"synthetic {args.preset} snapshot -> {args.out} "
+          f"({size / 1e9:.2f} GB)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
