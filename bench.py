@@ -348,7 +348,11 @@ def _hlo_evidence():
         "programs": {
             p["program"]: {
                 "chip_mb": round(p["total_chip_bytes"] / 1e6, 1),
-                "ici_seconds": round(p["ici_seconds_ring_model"], 4),
+                # Seconds recomputed from the file's BYTES with THIS bench's
+                # link bandwidth — dividing by the file's own seconds would
+                # silently mix two bandwidth constants if either is retuned.
+                "ici_seconds": round(
+                    p["total_chip_bytes"] / _ICI_LINK_BW, 4),
             } for p in d.get("programs", [])
         },
     }

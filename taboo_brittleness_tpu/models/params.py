@@ -130,6 +130,8 @@ def iter_stacked_leaves(path: str, cfg: Gemma2Config):
     to the caller before the next is built.  ``leaf_path`` is
     ``("embed",)`` / ``("final_norm",)`` / ``("layers", <name>)``.
     """
+    import contextlib
+
     from safetensors import safe_open
 
     key_to_shard = _safetensors_shard_map(path)
@@ -137,34 +139,37 @@ def iter_stacked_leaves(path: str, cfg: Gemma2Config):
 
     handles: Dict[str, Any] = {}
 
-    def tensor(key: str) -> np.ndarray:
-        shard = key_to_shard["model." + key] if ("model." + key) in key_to_shard \
-            else key_to_shard[key]
-        if shard not in handles:
-            handles[shard] = safe_open(os.path.join(path, shard),
-                                       framework="numpy")
-        f = handles[shard]
-        try:
-            return f.get_tensor("model." + key)
-        except Exception:  # noqa: BLE001 — key scoping differs per snapshot
-            return f.get_tensor(key)
+    with contextlib.ExitStack() as stack:
+        def tensor(key: str) -> np.ndarray:
+            shard = key_to_shard["model." + key] \
+                if ("model." + key) in key_to_shard else key_to_shard[key]
+            if shard not in handles:
+                handles[shard] = stack.enter_context(
+                    safe_open(os.path.join(path, shard), framework="numpy"))
+            f = handles[shard]
+            try:
+                return f.get_tensor("model." + key)
+            except Exception:  # noqa: BLE001 — key scoping differs per snapshot
+                return f.get_tensor(key)
 
-    yield ("embed",), np.asarray(tensor("embed_tokens.weight"), dtype)
-    yield ("final_norm",), np.asarray(tensor("norm.weight"), dtype)
-    for leaf, (suffix, transpose) in _LAYER_MAP.items():
-        out = None
-        for i in range(cfg.num_layers):
-            t = tensor(f"layers.{i}.{suffix}")
-            if out is None:
-                shape = t.shape[::-1] if transpose else t.shape
-                out = np.empty((cfg.num_layers,) + shape, dtype)
-            out[i] = t.T if transpose else t
-        del t
-        yield ("layers", leaf), out
-        # Drop our binding before the next leaf's np.empty: without this the
-        # generator pins the PREVIOUS stacked leaf through the allocation and
-        # host staging peaks at two leaves (~8.6 GB at 9B), not one.
-        out = None
+        yield ("embed",), np.asarray(tensor("embed_tokens.weight"), dtype)
+        yield ("final_norm",), np.asarray(tensor("norm.weight"), dtype)
+        for leaf, (suffix, transpose) in _LAYER_MAP.items():
+            out = None
+            for i in range(cfg.num_layers):
+                t = tensor(f"layers.{i}.{suffix}")
+                if out is None:
+                    shape = t.shape[::-1] if transpose else t.shape
+                    out = np.empty((cfg.num_layers,) + shape, dtype)
+                out[i] = t.T if transpose else t
+            del t
+            yield ("layers", leaf), out
+            # Drop our binding before the next leaf's np.empty: without this
+            # the generator pins the PREVIOUS stacked leaf through the
+            # allocation and host staging peaks at two leaves (~8.6 GB at
+            # 9B), not one.  The ExitStack closes every shard mapping when
+            # the generator finishes or is abandoned.
+            out = None
 
 
 def from_safetensors_dir_streamed(

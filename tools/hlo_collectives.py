@@ -33,9 +33,14 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "s64": 8, "u64": 8, "pred": 1, "s8": 1, "u8": 1, "s16": 2,
                 "u16": 2}
 
+# A collective INSTRUCTION line: "%name = <shape-or-tuple> <op>(operands...".
+# Matches the async "-start" form and tuple-shaped variadic ops (the payload
+# is the sum of every component shape before the opcode); "-done" lines are
+# skipped (their shape repeats the started op's payload).
 _COLL_RE = re.compile(
-    r"=\s+(?P<shape>[a-z0-9]+\[[0-9,]*\])\S*\s+"
-    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute)\(")
+    r"=\s+(?P<shape>.+?)\s"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute)"
+    r"(?P<start>-start)?\(")
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
 
@@ -52,25 +57,33 @@ def _shape_bytes(shape_str: str) -> int:
     return total
 
 
-def _group_size(line: str) -> int:
+def _group_size(line: str, all_devices: int) -> int:
     """Group size from either replica_groups format: explicit
-    ``{{0,1,2,3},{4,5,6,7}}`` or iota-v2 ``[num_groups,group_size]<=[N]``."""
+    ``{{0,1,2,3},{4,5,6,7}}``, iota-v2 ``[num_groups,group_size]<=[N]``, or
+    the empty-``{}`` all-devices shorthand (→ ``all_devices``)."""
     m = re.search(r"replica_groups=\{\{([0-9, ]+)\}", line)
     if m:
         return len([x for x in m.group(1).split(",") if x.strip()])
     m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
     if m:
         return int(m.group(2))
-    return 0
+    # replica_groups={} (or absent): every device participates.
+    return all_devices
 
 
-def collectives_in_hlo(hlo_text: str, *, default_trip: int = 1) -> list:
+def collectives_in_hlo(hlo_text: str, *, default_trip: int = 1,
+                       all_devices: int = 8) -> list:
     """Every collective instruction with payload bytes, group size, and its
     EXECUTION MULTIPLICITY: while-loops are parsed structurally (computation
     blocks + ``body=%...`` edges) and each body's collectives multiply by the
     loop's ``known_trip_count`` — the rolled layer scan (42x) and the decode
     step loop compose.  A while with no known trip count (the decode's
-    early-exit generation loop) charges ``default_trip`` iterations."""
+    early-exit generation loop) charges ``default_trip`` iterations.
+
+    Raises if a line MENTIONS a collective opcode as an instruction but the
+    payload parse comes up empty — silent under-extraction would otherwise be
+    recorded as evidence (`-done` halves of async pairs are skipped by
+    design; their shape repeats the `-start` payload)."""
     comps: dict = {}
     entry = None
     current = None
@@ -89,12 +102,20 @@ def collectives_in_hlo(hlo_text: str, *, default_trip: int = 1) -> list:
             continue
         cm = _COLL_RE.search(line)
         if cm:
+            payload = _shape_bytes(cm.group("shape"))
+            if payload <= 0:
+                raise ValueError(
+                    "collective instruction with unparseable payload shape "
+                    f"(evidence would silently under-count): {line.strip()[:200]}")
             comps[current]["collectives"].append({
                 "op": cm.group("op"),
-                "payload_bytes": _shape_bytes(cm.group("shape")),
-                "group_size": _group_size(line),
+                "payload_bytes": payload,
+                "group_size": _group_size(line, all_devices),
             })
             continue
+        if re.search(r"\s[a-z-]*(all-reduce|all-gather|reduce-scatter|"
+                     r"collective-permute)-done\(", line):
+            continue                    # async completion: payload counted at -start
         if " while(" in line:
             bm = re.search(r"body=%?([\w.\-]+)", line)
             tm = re.search(r'"known_trip_count":\{"n":"(\d+)"\}', line)
@@ -134,8 +155,10 @@ def ring_chip_bytes(payload: int, n: int) -> float:
     return 2 * (n - 1) / n * payload
 
 
-def summarize(name: str, hlo_text: str, *, default_trip: int = 1) -> dict:
-    colls = collectives_in_hlo(hlo_text, default_trip=default_trip)
+def summarize(name: str, hlo_text: str, *, default_trip: int = 1,
+              all_devices: int = 8) -> dict:
+    colls = collectives_in_hlo(hlo_text, default_trip=default_trip,
+                               all_devices=all_devices)
     per_op: dict = {}
     total_chip_bytes = 0.0
     for c in colls:
