@@ -591,6 +591,17 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from taboo_brittleness_tpu.runtime import jax_cache
+
+    # Persistent compile cache.  The measured steady-state loops are
+    # post-warmup either way, but compile-INCLUSIVE numbers
+    # (first_word_seconds_incl_compile) depend on cache warmth — so the
+    # entry count at start is recorded next to the dir: 0 = cold run,
+    # comparable across rounds; >0 = warm, compile figures are not.
+    compile_cache = jax_cache.enable()
+    cache_entries = (len(os.listdir(compile_cache))
+                     if compile_cache and os.path.isdir(compile_cache) else 0)
+
     from taboo_brittleness_tpu.models import gemma2
     from taboo_brittleness_tpu.ops import lens, sae as sae_ops
     from taboo_brittleness_tpu.pipelines.interventions import sae_ablation_edit
@@ -695,7 +706,9 @@ def main() -> int:
         "timing_suspect_dedup": bool(
             dedup_suspect or (sweep and sweep["timing_suspect_dedup"])),
         "config": {"preset": preset, "batch": batch, "new_tokens": new_tokens,
-                   "prompt_len": prompt_len, "reps": reps},
+                   "prompt_len": prompt_len, "reps": reps,
+                   "compile_cache": compile_cache,
+                   "compile_cache_entries_at_start": cache_entries},
         # North-star account (BASELINE.json: full sweep "< 1 h on v5e-8").
         # Headline = the DERATED v5e-8 projection (decode latency intercept +
         # tp collectives charged); the band and the measured mini-study live

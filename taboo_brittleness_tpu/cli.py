@@ -391,8 +391,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (manifest env-info queries jax.devices before some subcommands build
     # their mesh); no-op outside a cluster environment.
     from taboo_brittleness_tpu.parallel import multihost
+    from taboo_brittleness_tpu.runtime import jax_cache
 
     multihost.initialize()
+    # Persistent compilation cache: the sweep's programs compile in minutes
+    # and are shape-stable, so a rerun/resume should never pay them twice
+    # (TBX_COMPILE_CACHE=0 opts out).
+    jax_cache.enable()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -684,3 +684,37 @@ def test_nll_cached_continuation_matches_full(setup):
         iv._teacher_forced_nll_cached(
             params, cfg, *dec.prefill_cache, *full_args[2:],
             resp_start=s + 1)
+
+
+def test_measure_arm_sets_matches_per_set_measure_arms(setup):
+    """The fused two-sweep dispatch stream (measure_arm_sets, the production
+    study path) must produce exactly what per-set measure_arms produces —
+    same arms, same order, same numbers."""
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import projection, sae as sae_ops
+
+    params, cfg, tok, config, sae = setup
+    state = iv.prepare_word_state(params, cfg, tok, config, WORD)
+    D = cfg.hidden_size
+
+    abl_shared = {"sae": sae, "layer": config.model.layer_idx}
+    abl_arm = {"latent_ids": jnp.asarray(
+        np.asarray([[1, 2], [3, -1], [0, 5]]), jnp.int32)}
+    proj_shared = {"layer": config.model.layer_idx}
+    proj_arm = {"basis": jnp.stack(
+        [projection.random_subspace(jax.random.PRNGKey(i), D, 1)
+         for i in range(2)])}
+
+    sets = [(iv.sae_ablation_edit, abl_shared, abl_arm, 2),
+            (iv.projection_edit, proj_shared, proj_arm, None)]
+    fused_a, fused_p = iv.measure_arm_sets(params, cfg, tok, config, state,
+                                           sets)
+    solo_a = iv.measure_arms(params, cfg, tok, config, state,
+                             iv.sae_ablation_edit, abl_shared, abl_arm,
+                             arm_chunk=2)
+    solo_p = iv.measure_arms(params, cfg, tok, config, state,
+                             iv.projection_edit, proj_shared, proj_arm)
+    assert fused_a == solo_a
+    assert fused_p == solo_p
+    assert len(fused_a) == 3 and len(fused_p) == 2

@@ -100,6 +100,10 @@ def main() -> int:
 
     import jax
 
+    from taboo_brittleness_tpu.runtime import jax_cache
+
+    jax_cache.enable()
+
     from taboo_brittleness_tpu.config import (
         Config, ExperimentConfig, InterventionConfig, ModelConfig)
     from taboo_brittleness_tpu.models import gemma2

@@ -44,6 +44,10 @@ def main() -> int:
     args = ap.parse_args()
 
     import jax
+
+    from taboo_brittleness_tpu.runtime import jax_cache
+
+    jax_cache.enable()
     import numpy as np
 
     from taboo_brittleness_tpu.config import (
