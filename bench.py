@@ -548,16 +548,25 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
         # Figures render via the CLI's own background renderer (the SAME
         # pipeline shape the sweep command runs); the final join is timed
         # and amortized into the steady-state number so nothing escapes the
-        # clock.
+        # clock.  ONE driver call over all words — per-word times come from
+        # the driver's own on_word_done callback, so the cross-WORD
+        # pipelining (next word's baseline dispatched behind this word's
+        # tail) is on the clock exactly as production runs it.
         from taboo_brittleness_tpu.cli import StudyPlotRenderer
 
         with StudyPlotRenderer(config, out_dir) as renderer:
-            for w in words:
-                t0 = time.perf_counter()
-                run_intervention_studies(
-                    config, model_loader=model_loader, sae=sae, words=[w],
-                    output_dir=out_dir, on_word_done=renderer.on_word_done)
-                word_seconds.append(round(time.perf_counter() - t0, 2))
+            t_prev = time.perf_counter()
+
+            def on_done(w, study):
+                nonlocal t_prev
+                now = time.perf_counter()
+                word_seconds.append(round(now - t_prev, 2))
+                t_prev = now
+                renderer.on_word_done(w, study)
+
+            run_intervention_studies(
+                config, model_loader=model_loader, sae=sae, words=words,
+                output_dir=out_dir, on_word_done=on_done)
             t0 = time.perf_counter()
             renderer.join()
             join_seconds = time.perf_counter() - t0

@@ -401,6 +401,29 @@ def prepare_word_state(
     (repeating the last prompt) so the launch still runs sharded, and every
     per-row output strips back to the real prompts — dp sharding is never
     dropped silently (same recipe as ``logit_lens.analyze_word_on_device``)."""
+    return prepare_word_collect(
+        prepare_word_dispatch(params, cfg, tok, config, word, mesh=mesh))
+
+
+def prepare_word_dispatch(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+    *,
+    mesh: Any = None,
+) -> Dict[str, Any]:
+    """Enqueue the baseline pass's four device programs (decode with
+    in-flight residual capture, tap readout, cached-NLL continuation, spike
+    finding) WITHOUT any host sync, returning the in-flight handle for
+    :func:`prepare_word_collect`.
+
+    The split exists for cross-WORD pipelining: ``run_intervention_studies``
+    dispatches the NEXT word's baseline behind the CURRENT word's final arm
+    chunk, so the device crosses word boundaries without idling through the
+    host's collect/JSON/planning tail (~1 s/word of idle baseline latency
+    otherwise)."""
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     B = len(config.prompts)
@@ -442,23 +465,36 @@ def prepare_word_state(
     spike_d, _ = lens.spike_positions_batch(
         out["tap_prob"], resp_d, top_k=config.intervention.spike_top_k)
 
+    return {"word": word, "tok": tok, "dec": dec, "layout_d": layout_d,
+            "out": out, "nll_d": nll_d, "spike_d": spike_d, "resp_d": resp_d,
+            "tid": tid, "resp_start": resp_start, "B": B}
+
+
+def prepare_word_collect(handle: Dict[str, Any]) -> WordState:
+    """Pull a :func:`prepare_word_dispatch` handle's results and assemble the
+    :class:`WordState` (blocks on the baseline programs)."""
+    dec, layout_d, out = handle["dec"], handle["layout_d"], handle["out"]
+    tok, B = handle["tok"], handle["B"]
+
     # ONE batched pull for every host-side value (remote round-trips measured
     # ~0.1 s EACH; this pass used to pay ~8 of them), then host assembly.
     (tokens, lengths, seqs, valid, positions, resp, row_sum,
      row_cnt, agg_ids, nll, residual, spike_pos) = jax.device_get(
         (dec.tokens, dec.lengths, layout_d.sequences, layout_d.valid,
-         layout_d.positions, resp_d, out["row_prob_sum"],
-         out["row_resp"], out["agg_ids"], nll_d, dec.residual, spike_d))
+         layout_d.positions, handle["resp_d"], out["row_prob_sum"],
+         out["row_resp"], out["agg_ids"], handle["nll_d"], dec.residual,
+         handle["spike_d"]))
     texts = decode.texts_from_tokens(tok, tokens[:B], lengths[:B])
     secret_prob = float(row_sum[:B].sum() / max(float(row_cnt[:B].sum()), 1.0))
     guesses = _decode_guess_rows(tok, agg_ids[:B])
 
     return WordState(
-        word=word, target_id=int(tid),
+        word=handle["word"], target_id=int(handle["tid"]),
         sequences=seqs[:B], valid=valid[:B], positions=positions[:B],
         response_mask=resp[:B], residual=residual[:B],
         secret_prob=secret_prob, baseline_nll=nll[:B], spike_pos=spike_pos[:B],
-        response_texts=texts, guesses=guesses, resp_start=resp_start,
+        response_texts=texts, guesses=guesses,
+        resp_start=handle["resp_start"],
         residual_dev=dec.residual[:B],
     )
 
@@ -859,9 +895,15 @@ def measure_arm_sets(
                          Optional[int]]],
     *,
     mesh: Any = None,
+    after_last_dispatch: Optional[Callable[[], None]] = None,
 ) -> List[List[ArmResult]]:
     """Measure several arm stacks — e.g. the ablation AND projection sweeps —
     in ONE software-pipelined dispatch stream.
+
+    ``after_last_dispatch`` fires once every chunk's programs are in the
+    device queue, BEFORE the final collects — the hook
+    ``run_intervention_studies`` uses to enqueue the next word's baseline
+    behind this word's tail (cross-word pipelining).
 
     ``sets`` holds ``(edit_fn, shared_ep, per_arm, arm_chunk)`` per stack;
     returns one ``List[ArmResult]`` per stack.  Each stack chunks exactly as
@@ -913,6 +955,8 @@ def measure_arm_sets(
             psi, ph, pn = pending
             results[psi].extend(_collect_rows(tok, config, state, ph)[:pn])
         pending = (si, handle, n_real)
+    if after_last_dispatch is not None:
+        after_last_dispatch()
     if pending is not None:
         psi, ph, pn = pending
         results[psi].extend(_collect_rows(tok, config, state, ph)[:pn])
@@ -1171,6 +1215,8 @@ def run_intervention_study(
     output_path: Optional[str] = None,
     mesh: Any = None,
     forcing: bool = False,
+    prepared: Optional[Dict[str, Any]] = None,
+    after_arms_dispatched: Optional[Callable[[], None]] = None,
 ) -> Dict[str, Any]:
     """Full brittleness study for one word: baseline + both sweeps.
 
@@ -1180,9 +1226,20 @@ def run_intervention_study(
     boundary without draining its queue for the host-side scoring/assembly
     in between.
 
+    ``prepared`` accepts an in-flight :func:`prepare_word_dispatch` handle
+    for this word (the studies driver dispatches it behind the PREVIOUS
+    word's tail); ``after_arms_dispatched`` forwards to
+    :func:`measure_arm_sets`'s post-dispatch hook.
+
     ``forcing=True`` adds pre/postgame token-forcing success under each
     targeted arm (and for the unedited baseline, for reference)."""
-    state = prepare_word_state(params, cfg, tok, config, word, mesh=mesh)
+    if prepared is not None:
+        if prepared["word"] != word:
+            raise ValueError(
+                f"prepared baseline is for {prepared['word']!r}, not {word!r}")
+        state = prepare_word_collect(prepared)
+    else:
+        state = prepare_word_state(params, cfg, tok, config, word, mesh=mesh)
     baseline: Dict[str, Any] = {
         "secret_prob": state.secret_prob,
         "guesses": state.guesses,
@@ -1193,7 +1250,8 @@ def run_intervention_study(
     proj_set, proj_assemble = plan_projection_sweep(
         params, cfg, tok, config, state, forcing=forcing)
     abl_arms, proj_arms = measure_arm_sets(
-        params, cfg, tok, config, state, [abl_set, proj_set], mesh=mesh)
+        params, cfg, tok, config, state, [abl_set, proj_set], mesh=mesh,
+        after_last_dispatch=after_arms_dispatched)
     ablation = abl_assemble(abl_arms)
     if forcing:
         # The unedited baseline rode in the ablation batch as the identity
@@ -1236,6 +1294,14 @@ def run_intervention_studies(
     both sweeps, prefetching the NEXT word's checkpoint on a host thread while
     the current word computes (runtime.checkpoints.prefetch_next).
 
+    Cross-word pipelining: once the current word's LAST arm chunk is in the
+    device queue, the NEXT word's baseline pass dispatches behind it
+    (``after_arms_dispatched`` → :func:`prepare_word_dispatch`) — the device
+    crosses the word boundary straight into the next baseline instead of
+    idling through the host's collect/JSON/planning tail.  A failure while
+    early-loading the next word is swallowed here (the current word's results
+    must land first) and resurfaces at that word's own ``model_loader`` call.
+
     Resumable the same way the generation cache is: a word whose results JSON
     already exists is skipped (delete it or pass ``force`` to redo), so a
     crashed sweep restarts where it stopped.
@@ -1251,6 +1317,7 @@ def run_intervention_studies(
         return not force and os.path.exists(os.path.join(output_dir, f"{w}.json"))
 
     out: Dict[str, Any] = {}
+    prepared_next: Optional[Dict[str, Any]] = None
     for i, word in enumerate(words):
         path = os.path.join(output_dir, f"{word}.json")
         if done(word):
@@ -1260,6 +1327,10 @@ def run_intervention_studies(
                 on_word_done(word, out[word])
             continue
         params, cfg, tok = model_loader(word)
+        prepared = (prepared_next
+                    if prepared_next and prepared_next["word"] == word
+                    else None)
+        prepared_next = None
         # Overlap the next word's checkpoint IO with this word's compute —
         # but only a word that will actually RUN: prefetching a to-be-skipped
         # word would pin its params in the loader's pending slot forever.
@@ -1268,9 +1339,38 @@ def run_intervention_studies(
         todo = [w for w in words[i + 1:] if not done(w)]
         if todo:
             prefetch_next(model_loader, [word, todo[0]], 0)
+
+        # The in-flight baseline handle costs ~0.3 GB/chip at 9B shapes
+        # (B=10 prefill KV + residual) on top of the final chunks' buffers;
+        # TBX_CROSS_WORD_BASELINE=0 turns the pre-dispatch off if an HBM
+        # budget ever needs it back.
+        cross_word = os.environ.get("TBX_CROSS_WORD_BASELINE", "1") != "0"
+
+        def dispatch_next_baseline(nxt=todo[0] if todo else None):
+            nonlocal prepared_next
+            if nxt is None or prepared_next is not None:
+                return
+            try:
+                p2, c2, t2 = model_loader(nxt)
+                prepared_next = prepare_word_dispatch(
+                    p2, c2, t2, config, nxt, mesh=mesh)
+            except Exception as e:  # noqa: BLE001 — must not lose THIS
+                # word's results to the next word's early load/dispatch
+                # failure.  A LOADER failure resurfaces at that word's own
+                # model_loader call (after this word's JSON is written); a
+                # dispatch failure falls back to the un-pipelined baseline,
+                # so log it — it would otherwise be invisible.
+                import sys
+
+                print(f"[study] next-word baseline pre-dispatch failed "
+                      f"({nxt}): {e}", file=sys.stderr)
+                prepared_next = None
+
         out[word] = run_intervention_study(
             params, cfg, tok, config, word, sae, output_path=path, mesh=mesh,
-            forcing=forcing)
+            forcing=forcing, prepared=prepared,
+            after_arms_dispatched=(dispatch_next_baseline if cross_word
+                                   else None))
         if on_word_done is not None:
             on_word_done(word, out[word])
     return out

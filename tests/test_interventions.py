@@ -718,3 +718,60 @@ def test_measure_arm_sets_matches_per_set_measure_arms(setup):
     assert fused_a == solo_a
     assert fused_p == solo_p
     assert len(fused_a) == 3 and len(fused_p) == 2
+
+
+def test_cross_word_pipelining_matches_sequential(setup, tmp_path):
+    """The studies driver's cross-word baseline pre-dispatch must change
+    NOTHING about the results: two words through run_intervention_studies
+    (pipelined path) equal the same words run one-by-one through
+    run_intervention_study."""
+    import dataclasses as dc
+    import json as json_mod
+
+    params, cfg, tok, config, sae = setup
+    fast_iv = dc.replace(config.intervention, budgets=(1, 2),
+                         random_trials=1, ranks=(1,))
+    config2 = dc.replace(config, intervention=fast_iv,
+                         word_plurals={WORD: [WORD], "word2": ["word2"]})
+    out_dir = str(tmp_path / "studies")
+
+    res = iv.run_intervention_studies(
+        config2, model_loader=lambda w: (params, cfg, tok), sae=sae,
+        words=[WORD, "word2"], output_dir=out_dir)
+
+    for w in (WORD, "word2"):
+        solo = iv.run_intervention_study(params, cfg, tok, config2, w, sae)
+        # JSON round-trip both sides so container/float representations
+        # compare canonically.
+        assert (json_mod.loads(json_mod.dumps(res[w]))
+                == json_mod.loads(json_mod.dumps(solo)))
+
+
+def test_cross_word_pipelining_survives_next_word_load_failure(
+        setup, tmp_path):
+    """A loader failure during the EARLY (pipelined) load of word 2 must not
+    lose word 1's results: its JSON lands first, and the failure resurfaces
+    at word 2's own load."""
+    import dataclasses as dc
+    import os as os_mod
+
+    params, cfg, tok, config, sae = setup
+    fast_iv = dc.replace(config.intervention, budgets=(1,),
+                         random_trials=1, ranks=(1,))
+    config2 = dc.replace(config, intervention=fast_iv,
+                         word_plurals={WORD: [WORD], "word2": ["word2"]})
+    out_dir = str(tmp_path / "studies")
+
+    class Crash(RuntimeError):
+        pass
+
+    def loader(w):
+        if w == "word2":
+            raise Crash("checkpoint gone")
+        return params, cfg, tok
+
+    with pytest.raises(Crash):
+        iv.run_intervention_studies(
+            config2, model_loader=loader, sae=sae, words=[WORD, "word2"],
+            output_dir=out_dir)
+    assert os_mod.path.exists(os_mod.path.join(out_dir, f"{WORD}.json"))
