@@ -10,6 +10,7 @@ reference bug noted in SURVEY.md anti-goals):
     python -m taboo_brittleness_tpu sae-baseline  [-c CFG] [--sae-npz PATH]
     python -m taboo_brittleness_tpu interventions [-c CFG] --word W [--sae-npz PATH]
     python -m taboo_brittleness_tpu token-forcing [-c CFG] [--modes pregame postgame]
+    python -m taboo_brittleness_tpu prompting     [-c CFG] [--modes naive adversarial]
 
 Every subcommand accepts the reference's ``configs/default.yaml`` schema
 unchanged (config.load_config).
@@ -339,6 +340,27 @@ def cmd_token_forcing(args) -> int:
     return 0
 
 
+def cmd_prompting(args) -> int:
+    from taboo_brittleness_tpu.pipelines import prompting
+
+    config = _load(args)
+    out = args.output or os.path.join("results", "prompting", "results.json")
+    manifest = _manifest(args, "prompting")
+    with manifest.stage("prompting"):
+        results = prompting.run_prompting_attacks(
+            config, model_loader=_loader(config, args, mesh=_mesh(config)),
+            words=args.words,
+            modes=tuple(args.modes), output_path=out,
+            output_dir=os.path.join(os.path.dirname(out) or ".", "words"),
+            force=args.force)
+    manifest.add_artifact(out)
+    manifest.extra["overall"] = results["overall"]
+    print(json.dumps(results["overall"], indent=2))
+    print(f"results -> {out}")
+    _finish(args, manifest, os.path.dirname(out))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="taboo_brittleness_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -383,6 +405,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="re-measure words whose per-word results already "
                          "exist (default: resume by skipping them)")
     tf.set_defaults(fn=cmd_token_forcing)
+
+    pr = sub.add_parser("prompting",
+                        help="naive/adversarial direct-elicitation attacks")
+    _common(pr)
+    pr.add_argument("--modes", nargs="+", default=["naive", "adversarial"],
+                    choices=["naive", "adversarial"])
+    pr.add_argument("--output", default=None)
+    pr.add_argument("--force", action="store_true",
+                    help="re-measure words whose per-word results already "
+                         "exist (default: resume by skipping them)")
+    pr.set_defaults(fn=cmd_prompting)
     return p
 
 

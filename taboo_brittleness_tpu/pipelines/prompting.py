@@ -1,0 +1,120 @@
+"""Naive / adversarial prompting attacks (paper Table 1's remaining
+elicitation rows; 2505.14352v1.pdf — neither implemented nor planned in the
+reference's ``src/``, listed as a stretch gap in round-4's review).
+
+Mechanics: each attack prompt renders as a plain user turn, all prompts of a
+mode decode as ONE left-padded batch (the same batched-greedy program every
+other pipeline uses), and success is the paper's leak criterion — the secret
+word (any accepted form, word-boundary matched) appears in the response.
+Per-word metrics mirror token forcing: ``success_rate`` = fraction of attack
+prompts that leak; ``pass_at_k`` = did ANY leak (the Table-1 Pass@10 shape).
+
+Prompt-set provenance: the paper's exact appendix lists are not extractable
+in this offline environment — ``config.NAIVE_PROMPTS`` /
+``ADVERSARIAL_PROMPTS`` are representative stand-ins, overridable from YAML
+(``prompting:`` section).
+
+Like the forcing sweep, results are word-independent given the model, so a
+shared-model loader (tests, bench) pays one decode per mode for the whole
+word list; real per-word checkpoints recompute.
+"""
+
+from __future__ import annotations
+
+import os  # noqa: F401  (kept: output_path dirname use below)
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from taboo_brittleness_tpu import metrics as metrics_mod
+from taboo_brittleness_tpu.config import Config
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params
+from taboo_brittleness_tpu.runtime import decode
+from taboo_brittleness_tpu.runtime.tokenizer import TokenizerLike
+
+MODES = ("naive", "adversarial")
+
+
+def _mode_prompts(config: Config, mode: str) -> List[str]:
+    if mode == "naive":
+        return list(config.prompting.naive_prompts)
+    if mode == "adversarial":
+        return list(config.prompting.adversarial_prompts)
+    raise ValueError(f"unknown prompting mode {mode!r}; expected {MODES}")
+
+
+def _attack_responses(
+    params: Params, cfg: Gemma2Config, tok: TokenizerLike, config: Config,
+    mode: str,
+    *,
+    edit_fn: Optional[Callable] = None, edit_params: Any = None,
+) -> List[str]:
+    """One batched decode over the mode's attack prompts -> response texts
+    (word-independent given the model — see module docstring)."""
+    _, texts, _ = decode.generate(
+        params, cfg, tok, _mode_prompts(config, mode),
+        max_new_tokens=config.experiment.max_new_tokens,
+        pad_to_multiple=config.experiment.pad_to_multiple,
+        edit_fn=edit_fn, edit_params=edit_params)
+    return texts
+
+
+def score_prompting(config: Config, word: str, mode: str,
+                    responses: Sequence[str]) -> Dict[str, Any]:
+    valid_forms = {f.lower() for f in config.word_plurals.get(word, [word])}
+    leaks = [metrics_mod.forcing_success([r], valid_forms) > 0
+             for r in responses]
+    return {
+        "word": word,
+        "mode": mode,
+        "success_rate": float(np.mean(leaks)) if leaks else 0.0,
+        "pass_at_k": float(any(leaks)),
+        "responses": list(responses),
+    }
+
+
+def run_prompting_attacks(
+    config: Config,
+    *,
+    model_loader: Callable,
+    words: Optional[Sequence[str]] = None,
+    modes: Sequence[str] = MODES,
+    output_path: Optional[str] = None,
+    output_dir: Optional[str] = None,
+    force: bool = False,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+) -> Dict[str, Any]:
+    """Prompting-attack sweep over words; per-word success + overall means
+    per mode (the paper's Table-1 'Naive/Adversarial prompting' rows).
+
+    Resume/memoization contract: :mod:`pipelines.word_sweep` (shared with
+    ``token_forcing.run_token_forcing``) — per-word atomic JSONs, payloads
+    memoized on (params, tokenizer) identity so a shared-model loader pays
+    one decode per mode for the entire word list.
+    """
+    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
+    from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+
+    words = list(words if words is not None else config.words)
+    results = run_word_sweep(
+        config, model_loader=model_loader, words=words, modes=modes,
+        compute_mode=lambda p, c, t, cf, m: _attack_responses(
+            p, c, t, cf, m, edit_fn=edit_fn, edit_params=edit_params),
+        score_word=lambda cf, w, m, payload: score_prompting(
+            cf, w, m, payload),
+        output_dir=output_dir, force=force)
+
+    overall = {
+        mode: {
+            "success_rate": float(np.mean(
+                [results[w][mode]["success_rate"] for w in words])),
+            "pass_at_k": float(np.mean(
+                [results[w][mode]["pass_at_k"] for w in words])),
+        }
+        for mode in modes
+    }
+    out = {"overall": overall, "words": results}
+    if output_path:
+        _atomic_json_dump(out, output_path)
+    return out

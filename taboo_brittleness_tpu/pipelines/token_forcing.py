@@ -18,8 +18,6 @@ projected model (the Execution Plan measures forcing success per arm).
 
 from __future__ import annotations
 
-import json
-import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -297,74 +295,32 @@ def run_token_forcing(
     soon as they exist, and a word whose file exists is skipped (its model is
     never loaded) — a crash at word 19 of 20 costs one word, not the sweep.
     Pass ``force`` to redo.  ``output_path`` (the aggregate JSON) also writes
-    atomically, last.
+    atomically, last.  The resume + (params, tokenizer)-identity memoization
+    contract lives in :mod:`pipelines.word_sweep` (shared with the prompting
+    attacks).
     """
     from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
-    from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+    from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
 
     words = list(words if words is not None else config.words)
-
-    def word_path(w: str) -> Optional[str]:
-        return os.path.join(output_dir, f"{w}.json") if output_dir else None
-
-    def load_done(w: str) -> Optional[Dict[str, Any]]:
-        """The word's saved entry, or None if it must (re)run.  A file from a
-        narrower-modes run does NOT count as done: resuming with more modes
-        re-measures the word instead of crashing at aggregation on the
-        missing key."""
-        p = word_path(w)
-        if p is None or force or not os.path.exists(p):
-            return None
-        with open(p) as f:
-            entry = json.load(f)
-        return entry if all(m in entry for m in modes) else None
-
-    def done(w: str) -> bool:
-        return load_done(w) is not None
-
-    results: Dict[str, Any] = {}
-    # Completion memo for the CURRENT (params, tokenizer) pair (see
-    # docstring): compare by identity, replace on miss so a real per-word
-    # loader never holds more than the in-flight checkpoint alive through
-    # this reference.  The tokenizer is part of the key because the memoized
-    # completions are decoded TEXT — a loader pairing one params object with
-    # per-word tokenizers must not reuse them.
-    memo_key: Any = None
-    memo: Dict[str, Any] = {}
     kw = dict(edit_fn=edit_fn, edit_params=edit_params)
-    for i, word in enumerate(words):
-        saved = load_done(word)
-        if saved is not None:
-            results[word] = saved
-            continue
-        params, cfg, tok = model_loader(word)
-        if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
-            memo_key, memo = (params, tok), {}
-        # Overlap the next *running* word's checkpoint IO with this word's
-        # compute (a to-be-skipped word would pin the pending slot forever).
-        # next() stops at the first pending word — no full O(words²) rescan
-        # (and re-parse of every done word's JSON) per iteration.
-        nxt = next((w for w in words[i + 1:] if not done(w)), None)
-        if nxt is not None:
-            prefetch_next(model_loader, [word, nxt], 0)
-        entry: Dict[str, Any] = {}
-        if "pregame" in modes:
-            if "pregame" not in memo:
-                memo["pregame"] = _pregame_completions(
-                    params, cfg, tok, config, **kw)
-            entry["pregame"] = _score_entry(
-                config, word, "pregame", memo["pregame"])
-        if "postgame" in modes:
-            if "postgame" not in memo:
-                memo["postgame"] = _postgame_completions(
-                    params, cfg, tok, config, **kw)
-            completions, transcript = memo["postgame"]
-            entry["postgame"] = _score_entry(
-                config, word, "postgame", completions,
-                warmup_transcript=transcript)
-        results[word] = entry
-        if output_dir:
-            _atomic_json_dump(entry, word_path(word))
+
+    def compute(params, cfg, tok, cf, mode):
+        if mode == "pregame":
+            return _pregame_completions(params, cfg, tok, cf, **kw)
+        return _postgame_completions(params, cfg, tok, cf, **kw)
+
+    def score(cf, word, mode, payload):
+        if mode == "pregame":
+            return _score_entry(cf, word, "pregame", payload)
+        completions, transcript = payload
+        return _score_entry(cf, word, "postgame", completions,
+                            warmup_transcript=transcript)
+
+    results = run_word_sweep(
+        config, model_loader=model_loader, words=words, modes=modes,
+        compute_mode=compute, score_word=score,
+        output_dir=output_dir, force=force)
 
     overall = {
         mode: float(np.mean([results[w][mode]["success_rate"] for w in words]))

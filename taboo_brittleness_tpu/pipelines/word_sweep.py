@@ -1,0 +1,90 @@
+"""Shared per-word attack-sweep driver (token forcing + prompting).
+
+Both attack pipelines sweep the word list with the same contract, kept in
+ONE place so the resume and memoization rules cannot drift apart:
+
+- **Resume:** with ``output_dir`` each word's entry writes atomically to
+  ``<output_dir>/<word>.json`` as soon as it exists; a word whose file
+  already covers every requested mode is skipped (its model is never
+  loaded).  A file from a narrower-modes run does NOT count as done.
+- **Memoization:** the per-mode payload (decoded attack responses) is
+  word-independent given the model, so it memoizes on the loaded
+  ``(params, tokenizer)`` IDENTITY — a shared-model loader (tests, bench,
+  arm studies) pays one decode per mode for the whole list, while real
+  per-word checkpoints recompute.  The tokenizer is part of the key because
+  payloads contain decoded text.
+- **Prefetch:** the next *running* word's checkpoint IO overlaps this
+  word's compute (``runtime.checkpoints.prefetch_next``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from taboo_brittleness_tpu.config import Config
+
+
+def run_word_sweep(
+    config: Config,
+    *,
+    model_loader: Callable,
+    words: Sequence[str],
+    modes: Sequence[str],
+    compute_mode: Callable[..., Any],
+    score_word: Callable[[Config, str, str, Any], Dict[str, Any]],
+    output_dir: Optional[str] = None,
+    force: bool = False,
+) -> Dict[str, Any]:
+    """Per-word entries ``{word: {mode: score_word(...)}}``.
+
+    ``compute_mode(params, cfg, tok, config, mode)`` produces the
+    word-independent payload for a mode under one model;
+    ``score_word(config, word, mode, payload)`` turns it into the word's
+    entry for that mode.  Callers aggregate their own ``overall`` block.
+    """
+    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
+    from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
+    words = list(words)
+
+    def word_path(w: str) -> Optional[str]:
+        return os.path.join(output_dir, f"{w}.json") if output_dir else None
+
+    def load_done(w: str) -> Optional[Dict[str, Any]]:
+        p = word_path(w)
+        if p is None or force or not os.path.exists(p):
+            return None
+        with open(p) as f:
+            entry = json.load(f)
+        return entry if all(m in entry for m in modes) else None
+
+    def done(w: str) -> bool:
+        return load_done(w) is not None
+
+    results: Dict[str, Any] = {}
+    memo_key: Any = None
+    memo: Dict[str, Any] = {}
+    for i, word in enumerate(words):
+        saved = load_done(word)
+        if saved is not None:
+            results[word] = saved
+            continue
+        params, cfg, tok = model_loader(word)
+        if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
+            memo_key, memo = (params, tok), {}
+        # next() stops at the first pending word — no full O(words²) rescan
+        # (and re-parse of every done word's JSON) per iteration.
+        nxt = next((w for w in words[i + 1:] if not done(w)), None)
+        if nxt is not None:
+            prefetch_next(model_loader, [word, nxt], 0)
+        entry: Dict[str, Any] = {}
+        for mode in modes:
+            if mode not in memo:
+                memo[mode] = compute_mode(params, cfg, tok, config, mode)
+            entry[mode] = score_word(config, word, mode, memo[mode])
+        results[word] = entry
+        if output_dir:
+            _atomic_json_dump(entry, word_path(word))
+    return results

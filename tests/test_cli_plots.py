@@ -17,6 +17,7 @@ def test_cli_parser_covers_all_subcommands():
         ["sae-baseline", "--sae-npz", "x.npz"],
         ["interventions", "--word", "ship", "--sae-npz", "x.npz"],
         ["token-forcing", "--modes", "pregame"],
+        ["prompting", "--modes", "naive"],
     ):
         args = p.parse_args(argv)
         assert callable(args.fn)

@@ -1,0 +1,107 @@
+"""Naive/adversarial prompting attacks (paper Table 1's remaining
+elicitation rows) on the tiny model."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu.config import Config, ExperimentConfig, ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.pipelines import prompting
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    words = [WORD, "secret", "word", "is", "What", "your", "Tell", "me",
+             "the", "you", "hiding"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=1, top_k=2, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        word_plurals={WORD: [WORD, WORD + "s"], "word2": ["word2"]},
+        prompts=["hint"],
+    )
+    return params, cfg, tok, config
+
+
+def test_scoring_detects_leaks(setup):
+    _, _, _, config = setup
+    n = len(config.prompting.naive_prompts)
+    res = prompting.score_prompting(
+        config, WORD, "naive",
+        ["I cannot say."] * (n - 1) + ["Fine, it is moon!"])
+    assert res["success_rate"] == pytest.approx(1.0 / n)
+    assert res["pass_at_k"] == 1.0
+    none = prompting.score_prompting(config, WORD, "naive",
+                                     ["moonlight only"] * n)
+    assert none["success_rate"] == 0.0 and none["pass_at_k"] == 0.0
+
+
+def test_run_prompting_attacks_end_to_end(setup, tmp_path):
+    params, cfg, tok, config = setup
+    out = str(tmp_path / "prompting.json")
+    res = prompting.run_prompting_attacks(
+        config, model_loader=lambda w: (params, cfg, tok),
+        words=[WORD, "word2"], output_path=out,
+        output_dir=str(tmp_path / "words"))
+    assert set(res["overall"]) == {"naive", "adversarial"}
+    for mode in ("naive", "adversarial"):
+        entry = res["words"][WORD][mode]
+        assert len(entry["responses"]) == len(
+            prompting._mode_prompts(config, mode))
+        assert 0.0 <= entry["success_rate"] <= 1.0
+    # Shared model => shared responses across words (memoized decode).
+    assert (res["words"][WORD]["naive"]["responses"]
+            == res["words"]["word2"]["naive"]["responses"])
+    assert os.path.exists(out)
+    with open(out) as f:
+        assert json.load(f)["overall"] == res["overall"]
+    # Resume: per-word files satisfy a second run without decoding.
+    loads = []
+    res2 = prompting.run_prompting_attacks(
+        config, model_loader=lambda w: (loads.append(w), params, cfg, tok)[1:],
+        words=[WORD, "word2"], output_dir=str(tmp_path / "words"))
+    assert loads == []
+    assert res2["words"][WORD] == res["words"][WORD]
+
+
+def test_run_prompting_memoizes_shared_model(setup, monkeypatch):
+    """One batched decode per mode for the whole word list under a shared
+    loader; a fresh params object recomputes."""
+    params, cfg, tok, config = setup
+    calls = []
+    real = prompting._attack_responses
+
+    def counting(*a, **kw):
+        calls.append(a[4])
+        return real(*a, **kw)
+
+    monkeypatch.setattr(prompting, "_attack_responses", counting)
+    prompting.run_prompting_attacks(
+        config, model_loader=lambda w: (params, cfg, tok),
+        words=[WORD, "word2"], modes=("naive",))
+    assert calls == ["naive"]
+
+    calls.clear()
+    params2 = gemma2.init_params(jax.random.PRNGKey(99), cfg)
+    loaders = {WORD: params, "word2": params2}
+    prompting.run_prompting_attacks(
+        config, model_loader=lambda w: (loaders[w], cfg, tok),
+        words=[WORD, "word2"], modes=("naive",))
+    assert calls == ["naive", "naive"]
+
+
+def test_unknown_mode_raises(setup):
+    _, _, _, config = setup
+    with pytest.raises(ValueError, match="unknown prompting mode"):
+        prompting._mode_prompts(config, "bogus")

@@ -16,7 +16,9 @@ pipeline at production shapes over the plan's real 20 words:
    ranks, R=10 controls, forcing attacks under each targeted arm) ->
    per-word JSONs + brittleness figures;
 5. standalone token-forcing results;
-6. a run manifest stamping env + stage timings.
+6. naive/adversarial prompting-attack results (paper Table 1's remaining
+   elicitation rows);
+7. a run manifest stamping env + stage timings.
 
 Usage (real chip, ~10-15 min)::
 
@@ -114,6 +116,17 @@ def main() -> int:
         f"{arch} RANDOM weights (no hub egress on this host; shapes and "
         "pipeline are production, numbers are not scientific results)")
     manifest.extra["words"] = len(words)
+
+    def stamp_resumed(stage: str, dir_path: str) -> None:
+        """Provenance: per-word artifacts that already exist were RESUMED,
+        not produced by this run — stage timings only cover the rest (the
+        whole tree is resumable, so a manifest from a resumed pass would
+        otherwise read as an implausible speedup)."""
+        resumed = sorted(
+            f[:-5] for f in (os.listdir(dir_path)
+                             if os.path.isdir(dir_path) else [])
+            if f.endswith(".json")) 
+        manifest.extra.setdefault("resumed_words", {})[stage] = resumed
     os.makedirs(args.out, exist_ok=True)
     t_all = time.time()
 
@@ -123,7 +136,7 @@ def main() -> int:
     with manifest.stage("generation"):
         generation.run_generation(config, model_loader=model_loader,
                                   words=words)
-    print(f"[1/5] generation cache -> {config.output.processed_dir}",
+    print(f"[1/6] generation cache -> {config.output.processed_dir}",
           flush=True)
 
     # 2. LL-Top-k evaluation (+ heatmaps for the reference's 3 words).
@@ -155,7 +168,7 @@ def main() -> int:
                 p = os.path.join(plots_dir, f)
                 shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
     manifest.add_artifact(ll_json)
-    print(f"[2/5] LL-Top-k -> {ll_json}", flush=True)
+    print(f"[2/6] LL-Top-k -> {ll_json}", flush=True)
 
     # 3. SAE baseline CSV.
     from taboo_brittleness_tpu.pipelines import sae_baseline
@@ -165,7 +178,7 @@ def main() -> int:
         res = sae_baseline.analyze_sae_baseline(config, sae, words=words)
         sae_baseline.save_metrics_csv(res, csv_path)
     manifest.add_artifact(csv_path)
-    print(f"[3/5] SAE baseline -> {csv_path}", flush=True)
+    print(f"[3/6] SAE baseline -> {csv_path}", flush=True)
 
     # 4. Full intervention studies (+ forcing) with background figures.
     # (save_plots back ON here: the study's brittleness curves ARE wanted;
@@ -178,6 +191,7 @@ def main() -> int:
     iv_config = dataclasses.replace(
         config, output=dataclasses.replace(config.output, save_plots=True))
     iv_dir = os.path.join(args.out, "interventions")
+    stamp_resumed("interventions", iv_dir)
     with manifest.stage("interventions"), \
             StudyPlotRenderer(iv_config, iv_dir) as renderer:
         interventions.run_intervention_studies(
@@ -187,19 +201,35 @@ def main() -> int:
         renderer.join()
     for w in words:
         manifest.add_artifact(os.path.join(iv_dir, f"{w}.json"))
-    print(f"[4/5] intervention studies -> {iv_dir}", flush=True)
+    print(f"[4/6] intervention studies -> {iv_dir}", flush=True)
 
     # 5. Standalone token-forcing sweep (one launch set: shared model).
     from taboo_brittleness_tpu.pipelines import token_forcing
 
     tf_json = os.path.join(args.out, "token_forcing", "results.json")
+    stamp_resumed("token-forcing", os.path.join(args.out, "token_forcing",
+                                                "words"))
     with manifest.stage("token-forcing"):
         token_forcing.run_token_forcing(
             config, model_loader=model_loader, words=words,
             output_path=tf_json,
             output_dir=os.path.join(args.out, "token_forcing", "words"))
     manifest.add_artifact(tf_json)
-    print(f"[5/5] token forcing -> {tf_json}", flush=True)
+    print(f"[5/6] token forcing -> {tf_json}", flush=True)
+
+    # 6. Naive/adversarial prompting attacks (one decode per mode under the
+    # shared model).
+    from taboo_brittleness_tpu.pipelines import prompting
+
+    pr_json = os.path.join(args.out, "prompting", "results.json")
+    stamp_resumed("prompting", os.path.join(args.out, "prompting", "words"))
+    with manifest.stage("prompting"):
+        prompting.run_prompting_attacks(
+            config, model_loader=model_loader, words=words,
+            output_path=pr_json,
+            output_dir=os.path.join(args.out, "prompting", "words"))
+    manifest.add_artifact(pr_json)
+    print(f"[6/6] prompting attacks -> {pr_json}", flush=True)
 
     manifest.extra["total_seconds"] = round(time.time() - t_all, 1)
     path = manifest.save(os.path.join(args.out, "run_manifest.json"))
