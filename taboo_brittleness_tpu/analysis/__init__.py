@@ -1,0 +1,25 @@
+"""tbx-check: a JAX/TPU-aware static-analysis gate for this repo.
+
+The pipeline lives or dies on TPU memory and trace discipline: one
+``[42, seq, 256000]`` f32 probability tensor is ~1.16 GB per prompt, a stray
+host sync inside a hot path serializes the device queue, and a
+``static_argnames`` typo silently retraces per call.  This package keeps
+those hazard classes out of the tree as it grows:
+
+- ``core``     — findings, ``# tbx: <rule>-ok`` suppression pragmas, and the
+                 per-module AST context (imports, jit roots, traced reach).
+- ``rules``    — the TBX001..TBX008 AST rules (see ``rules.RULES``).
+- ``deep``     — optional jaxpr-level pass: traces registered jit entry
+                 points with abstract shapes and flags f32 materialization
+                 on vocab-carrying operands (TBX101).
+- ``baseline`` — fingerprint engine so known findings can be ratcheted.
+- ``cli``      — ``python -m taboo_brittleness_tpu.analysis [--deep]
+                 [--baseline FILE] [paths...]``; exit 0 iff clean.
+
+Import surface is stdlib-only unless ``--deep`` is requested (the jaxpr pass
+imports jax lazily), so the gate costs milliseconds in CI.
+"""
+
+from taboo_brittleness_tpu.analysis.core import Finding, analyze_file  # noqa: F401
+from taboo_brittleness_tpu.analysis.cli import main, run_check  # noqa: F401
+from taboo_brittleness_tpu.analysis.rules import RULES  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m taboo_brittleness_tpu.analysis`` — the tbx-check gate."""
+
+import sys
+
+from taboo_brittleness_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Baseline (ratchet) engine for tbx-check findings.
+
+A baseline is a JSON file of finding *fingerprints*: line-number-free hashes
+of (path, rule, source snippet), so unrelated edits above a known finding do
+not churn the file.  Workflow:
+
+    python -m taboo_brittleness_tpu.analysis --write-baseline tools/tbx_baseline.json ...
+    python -m taboo_brittleness_tpu.analysis --baseline tools/tbx_baseline.json ...
+
+``--baseline`` filters known findings out of the gate; anything NEW still
+fails.  Deep-mode (jaxpr) findings baseline the same way — their "path" is
+the entry-point name and their snippet the conversion description, both
+stable across line edits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Iterable, List, Set, Tuple
+
+from taboo_brittleness_tpu.analysis.core import Finding
+
+
+def fingerprint(finding: Finding) -> str:
+    basis = f"{finding.path}::{finding.code}::{finding.snippet or finding.message}"
+    return hashlib.sha1(basis.encode("utf-8")).hexdigest()[:16]
+
+
+def save(findings: Iterable[Finding], path: str) -> int:
+    entries = {}
+    for f in findings:
+        fp = fingerprint(f)
+        # Keep one human-readable locator per fingerprint (the hash alone
+        # would make the committed file unreviewable).
+        entries.setdefault(fp, {
+            "rule": f.code, "path": f.path, "summary": f.message[:120]})
+    doc = {"version": 1, "findings": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def load(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "findings" not in doc:
+        raise ValueError(f"{path}: not a tbx-check baseline file")
+    return set(doc["findings"])
+
+
+def split(findings: List[Finding],
+          known: Set[str]) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) partition of ``findings`` against a baseline set."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if fingerprint(f) in known else new).append(f)
+    return new, old

@@ -1,0 +1,308 @@
+"""tbx-check core: findings, suppression pragmas, per-module AST context.
+
+Everything here is stdlib-only (``ast`` + ``re``): the static pass must cost
+milliseconds and run before jax is even importable (e.g. in a container that
+only has the checker).  The jaxpr-level pass lives in ``deep.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file line (or a deep-mode entry)."""
+
+    path: str        # repo-relative posix path, or "<deep:entry>" for jaxpr findings
+    line: int        # 1-based; 0 for deep-mode findings
+    col: int
+    code: str        # "TBX001"
+    alias: str       # "host-sync" — usable in pragmas interchangeably with code
+    message: str
+    snippet: str = ""  # stripped source line: the line-number-free fingerprint basis
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} [{self.alias}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Suppression pragmas.
+# ---------------------------------------------------------------------------
+
+# ``# tbx: f32-ok — reason`` / ``# tbx: TBX002-ok, TBX001-ok: reason``.
+# Tokens are <code-or-alias>-ok; anything after them is the (recommended)
+# one-line justification.  A trailing pragma suppresses its own line; a
+# pragma inside a comment block suppresses the first code line after the
+# block (so multi-line justifications work wherever the tbx line sits).
+_PRAGMA_LINE_RE = re.compile(r"#\s*tbx:\s*(?P<body>.+)$")
+_PRAGMA_TOKEN_RE = re.compile(r"([A-Za-z0-9]+(?:-[A-Za-z0-9]+)*)-ok\b")
+
+
+def parse_pragmas(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of suppressed rule tokens (codes or
+    aliases, lowercased; the literal token ``all`` suppresses every rule)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_LINE_RE.search(line)
+        if not m:
+            continue
+        tokens = {t.lower() for t in _PRAGMA_TOKEN_RE.findall(m.group("body"))}
+        if not tokens:
+            continue
+        out.setdefault(i, set()).update(tokens)
+        if line.strip().startswith("#"):
+            # Comment-only pragma: walk past the rest of the comment block so
+            # it covers the statement the block documents.
+            j = i
+            while j < len(lines) and lines[j].strip().startswith("#"):
+                j += 1
+            out.setdefault(j + 1, set()).update(tokens)
+    return out
+
+
+def is_suppressed(finding: Finding, pragmas: Dict[int, Set[str]]) -> bool:
+    tokens = pragmas.get(finding.line, ())
+    return ("all" in tokens or finding.code.lower() in tokens
+            or finding.alias.lower() in tokens)
+
+
+# ---------------------------------------------------------------------------
+# Import alias resolution + dotted names.
+# ---------------------------------------------------------------------------
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> fully dotted origin (``jnp`` -> ``jax.numpy``, ``P`` ->
+    ``jax.sharding.PartitionSpec``, ``partial`` -> ``functools.partial``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted origin of a Name/Attribute chain, alias-expanded; None for
+    anything that is not a plain chain (calls, subscripts, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+# ---------------------------------------------------------------------------
+# Jit bindings (how a function became a trace root).
+# ---------------------------------------------------------------------------
+
+JIT_WRAPPERS = {
+    "jax.jit", "jax.pjit", "jax.pmap",
+    "jax.experimental.pjit.pjit",
+}
+PARTIAL_NAMES = {"functools.partial"}
+
+
+@dataclasses.dataclass
+class JitBinding:
+    """One ``fn`` <- jit association: a decorator (``@jax.jit``,
+    ``@partial(jax.jit, ...)``) or a module-level ``g = jax.jit(fn, ...)``."""
+
+    fn: ast.FunctionDef
+    call: Optional[ast.Call]   # None for the bare @jax.jit decorator form
+    line: int
+    col: int
+
+    def keyword(self, name: str) -> Optional[ast.expr]:
+        if self.call is None:
+            return None
+        for kw in self.call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def has_keyword(self, *names: str) -> bool:
+        return any(self.keyword(n) is not None for n in names)
+
+
+class ModuleContext:
+    """Parsed module + everything the rules need: alias map, jit bindings,
+    and the set of functions reachable from a trace root (module-local call
+    graph by name; nested defs inherit their parent's reachability)."""
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = import_aliases(self.tree)
+        self.pragmas = parse_pragmas(self.lines)
+
+        self.functions: List[ast.FunctionDef] = []
+        self.parents: Dict[ast.AST, Optional[ast.FunctionDef]] = {}
+        self.module_funcs: Dict[str, ast.FunctionDef] = {}
+        self._index_functions()
+
+        self.jit_bindings: List[JitBinding] = []
+        self._collect_jit_bindings()
+        self.traced: Set[ast.FunctionDef] = self._traced_closure()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index_functions(self) -> None:
+        def visit(node: ast.AST, parent: Optional[ast.FunctionDef]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.functions.append(child)
+                    self.parents[child] = parent
+                    if parent is None:
+                        self.module_funcs[child.name] = child
+                    visit(child, child)
+                else:
+                    visit(child, parent)
+
+        visit(self.tree, None)
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        return dotted(node, self.aliases)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, node: ast.AST, code: str, alias: str, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(path=self.rel, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       code=code, alias=alias, message=message,
+                       snippet=self.line_text(line))
+
+    # -- jit bindings ------------------------------------------------------
+
+    def _jit_call(self, node: ast.expr) -> Optional[ast.Call]:
+        """The jit Call carrying the kwargs, if ``node`` is a jit wrapper
+        expression: ``jax.jit(...)`` or ``partial(jax.jit, ...)``."""
+        if not isinstance(node, ast.Call):
+            return None
+        fn = self.dotted(node.func)
+        if fn in JIT_WRAPPERS:
+            return node
+        if fn in PARTIAL_NAMES and node.args:
+            if self.dotted(node.args[0]) in JIT_WRAPPERS:
+                return node
+        return None
+
+    def _collect_jit_bindings(self) -> None:
+        for fn in self.functions:
+            for deco in fn.decorator_list:
+                if self.dotted(deco) in JIT_WRAPPERS:
+                    self.jit_bindings.append(JitBinding(
+                        fn=fn, call=None, line=deco.lineno,
+                        col=deco.col_offset + 1))
+                    continue
+                call = self._jit_call(deco)
+                if call is not None:
+                    self.jit_bindings.append(JitBinding(
+                        fn=fn, call=call, line=deco.lineno,
+                        col=deco.col_offset + 1))
+        # g = jax.jit(fn, ...) form (module level or inside functions).
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.dotted(node.func) in JIT_WRAPPERS and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    fn = self.module_funcs.get(target.id)
+                    if fn is not None:
+                        self.jit_bindings.append(JitBinding(
+                            fn=fn, call=node, line=node.lineno,
+                            col=node.col_offset + 1))
+
+    # -- traced reachability ----------------------------------------------
+
+    def _loaded_names(self, fn: ast.FunctionDef) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                names.add(node.id)
+        return names
+
+    def _traced_closure(self) -> Set[ast.FunctionDef]:
+        """Trace roots + the module-local by-name call-graph closure, plus
+        every function *defined inside* a traced function (its body runs
+        under the trace)."""
+        roots = {b.fn for b in self.jit_bindings}
+        traced: Set[ast.FunctionDef] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn in traced:
+                continue
+            traced.add(fn)
+            # Nested defs run under the same trace.
+            for other in self.functions:
+                if self.parents.get(other) is fn:
+                    frontier.append(other)
+            # Module-level functions referenced by name (called or passed to
+            # lax.scan / vmap / ...) are traced too.
+            for name in self._loaded_names(fn):
+                callee = self.module_funcs.get(name)
+                if callee is not None and callee not in traced:
+                    frontier.append(callee)
+        return traced
+
+    def enclosing_traced(self, node: ast.AST) -> Optional[ast.FunctionDef]:
+        """The innermost traced function whose source span contains ``node``
+        (AST nodes don't carry parent pointers; spans are cheap and exact
+        here because functions nest strictly)."""
+        line = getattr(node, "lineno", None)
+        if line is None:
+            return None
+        best: Optional[ast.FunctionDef] = None
+        for fn in self.traced:
+            end = getattr(fn, "end_lineno", None)
+            if end is None:
+                continue
+            if fn.lineno <= line <= end:
+                if best is None or fn.lineno >= best.lineno:
+                    best = fn
+        return best
+
+
+def analyze_file(path: str, rel: Optional[str] = None,
+                 rules: Optional[Iterable] = None,
+                 repo=None) -> Tuple[List[Finding], List[Finding]]:
+    """Run the AST rules over one file.  Returns (active, suppressed)."""
+    from taboo_brittleness_tpu.analysis.rules import RULES, RepoContext
+
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        ctx = ModuleContext(path, source, rel=rel)
+    except SyntaxError as e:
+        f_err = Finding(path=rel or path, line=e.lineno or 0, col=e.offset or 0,
+                        code="TBX000", alias="syntax",
+                        message=f"file does not parse: {e.msg}")
+        return [f_err], []
+    repo = repo if repo is not None else RepoContext.discover([path])
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in (rules if rules is not None else RULES):
+        for finding in rule.check(ctx, repo):
+            (suppressed if is_suppressed(finding, ctx.pragmas)
+             else active).append(finding)
+    active.sort(key=lambda f: (f.line, f.col, f.code))
+    suppressed.sort(key=lambda f: (f.line, f.col, f.code))
+    return active, suppressed

@@ -1,0 +1,198 @@
+"""Deep mode: jaxpr-level vocab-dtype audit of the public jit entry points.
+
+The AST rules see *source*; this pass sees what JAX will actually stage.  It
+traces a small registry of public entry points (ops/lens.py, ops/sae.py,
+runtime/decode.py) with ABSTRACT shapes — a tiny Gemma-2 config whose vocab
+size is a distinctive marker dim — and walks the resulting jaxprs (through
+pjit/scan/while/cond sub-jaxprs) for ``convert_element_type`` to f32 applied
+to a vocab-carrying operand.  That is exactly the [L, S, V] f32
+materialization hazard (~1.16 GB/prompt at the real 256k vocab) surfacing
+*after* tracing, where an AST rule cannot follow it.
+
+Complements ``tools/hlo_collectives.py``, which audits the compiled HLO's
+collectives but not its dtypes.  Nothing compiles here — ``jax.make_jaxpr``
+only traces, so deep mode stays a few seconds on CPU.
+
+Known-intentional conversions (the lens softmax must be f32; the tensor is
+transient inside one scan step) are kept out of the gate via the committed
+baseline (``tools/tbx_baseline.json``), not pragmas — jaxpr findings have no
+source line to pragma.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Set, Tuple
+
+from taboo_brittleness_tpu.analysis.core import Finding
+
+# Distinctive vocab size: prime, and far from every other tiny-config dim,
+# so "the marker appears in an operand shape" identifies vocab-carrying
+# tensors with no false hits.
+VOCAB_MARKER = 641
+
+
+def _tiny_cfg():
+    from taboo_brittleness_tpu.models import gemma2
+
+    # bf16 compute so widening conversions actually appear in the jaxpr (the
+    # f32-compute test config would make astype(float32) a no-op).
+    return gemma2.PRESETS["gemma2_tiny"].replace(
+        vocab_size=VOCAB_MARKER, dtype="bfloat16", param_dtype="bfloat16")
+
+
+def _abstract_params(cfg):
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+
+    return jax.eval_shape(
+        lambda key: gemma2.init_params(key, cfg), jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# Entry registry: name -> () -> (callable, abstract args).
+# Add new public jit entry points here as the repo grows.
+# ---------------------------------------------------------------------------
+
+def _entry_lens_aggregate():
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import lens
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, T = 2, 5
+    residual = jax.ShapeDtypeStruct((B, T, cfg.hidden_size), jnp.float32)
+    token_ids = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    mask = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+
+    def fn(p, r, ids, m):
+        return lens.aggregate_from_residual(p, cfg, r, ids, m, top_k=3)
+
+    return fn, (params, residual, token_ids, mask)
+
+
+def _entry_sae_correlation_stream():
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+
+    D, S, N = 16, 37, 8
+    sae = sae_ops.SAEParams(
+        w_enc=jax.ShapeDtypeStruct((D, S), jnp.float32),
+        b_enc=jax.ShapeDtypeStruct((S,), jnp.float32),
+        w_dec=jax.ShapeDtypeStruct((S, D), jnp.float32),
+        b_dec=jax.ShapeDtypeStruct((D,), jnp.float32),
+        threshold=jax.ShapeDtypeStruct((S,), jnp.float32),
+    )
+    x = jax.ShapeDtypeStruct((N, D), jnp.bfloat16)
+    y = jax.ShapeDtypeStruct((N,), jnp.float32)
+    w = jax.ShapeDtypeStruct((N,), jnp.float32)
+
+    def fn(s, xx, yy, ww):
+        return sae_ops.latent_secret_correlation_stream(s, xx, yy, ww, chunk=4)
+
+    return fn, (sae, x, y, w)
+
+
+def _entry_greedy_decode():
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import decode
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, T = 2, 5
+    ids = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    valid = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+    pos = jax.ShapeDtypeStruct((B, T), jnp.int32)
+
+    def fn(p, i, v, q):
+        return decode.greedy_decode(p, cfg, i, v, q, max_new_tokens=3)
+
+    return fn, (params, ids, valid, pos)
+
+
+ENTRY_POINTS: List[Tuple[str, Callable]] = [
+    ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
+    ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
+    ("runtime.decode.greedy_decode", _entry_greedy_decode),
+]
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr walk.
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(params) -> Iterable:
+    """Every Jaxpr/ClosedJaxpr reachable through an eqn's params (pjit's
+    ``jaxpr``, scan/while bodies, cond ``branches`` tuples, ...)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    for value in params.values():
+        stack = [value]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, (ClosedJaxpr, Jaxpr)):
+                yield v
+            elif isinstance(v, (tuple, list)):
+                stack.extend(v)
+
+
+def _vocab_f32_conversions(jaxpr, seen: Set[tuple]) -> Iterable[tuple]:
+    """(shape, src_dtype) for each widening convert_element_type -> f32 whose
+    operand shape carries the vocab marker, deduped across the whole trace."""
+    import numpy as np
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in inner.eqns:
+        if eqn.primitive.name == "convert_element_type":
+            new_dtype = eqn.params.get("new_dtype")
+            aval = eqn.invars[0].aval
+            shape = tuple(getattr(aval, "shape", ()))
+            src = getattr(aval, "dtype", None)
+            if (new_dtype == np.float32 and src is not None
+                    and np.dtype(src) != np.float32
+                    and np.dtype(src).itemsize < 4
+                    and VOCAB_MARKER in shape):
+                key = (shape, str(src))
+                if key not in seen:
+                    seen.add(key)
+                    yield key
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _vocab_f32_conversions(sub, seen)
+
+
+def run_deep(entries: Iterable[Tuple[str, Callable]] = None) -> List[Finding]:
+    """Trace each registered entry point and return TBX101 findings for
+    vocab-dim f32 materializations (TBX100 if an entry fails to trace —
+    a broken registry must fail the gate, not skip silently)."""
+    import jax
+
+    findings: List[Finding] = []
+    for name, build in (entries if entries is not None else ENTRY_POINTS):
+        try:
+            fn, args = build()
+            jaxpr = jax.make_jaxpr(fn)(*args)
+        except Exception as e:  # registry drift is a finding, not a crash
+            findings.append(Finding(
+                path=f"<deep:{name}>", line=0, col=0,
+                code="TBX100", alias="deep-entry",
+                message=f"entry point failed to trace: {type(e).__name__}: {e}",
+                snippet=f"trace-failure {type(e).__name__}"))
+            continue
+        seen: Set[tuple] = set()
+        for shape, src in _vocab_f32_conversions(jaxpr, seen):
+            findings.append(Finding(
+                path=f"<deep:{name}>", line=0, col=0,
+                code="TBX101", alias="deep-f32",
+                message=(f"jaxpr materializes {src}->float32 on a "
+                         f"vocab-carrying operand {shape} (vocab marker dim "
+                         f"{VOCAB_MARKER}); at the real 256k vocab this is "
+                         "the GB-scale f32 tensor — keep it transient or "
+                         "baseline it as reviewed"),
+                snippet=f"{src}->f32 {shape}"))
+    return findings

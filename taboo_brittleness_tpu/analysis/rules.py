@@ -1,0 +1,526 @@
+"""The TBX001..TBX008 AST rules.
+
+Each rule is a small class with ``code`` / ``alias`` / ``summary`` and a
+``check(ctx, repo)`` generator over :class:`~.core.Finding`.  Rules are
+deliberately narrow: the gate must hold the whole repo at zero unsuppressed
+findings (tests/test_analysis.py meta-test), so precision beats recall —
+every widening of a rule is paid for in pragmas.
+
+Suppress any finding with ``# tbx: <code-or-alias>-ok — <reason>`` on the
+violating line or the line directly above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+from taboo_brittleness_tpu.analysis.core import Finding, ModuleContext
+
+
+# ---------------------------------------------------------------------------
+# Repo-level context shared by all modules (declared mesh axes).
+# ---------------------------------------------------------------------------
+
+_DEFAULT_AXES = frozenset({"dp", "tp", "sp"})
+
+
+def _axes_from_mesh_module(path: str) -> Optional[frozenset]:
+    """Union of axis-name tuples passed to ``Mesh(...)`` in parallel/mesh.py
+    (``Mesh(arr, ("dp", "tp", "sp"))``) — the single source of truth for
+    which logical axes exist."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+        if name != "Mesh":
+            continue
+        names_arg = node.args[1]
+        if isinstance(names_arg, (ast.Tuple, ast.List)):
+            for elt in names_arg.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    axes.add(elt.value)
+    return frozenset(axes) or None
+
+
+@dataclasses.dataclass(frozen=True)
+class RepoContext:
+    """Cross-module facts the rules need (currently: the mesh axis names)."""
+
+    mesh_axes: frozenset = _DEFAULT_AXES
+
+    @classmethod
+    def discover(cls, paths: Sequence[str] = ()) -> "RepoContext":
+        """Axis names from this repo's ``parallel/mesh.py`` (located relative
+        to the analysis package, so the gate works from any cwd)."""
+        mesh_py = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "parallel", "mesh.py")
+        axes = _axes_from_mesh_module(mesh_py)
+        return cls(mesh_axes=axes or _DEFAULT_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers.
+# ---------------------------------------------------------------------------
+
+def _top_level_traced(ctx: ModuleContext) -> List[ast.FunctionDef]:
+    """Traced functions whose parent is NOT traced — walking each exactly
+    once covers every traced line without double-reporting nested defs."""
+    return [fn for fn in ctx.traced if ctx.parents.get(fn) not in ctx.traced]
+
+
+def _fn_param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in getattr(a, "posonlyargs", [])]
+            + [p.arg for p in a.args] + [p.arg for p in a.kwonlyargs])
+
+
+def _string_constants(node: ast.expr) -> Iterator[ast.Constant]:
+    """String literals in an expression, descending through tuples/lists."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _string_constants(elt)
+
+
+# ---------------------------------------------------------------------------
+# TBX001 — host sync inside traced code.
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+    "numpy.copy": "np.copy",
+}
+
+
+class HostSyncRule:
+    """``device_get`` / ``.item()`` / ``np.asarray`` on values inside a
+    function reachable from a jit/pjit trace root: under trace these either
+    fail on tracers or, worse, silently constant-fold a device round-trip
+    into every dispatch (the remote-runtime round-trip is ~0.1 s EACH)."""
+
+    code = "TBX001"
+    alias = "host-sync"
+    summary = "host sync (device_get/.item()/np.asarray) in traced code"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        for fn in _top_level_traced(ctx):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = ctx.dotted(node.func)
+                if name in _HOST_SYNC_CALLS:
+                    yield ctx.finding(
+                        node, self.code, self.alias,
+                        f"{_HOST_SYNC_CALLS[name]} inside traced function "
+                        f"`{fn.name}` — forces a device->host sync (or fails "
+                        "on tracers); keep the graph host-free and pull "
+                        "results once, batched, outside the jit")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    yield ctx.finding(
+                        node, self.code, self.alias,
+                        f".item() inside traced function `{fn.name}` — a "
+                        "per-element device->host sync; use jnp reductions "
+                        "and pull once outside the jit")
+
+
+# ---------------------------------------------------------------------------
+# TBX002 — vocab-scale f32 materialization.
+# ---------------------------------------------------------------------------
+
+_F32_NAMES = {"jax.numpy.float32", "numpy.float32"}
+_RNG_DRAWS = {"random", "normal", "integers", "uniform", "standard_normal",
+              "rand", "randn", "choice"}
+_VOCAB_NAME_RE = re.compile(r"(^|_)(all_)?(logits?|probs?|vocab)(_|$)", re.I)
+# A shape comment carrying a vocab dim: "[B, T, V]", "[L,S,V]", "[b, T, V/tp]".
+_VOCAB_LINE_RE = re.compile(r"\[[^\]\n]{0,60}\bV\b[^\]\n]{0,20}\]|256[_,]?000|\bvocab\b",
+                            re.I)
+
+
+def _is_f32_arg(ctx: ModuleContext, node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float32":
+        return True
+    return ctx.dotted(node) in _F32_NAMES
+
+
+class VocabF32Rule:
+    """``.astype(float32)`` applied to a vocab-carrying array (name or shape
+    comment says logits/probs/vocab or ``[.., V]``): one [L, S, V] f32 tensor
+    is ~1.16 GB/prompt at Gemma-2 scale (PAPER.md).  Conversions that are
+    numerically required (softmax in f32) stay — with an explicit
+    ``# tbx: f32-ok — <reason>`` pragma so every one is a reviewed decision."""
+
+    code = "TBX002"
+    alias = "f32"
+    summary = "f32 materialization of a vocab-scale array"
+
+    def _assign_targets(self, ctx: ModuleContext) -> Dict[int, List[str]]:
+        """id(value-expression) -> assigned names, to catch
+        ``logits = (x @ e.T).astype(jnp.float32)``."""
+        out: Dict[int, List[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+                if names:
+                    out[id(node.value)] = names
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    out[id(node.value)] = [node.target.id]
+        return out
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        targets = self._assign_targets(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and len(node.args) == 1
+                    and _is_f32_arg(ctx, node.args[0])):
+                continue
+            # ``rng.random((T, V)).astype(np.float32)`` is host-side fixture
+            # construction, not a device materialization — skip astype
+            # applied directly to a fresh RNG draw.
+            recv = node.func.value
+            if (isinstance(recv, ast.Call)
+                    and isinstance(recv.func, ast.Attribute)
+                    and recv.func.attr in _RNG_DRAWS):
+                continue
+            receiver_names = {
+                n.id for n in ast.walk(node.func.value)
+                if isinstance(n, ast.Name)}
+            vocab_names = [n for n in receiver_names if _VOCAB_NAME_RE.search(n)]
+            vocab_names += [n for n in targets.get(id(node), [])
+                            if _VOCAB_NAME_RE.search(n)]
+            hint = None
+            if vocab_names:
+                hint = f"`{sorted(set(vocab_names))[0]}`"
+            elif _VOCAB_LINE_RE.search(ctx.line_text(node.lineno)) and (
+                    id(node) in targets or not receiver_names):
+                hint = "shape comment"
+            if hint is None:
+                continue
+            yield ctx.finding(
+                node, self.code, self.alias,
+                f"astype(float32) on a vocab-carrying array ({hint}): at "
+                "[L,S,V] scale this is ~1.16 GB/prompt of f32 in HBM; keep "
+                "bf16 or justify with `# tbx: f32-ok — <reason>`")
+
+
+# ---------------------------------------------------------------------------
+# TBX003 — missing buffer donation on cache-carrying jits.
+# ---------------------------------------------------------------------------
+
+_CACHE_ARG_RE = re.compile(r"(^|_)(kv|cache|caches)(_|$)", re.I)
+
+
+class MissingDonationRule:
+    """A jit whose signature takes a KV-cache-named buffer and donates
+    nothing holds BOTH the argument and the program's working copy live
+    across the call — at sweep shapes that is an extra ~1.1 GB of HBM for
+    the whole launch (donate_argnums/donate_argnames lets XLA alias it)."""
+
+    code = "TBX003"
+    alias = "donate"
+    summary = "jit takes a KV-cache-named arg but donates no buffers"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        seen = set()
+        for b in ctx.jit_bindings:
+            key = (b.line, b.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            # Static args are hashed python values, not buffers — a
+            # cache-NAMED static flag (return_prefill_cache) is not a cache.
+            statics: Set[str] = set()
+            static_kw = b.keyword("static_argnames")
+            if static_kw is not None:
+                statics = {c.value for c in _string_constants(static_kw)}
+            cache_args = [n for n in _fn_param_names(b.fn)
+                          if _CACHE_ARG_RE.search(n) and n not in statics]
+            if not cache_args:
+                continue
+            if b.has_keyword("donate_argnums", "donate_argnames"):
+                continue
+            anchor = b.call if b.call is not None else b.fn
+            yield Finding(
+                path=ctx.rel, line=b.line, col=b.col,
+                code=self.code, alias=self.alias,
+                message=(f"jit of `{b.fn.name}` takes cache-like arg(s) "
+                         f"{cache_args} but sets no donate_argnums/"
+                         "donate_argnames — the caller's buffer and the "
+                         "program's copy coexist in HBM; donate it (or "
+                         "pragma with the reason it must stay live)"),
+                snippet=ctx.line_text(getattr(anchor, "lineno", b.line)))
+
+
+# ---------------------------------------------------------------------------
+# TBX004 — static_argnames drift.
+# ---------------------------------------------------------------------------
+
+class StaticArgnamesRule:
+    """Every name in ``static_argnames`` must exist in the wrapped function's
+    signature.  JAX only validates this lazily at call time (and string-typed
+    names survive refactors silently) — a renamed parameter turns the static
+    into a traced arg and the jit retraces per call."""
+
+    code = "TBX004"
+    alias = "static-args"
+    summary = "static_argnames lists a name absent from the wrapped signature"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        seen = set()
+        for b in ctx.jit_bindings:
+            value = b.keyword("static_argnames")
+            if value is None:
+                continue
+            key = (b.line, b.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            params = set(_fn_param_names(b.fn))
+            for const in _string_constants(value):
+                if const.value not in params:
+                    yield ctx.finding(
+                        const, self.code, self.alias,
+                        f"static_argnames entry '{const.value}' is not a "
+                        f"parameter of `{b.fn.name}` (has: "
+                        f"{sorted(params)}) — the stale name silently stops "
+                        "marking anything static")
+
+
+# ---------------------------------------------------------------------------
+# TBX005 — mesh-axis consistency.
+# ---------------------------------------------------------------------------
+
+_PSPEC_SUFFIX = ".PartitionSpec"
+_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmax", "jax.lax.pmin", "jax.lax.pmean",
+    "jax.lax.all_gather", "jax.lax.ppermute", "jax.lax.pswapaxes",
+    "jax.lax.axis_index", "jax.lax.all_to_all", "jax.lax.psum_scatter",
+}
+
+
+class MeshAxisRule:
+    """Axis strings in ``PartitionSpec``/``P(...)``, ``axis_name=`` kwargs,
+    and lax collectives must be axes declared by ``parallel/mesh.py``
+    (``Mesh(..., ("dp", "tp", "sp"))``) — a typo'd axis fails only at run
+    time on a real mesh, long after CI."""
+
+    code = "TBX005"
+    alias = "mesh-axis"
+    summary = "PartitionSpec/collective axis not declared in parallel/mesh.py"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        axes = repo.mesh_axes
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.dotted(node.func) or ""
+            check_args = (name.endswith(_PSPEC_SUFFIX)
+                          or name in _COLLECTIVES)
+            if check_args:
+                for arg in node.args:
+                    for const in _string_constants(arg):
+                        if const.value not in axes:
+                            yield self._finding(ctx, const, axes)
+            for kw in node.keywords:
+                if kw.arg == "axis_name" and kw.value is not None:
+                    for const in _string_constants(kw.value):
+                        if const.value not in axes:
+                            yield self._finding(ctx, const, axes)
+
+    def _finding(self, ctx: ModuleContext, const: ast.Constant,
+                 axes: frozenset) -> Finding:
+        return ctx.finding(
+            const, self.code, self.alias,
+            f"mesh axis '{const.value}' is not declared in parallel/mesh.py "
+            f"(declared: {sorted(axes)}) — this fails only at run time on a "
+            "real mesh")
+
+
+# ---------------------------------------------------------------------------
+# TBX006 — nondeterminism inside traced code.
+# ---------------------------------------------------------------------------
+
+_CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
+                "time.perf_counter", "time.process_time"}
+
+
+class NondeterminismRule:
+    """``time.*`` clocks, Python ``random``, or unseeded ``np.random`` inside
+    traced code: the value is frozen at TRACE time and baked into the
+    compiled program as a constant — every later dispatch silently replays
+    the first call's draw.  Thread randomness through ``jax.random`` keys."""
+
+    code = "TBX006"
+    alias = "nondet"
+    summary = "host clock / RNG call inside traced code"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        for fn in _top_level_traced(ctx):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = ctx.dotted(node.func) or ""
+                if name in _CLOCK_CALLS:
+                    what = f"{name}()"
+                elif name.startswith("random."):
+                    what = f"{name}() (Python random)"
+                elif name.startswith("numpy.random."):
+                    what = f"np.{name[6:]}() (host-side numpy RNG)"
+                else:
+                    continue
+                yield ctx.finding(
+                    node, self.code, self.alias,
+                    f"{what} inside traced function `{fn.name}` — the value "
+                    "is baked in at trace time and replayed by every "
+                    "dispatch; use jax.random with an explicit key (or "
+                    "compute it outside the jit and pass it in)")
+
+
+# ---------------------------------------------------------------------------
+# TBX007 — wall clock where a monotonic clock belongs.
+# ---------------------------------------------------------------------------
+
+_TIMING_NAME_RE = re.compile(
+    r"^(t\d*|t_\w+|start\w*|started\w*|begin\w*|\w*_t0)$")
+
+
+class WallClockRule:
+    """``time.time()`` used for duration math (subtraction, a ``t0 = ...``
+    start mark, or passed as a timestamp factory): wall-clock jumps under
+    NTP steps/leap smears, so recorded durations can come out negative or
+    wildly long.  Use ``time.monotonic()``/``perf_counter()`` for durations;
+    pragma the genuine epoch-timestamp uses."""
+
+    code = "TBX007"
+    alias = "wallclock"
+    summary = "time.time() used where a monotonic clock belongs"
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        call_funcs = {id(n.func) for n in ast.walk(ctx.tree)
+                      if isinstance(n, ast.Call)}
+
+        def is_time_call(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and ctx.dotted(node.func) == "time.time")
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if is_time_call(node.left) or is_time_call(node.right):
+                    yield ctx.finding(
+                        node, self.code, self.alias,
+                        "duration computed by subtracting time.time() — "
+                        "wall clock is not monotonic; use time.monotonic() "
+                        "or time.perf_counter()")
+            elif (isinstance(node, ast.Attribute)
+                    and ctx.dotted(node) == "time.time"
+                    and id(node) not in call_funcs):
+                yield ctx.finding(
+                    node, self.code, self.alias,
+                    "bare time.time passed as a callback/factory — if the "
+                    "value feeds duration math use time.monotonic; pragma "
+                    "if an epoch timestamp is genuinely intended")
+            elif isinstance(node, ast.Assign) and is_time_call(node.value):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and _TIMING_NAME_RE.match(tgt.id)):
+                        yield ctx.finding(
+                            node, self.code, self.alias,
+                            f"`{tgt.id} = time.time()` start mark — use "
+                            "time.monotonic()/perf_counter() so the "
+                            "duration survives clock adjustments")
+                        break
+
+
+# ---------------------------------------------------------------------------
+# TBX008 — mutable defaults / closure-captured device constants.
+# ---------------------------------------------------------------------------
+
+class CapturedConstantRule:
+    """Traced functions must not carry mutable defaults (shared across every
+    call AND trace) or reference module-level ``jnp`` array constants: a
+    captured device array is re-embedded as a literal into every trace,
+    bloating executables and pinning stale buffers.  Pass arrays as
+    arguments instead."""
+
+    code = "TBX008"
+    alias = "capture"
+    summary = "mutable default / captured jnp constant in traced function"
+
+    def _module_device_consts(self, ctx: ModuleContext) -> Set[str]:
+        consts: Set[str] = set()
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            name = ctx.dotted(node.value.func) or ""
+            if name.startswith("jax.numpy."):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts.add(tgt.id)
+        return consts
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        device_consts = self._module_device_consts(ctx)
+        for fn in ctx.traced:
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield ctx.finding(
+                        d, self.code, self.alias,
+                        f"mutable default in traced function `{fn.name}` — "
+                        "shared across every call and trace; default to "
+                        "None and build inside")
+                elif isinstance(d, ast.Call):
+                    name = ctx.dotted(d.func) or ""
+                    if name.startswith(("jax.numpy.", "numpy.")):
+                        yield ctx.finding(
+                            d, self.code, self.alias,
+                            f"array-valued default in traced function "
+                            f"`{fn.name}` — built once at def time and "
+                            "closure-captured into every trace; pass it as "
+                            "an argument")
+        if not device_consts:
+            return
+        for fn in _top_level_traced(ctx):
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in device_consts):
+                    yield ctx.finding(
+                        node, self.code, self.alias,
+                        f"module-level jnp constant `{node.id}` captured by "
+                        f"traced function `{fn.name}` — re-embedded into "
+                        "every trace; pass it as an argument")
+
+
+RULES = [
+    HostSyncRule(),
+    VocabF32Rule(),
+    MissingDonationRule(),
+    StaticArgnamesRule(),
+    MeshAxisRule(),
+    NondeterminismRule(),
+    WallClockRule(),
+    CapturedConstantRule(),
+]
+
+RULES_BY_CODE = {r.code: r for r in RULES}

@@ -310,6 +310,8 @@ def unembed(params: Params, cfg: Gemma2Config, h: jax.Array) -> jax.Array:
     (the lens readout of reference src/models.py:135-138, minus the softmax)."""
     x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     logits = x @ params["embed"].astype(cfg.compute_dtype).T
+    # tbx: f32-ok — final logits are f32 by model spec (softcap tanh in bf16
+    # quantizes decode argmax); callers unembed one column or reduce in-graph.
     return softcap(logits.astype(jnp.float32), cfg.final_logit_softcap)
 
 

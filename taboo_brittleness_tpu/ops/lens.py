@@ -73,6 +73,9 @@ def lens_probs(
     capped variant (matches the model's actual final-logit path, ``unembed``)."""
     x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     logits = x @ params["embed"].astype(cfg.compute_dtype).T
+    # tbx: f32-ok — lens softmax must run in f32 (bf16 renormalization skews
+    # the tiny target probs); the [B, T, V] tensor lives only inside one scan
+    # step and XLA fuses the reduction into the unembed epilogue.
     logits = logits.astype(jnp.float32)
     if logit_softcap is not None:
         logits = softcap(logits, logit_softcap)
@@ -197,6 +200,7 @@ def make_tp_lens_tap(
 
         def local_stats(x_l, e_l, tgt_l):
             # x_l [b, T, D]; e_l [V/tp, D]; tgt_l [b] global ids.
+            # tbx: f32-ok — shard-local [b, T, V/tp] softmax numerics in f32.
             logits = (x_l @ e_l.T).astype(jnp.float32)        # [b, T, V/tp]
             if logit_softcap is not None:
                 logits = softcap(logits, logit_softcap)
@@ -511,6 +515,7 @@ def aggregate_from_residual_tp(
     def local(h_l, ids_l, mask_l, e_l):
         # h_l [b, T, D] f32 residuals; ids_l/mask_l [b, T]; e_l [V/tp, D].
         x = rms_norm(h_l, params["final_norm"], eps)
+        # tbx: f32-ok — shard-local [b, T, V/tp] softmax numerics in f32.
         logits = (x @ e_l.T).astype(jnp.float32)               # [b, T, Vl]
         if logit_softcap is not None:
             logits = softcap(logits, logit_softcap)

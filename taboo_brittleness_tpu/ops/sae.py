@@ -96,6 +96,8 @@ def encode(sae: SAEParams, x: jax.Array) -> jax.Array:
     Matches ``sae_lens`` JumpReLU inference (reference uses it at
     src/02_run_sae_baseline.py:67).  x: [..., D] -> acts [..., S], f32.
     """
+    # tbx: f32-ok — [.., D] residual (no vocab dim); Gemma-Scope thresholds
+    # are f32 and the JumpReLU gate comparison must match their precision.
     pre = x.astype(jnp.float32) @ sae.w_enc + sae.b_enc
     return jnp.where(pre > sae.threshold, pre, 0.0)
 
@@ -117,7 +119,7 @@ def mean_response_acts(
     """Mean SAE activation over response tokens — the reference's pooled feature
     vector (mean over tokens, src/02_run_sae_baseline.py:70).  -> [S]."""
     acts = encode(sae, resid)                               # [T, S]
-    w = response_mask.astype(jnp.float32)
+    w = response_mask.astype(jnp.float32)  # tbx: f32-ok — [T] mask weights
     denom = jnp.maximum(jnp.sum(w), 1.0)
     return jnp.sum(acts * w[:, None], axis=0) / denom
 
@@ -165,6 +167,8 @@ def ablate_latents(
         hit = hit.reshape(B, *([1] * (x.ndim - 2)), S)        # align with acts
     ablated = jnp.where(hit, 0.0, acts)
     delta = decode(sae, ablated) - decode(sae, acts)          # [..., D]
+    # tbx: f32-ok — [.., D] patch applied in f32 then cast straight back to
+    # the residual dtype; keeps the m=0 edit exactly identity.
     return (x.astype(jnp.float32) + delta).astype(x.dtype)
 
 
@@ -189,6 +193,8 @@ def latent_secret_alignment(sae: SAEParams, params_embed: jax.Array,
     ``-acts[s] * (W_dec[s] · u_secret)`` up to the final norm) for callers with
     no calibration responses in hand.
     """
+    # tbx: f32-ok — one [D] unembed row + [S, D] decoder; cosine norms need
+    # f32 accumulation and neither carries the vocab dim.
     u = params_embed[secret_id].astype(jnp.float32)          # [D]
     w = sae.w_dec.astype(jnp.float32)                        # [S, D]
     num = w @ u
@@ -206,10 +212,12 @@ def latent_secret_correlation(
     secret logit over calibration positions — the Execution Plan's scoring
     estimator ("correlation with the secret logit over calibration data").
     -> [S], in [-1, 1]; latents that never fire get 0 (zero variance)."""
+    # Correlation moments in f32 over [N] / [N, S] operands — the secret
+    # "logit" is one scalar per position, not a vocab row.
     w = weights.astype(jnp.float32)
     wsum = jnp.maximum(jnp.sum(w), 1.0)
     a = acts.astype(jnp.float32)
-    y = secret_logit.astype(jnp.float32)
+    y = secret_logit.astype(jnp.float32)  # tbx: f32-ok — [N] scalar-per-position
     mean_a = (w @ a) / wsum                                  # [S]
     mean_y = jnp.sum(w * y) / wsum
     da = a - mean_a                                          # [N, S]
@@ -245,6 +253,7 @@ def latent_secret_correlation_stream(
         weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
     S = sae.w_enc.shape[1]
     xs = x.reshape(-1, chunk, D)
+    # tbx: f32-ok — [N] scalar-per-position logit/weight streams, not vocab.
     ys = secret_logit.astype(jnp.float32).reshape(-1, chunk)
     ws = weights.astype(jnp.float32).reshape(-1, chunk)
 

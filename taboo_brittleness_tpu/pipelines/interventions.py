@@ -264,6 +264,10 @@ def _teacher_forced_nll_cached(
     return _nll_from_hidden(params, cfg, h_s, seqs, next_mask, s)
 
 
+# The prefill cache CANNOT be donated here: the ΔNLL parity tests score the
+# same cache twice (edited + baseline), and the pipeline frees it explicitly
+# right after dispatch (dec._replace(prefill_cache=None)).
+# tbx: donate-ok — cache buffers are deliberately reused by callers (see above)
 _nll_cached_jit = jax.jit(_teacher_forced_nll_cached,
                           static_argnames=("cfg", "edit_fn", "resp_start"))
 

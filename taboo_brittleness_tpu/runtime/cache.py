@@ -57,6 +57,8 @@ def save_pair(
     os.makedirs(os.path.dirname(npz_path) or ".", exist_ok=True)
     all_probs = np.asarray(all_probs)
     if all_probs.dtype != np.float32:
+        # tbx: f32-ok — parity-dump mode: the reference cache schema is f32
+        # by definition (byte-level npz compatibility); host-side only.
         all_probs = all_probs.astype(np.float32, copy=False)
 
     arrays: Dict[str, np.ndarray] = {"all_probs": all_probs}
@@ -97,6 +99,8 @@ class CachedPair:
 def load_pair(npz_path: str, json_path: str, *, layer_idx: Optional[int] = None) -> CachedPair:
     """Load one pair; accepts both our caches and the reference's committed ones."""
     with np.load(npz_path) as cache:
+        # tbx: f32-ok — reference caches are f32 on disk; copy=False keeps
+        # the load zero-copy for conforming files.
         all_probs = cache["all_probs"].astype(np.float32, copy=False)
         resid = None
         found_layer = None

@@ -57,6 +57,10 @@ class DecodeResult(NamedTuple):
     # this cache instead of re-running the prompt columns (~40% of that
     # phase's forward FLOPs at sweep shapes; interventions._nll_cached_jit).
     prefill_cache: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+    # With return_cache: the full end-of-decode KVCache.  Thread it back into
+    # the next same-shape launch as ``cache_seed`` (donated) and the ~GB KV
+    # block recycles in place instead of alloc+free per launch.
+    cache: Optional[KVCache] = None
 
 
 def pad_prompts(
@@ -96,7 +100,8 @@ def pad_prompts(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "edit_fn", "decode_edit",
                      "stop_ids", "capture_residual_layer",
-                     "return_prefill_cache"),
+                     "return_prefill_cache", "return_cache"),
+    donate_argnames=("cache_seed",),
 )
 def greedy_decode(
     params: Params,
@@ -112,6 +117,8 @@ def greedy_decode(
     stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
     capture_residual_layer: Optional[int] = None,
     return_prefill_cache: bool = False,
+    cache_seed: Optional[KVCache] = None,
+    return_cache: bool = False,
 ) -> DecodeResult:
     """One compiled program: prefill + max_new_tokens greedy steps.
 
@@ -133,9 +140,27 @@ def greedy_decode(
     re-running a full teacher-forced pass over the finished sequence, which
     halves the intervention sweep's per-arm cost (the re-run was a 42-layer
     forward; the sweep consumes only this one layer).
+
+    ``cache_seed`` recycles a previous same-shape launch's KV block (get one
+    with ``return_cache=True``): the argument is DONATED, so XLA reuses the
+    ~GB buffer in place instead of alloc+free per launch — don't touch the
+    seed result's ``cache`` after passing it back in.  Only occupancy is
+    reset; stale K/V rows stay masked by ``valid=False``.
     """
     B, T = prompt_ids.shape
-    cache = KVCache.zeros(cfg, B, max_len=T + max_new_tokens)
+    if cache_seed is None:
+        cache = KVCache.zeros(cfg, B, max_len=T + max_new_tokens)
+    else:
+        want = (cfg.num_layers, B, T + max_new_tokens,
+                cfg.num_kv_heads, cfg.head_dim)
+        if tuple(cache_seed.k.shape) != want:
+            raise ValueError(
+                f"cache_seed shape {tuple(cache_seed.k.shape)} does not match "
+                f"this launch ({want}); seeds only recycle across same-shape "
+                "launches")
+        cache = cache_seed._replace(
+            valid=jnp.zeros_like(cache_seed.valid),
+            length=jnp.zeros((), jnp.int32))
     capture = capture_residual_layer is not None
 
     def _carry_tap(chunk: int):
@@ -238,7 +263,7 @@ def greedy_decode(
                 toks, emit, resid)
 
     done0 = jnp.zeros((B,), bool)
-    (_, _, _, _, _, tokens, emitted, gen_resid) = lax.while_loop(
+    (final_cache, _, _, _, _, tokens, emitted, gen_resid) = lax.while_loop(
         cond_fn, body_fn,
         (prefill.cache, first_tok, done0, prompt_len, jnp.asarray(0),
          toks0, emit0, resid0),
@@ -257,6 +282,7 @@ def greedy_decode(
         tokens=tokens, lengths=lengths,
         sequences=sequences, sequence_valid=sequence_valid,
         residual=residual, prefill_cache=prefill_kv,
+        cache=final_cache if return_cache else None,
     )
 
 

@@ -56,7 +56,13 @@ class RunManifest:
     command: str
     config: Optional[Dict[str, Any]] = None
     run_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:12])
+    # Epoch timestamp: serialized metadata for humans/tooling, NOT duration
+    # math — wall_seconds below accounts against the monotonic mark.
+    # tbx: wallclock-ok — genuine epoch timestamp (duration uses _mono_start)
     started_at: float = dataclasses.field(default_factory=time.time)
+    # Monotonic twin of started_at: durations must survive NTP steps / clock
+    # adjustments mid-run (a stepped clock once made wall_seconds negative).
+    _mono_start: float = dataclasses.field(default_factory=time.monotonic)
     environment: Dict[str, Any] = dataclasses.field(default_factory=environment_info)
     stages: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     artifacts: List[str] = dataclasses.field(default_factory=list)
@@ -85,7 +91,7 @@ class RunManifest:
             "run_id": self.run_id,
             "command": self.command,
             "started_at": self.started_at,
-            "wall_seconds": round(time.time() - self.started_at, 3),
+            "wall_seconds": round(time.monotonic() - self._mono_start, 3),
             "environment": self.environment,
             "config": self.config,
             "stages": self.stages,

@@ -1,0 +1,20 @@
+"""Seeded TBX001 violations: host syncs reachable from a jit trace root.
+
+This file is the checker's corpus (tests/test_analysis.py asserts the exact
+codes and line numbers) — it is excluded from the repo gate by default and
+never imported.
+"""
+
+import jax
+import numpy as np
+
+
+def _pull_helper(x):
+    return np.asarray(x).sum()          # TBX001: np.asarray in traced reach
+
+
+@jax.jit
+def traced(x):
+    y = jax.device_get(x)               # TBX001: device_get under trace
+    z = x.sum().item()                  # TBX001: .item() under trace
+    return _pull_helper(x) + y + z

@@ -1,0 +1,14 @@
+"""Seeded TBX002 violation: f32 materialization of a vocab-scale array."""
+
+import jax.numpy as jnp
+
+
+def readout(h, embed):
+    logits = h @ embed.T                       # [B, T, V] bf16
+    probs = logits.astype(jnp.float32)         # TBX002: vocab-carrying f32
+    big = (h @ embed.T).astype(jnp.float32)    # [B, T, V] shape-comment hint
+    return probs, big
+
+
+def fine(x):
+    return x.astype(jnp.float32)               # no vocab signal: not flagged

@@ -1,0 +1,11 @@
+"""Seeded TBX003 violation: a KV-cache-carrying jit that donates nothing."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def step_with_cache(params, kv_cache, *, steps):   # TBX003 at the decorator
+    del steps
+    return params, kv_cache

@@ -1,0 +1,19 @@
+"""Seeded TBX004 violations: static_argnames naming absent parameters."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("cfg", "topk"))   # TBX004: 'topk' absent
+def readout(params, cfg, x, *, top_k):
+    del cfg, top_k
+    return params, x
+
+
+def _scorer(x, chunk):
+    del chunk
+    return x
+
+
+scorer_jit = jax.jit(_scorer, static_argnames=("chunks",))  # TBX004: 'chunks'

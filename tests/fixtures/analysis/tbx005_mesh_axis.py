@@ -1,0 +1,11 @@
+"""Seeded TBX005 violations: axis strings not declared in parallel/mesh.py."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+BAD_SPEC = P("dp", "model")        # TBX005: 'model' is not a declared axis
+GOOD_SPEC = P("dp", "tp")          # declared axes: fine
+
+
+def local_sum(x):
+    return lax.psum(x, axis_name="rows")   # TBX005: 'rows' undeclared

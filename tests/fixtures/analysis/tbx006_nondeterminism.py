@@ -1,0 +1,16 @@
+"""Seeded TBX006 violations: host RNG / clock inside traced code."""
+
+import random
+import time
+
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    jitter = random.random()        # TBX006: Python random under trace
+    seed = np.random.rand()         # TBX006: numpy RNG under trace
+    stamp = time.time()             # TBX006: clock frozen at trace time
+    return x * jitter + seed + stamp

@@ -1,0 +1,15 @@
+"""Seeded TBX007 violations: wall clock used for duration math."""
+
+import dataclasses
+import time
+
+
+def timed_work():
+    t0 = time.time()                  # TBX007: start mark on the wall clock
+    work = sum(range(10))
+    return time.time() - t0, work     # TBX007: duration by subtraction
+
+
+@dataclasses.dataclass
+class Record:
+    started: float = dataclasses.field(default_factory=time.time)  # TBX007

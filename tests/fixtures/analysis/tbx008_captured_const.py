@@ -1,0 +1,12 @@
+"""Seeded TBX008 violations: mutable default + captured jnp constant."""
+
+import jax
+import jax.numpy as jnp
+
+_TABLE = jnp.arange(8)
+
+
+@jax.jit
+def lookup(i, extras=[]):     # TBX008: mutable default on a traced function
+    del extras
+    return _TABLE[i]          # TBX008: module-level jnp constant captured

@@ -1,0 +1,265 @@
+"""tbx-check: fixture corpus (exact codes + lines), pragmas, baseline,
+deep jaxpr mode, and the repo-wide zero-findings meta-gate."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from taboo_brittleness_tpu.analysis import baseline as baseline_mod
+from taboo_brittleness_tpu.analysis.cli import run_check
+from taboo_brittleness_tpu.analysis.core import ModuleContext, analyze_file
+from taboo_brittleness_tpu.analysis.rules import RULES, RepoContext
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _findings(name):
+    active, suppressed = analyze_file(os.path.join(FIXTURES, name))
+    return active, suppressed
+
+
+def _codes_and_lines(findings):
+    return sorted((f.code, f.line) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# One seeded violation (set) per rule, exact codes and line numbers.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,expected", [
+    ("tbx001_host_sync.py",
+     [("TBX001", 13), ("TBX001", 18), ("TBX001", 19)]),
+    ("tbx002_vocab_f32.py",
+     [("TBX002", 8), ("TBX002", 9)]),
+    ("tbx003_missing_donation.py",
+     [("TBX003", 8)]),
+    ("tbx004_static_argnames.py",
+     [("TBX004", 8), ("TBX004", 19)]),
+    ("tbx005_mesh_axis.py",
+     [("TBX005", 6), ("TBX005", 11)]),
+    ("tbx006_nondeterminism.py",
+     [("TBX006", 13), ("TBX006", 14), ("TBX006", 15)]),
+    ("tbx007_wallclock.py",
+     [("TBX007", 8), ("TBX007", 10), ("TBX007", 15)]),
+    ("tbx008_captured_const.py",
+     [("TBX008", 10), ("TBX008", 12)]),
+])
+def test_fixture_rules(name, expected):
+    active, _ = _findings(name)
+    assert _codes_and_lines(active) == expected
+
+
+def test_clean_fixture_has_no_findings():
+    active, suppressed = _findings("clean.py")
+    assert active == [] and suppressed == []
+
+
+# ---------------------------------------------------------------------------
+# Pragmas.
+# ---------------------------------------------------------------------------
+
+def _check_source(tmp_path, source):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(source))
+    return analyze_file(str(p))
+
+
+def test_trailing_pragma_suppresses(tmp_path):
+    active, suppressed = _check_source(tmp_path, """\
+        import time
+
+        def timed():
+            t0 = time.time()  # tbx: wallclock-ok — epoch mark is intended
+            return t0
+    """)
+    assert active == []
+    assert [f.code for f in suppressed] == ["TBX007"]
+
+
+def test_comment_block_pragma_covers_next_statement(tmp_path):
+    active, suppressed = _check_source(tmp_path, """\
+        import time
+
+        def timed():
+            # This epoch mark feeds a log record, not duration math.
+            # tbx: TBX007-ok — epoch timestamp intended
+            # (see the log schema for why.)
+            t0 = time.time()
+            return t0
+    """)
+    assert active == []
+    assert [f.code for f in suppressed] == ["TBX007"]
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    active, _ = _check_source(tmp_path, """\
+        import time
+
+        def timed():
+            t0 = time.time()  # tbx: f32-ok — wrong rule
+            return t0
+    """)
+    assert [f.code for f in active] == ["TBX007"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline engine.
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_filters_known_findings(tmp_path):
+    fixture = os.path.join(FIXTURES, "tbx007_wallclock.py")
+    report = run_check([fixture], default_excludes=False)
+    assert report.findings
+
+    bl = tmp_path / "baseline.json"
+    n = baseline_mod.save(report.findings, str(bl))
+    assert n == len({baseline_mod.fingerprint(f) for f in report.findings})
+    with open(bl) as f:
+        doc = json.load(f)
+    assert doc["version"] == 1 and doc["findings"]
+
+    again = run_check([fixture], baseline=str(bl), default_excludes=False)
+    assert again.findings == []
+    assert len(again.baselined) == len(report.findings)
+
+
+def test_baseline_fingerprint_survives_line_shift(tmp_path):
+    src = "import time\n\n\ndef timed():\n    t0 = time.time()\n    return t0\n"
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    fp0 = {baseline_mod.fingerprint(f) for f in analyze_file(str(p))[0]}
+    p.write_text("# a new header comment\n" + src)
+    fp1 = {baseline_mod.fingerprint(f) for f in analyze_file(str(p))[0]}
+    assert fp0 == fp1 and fp0
+
+
+# ---------------------------------------------------------------------------
+# Rule plumbing details.
+# ---------------------------------------------------------------------------
+
+def test_static_argnames_drift_in_assignment_form(tmp_path):
+    active, _ = _check_source(tmp_path, """\
+        import jax
+
+        def _f(x, chunk):
+            return x
+
+        f_jit = jax.jit(_f, static_argnames=("chunky",))
+    """)
+    assert [f.code for f in active] == ["TBX004"]
+    assert "chunky" in active[0].message
+
+
+def test_repo_declares_dp_tp_sp_axes():
+    repo = RepoContext.discover([])
+    assert {"dp", "tp", "sp"} <= set(repo.mesh_axes)
+
+
+def test_traced_reachability_spans_helpers(tmp_path):
+    # The helper is only reachable THROUGH the jitted caller; a host sync in
+    # it must still be flagged, and a host sync in an unreachable function
+    # must not.
+    active, _ = _check_source(tmp_path, """\
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        def untraced(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def entry(x):
+            return helper(x)
+    """)
+    assert [(f.code, f.line) for f in active] == [("TBX001", 5)]
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    active, _ = analyze_file(str(p))
+    assert [f.code for f in active] == ["TBX000"]
+
+
+# ---------------------------------------------------------------------------
+# Deep (jaxpr) mode.
+# ---------------------------------------------------------------------------
+
+def test_deep_mode_flags_decode_vocab_f32_and_traces_all_entries():
+    from taboo_brittleness_tpu.analysis.deep import ENTRY_POINTS, run_deep
+
+    findings = run_deep()
+    # Registry drift (an entry failing to trace) must surface, not skip.
+    assert not [f for f in findings if f.code == "TBX100"], [
+        f.message for f in findings]
+    by_entry = {f.path for f in findings if f.code == "TBX101"}
+    # The decode's per-step [B, 1, V] f32 unembed is the known (reviewed,
+    # baselined in tools/tbx_baseline.json) conversion deep mode must see.
+    assert "<deep:runtime.decode.greedy_decode>" in by_entry
+    assert len(ENTRY_POINTS) >= 3
+
+
+def test_committed_deep_baseline_covers_current_deep_findings():
+    from taboo_brittleness_tpu.analysis.deep import run_deep
+
+    known = baseline_mod.load(os.path.join(REPO, "tools", "tbx_baseline.json"))
+    new, _ = baseline_mod.split(run_deep(), known)
+    assert new == [], [f.message for f in new]
+
+
+# ---------------------------------------------------------------------------
+# The repo-wide gate (the acceptance command, in-process and end-to-end).
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean_under_tbx_check():
+    report = run_check(
+        [os.path.join(REPO, d) for d in
+         ("taboo_brittleness_tpu", "tools", "tests")])
+    assert report.findings == [], "\n".join(f.format() for f in report.findings)
+    # The corpus is excluded by default — prove the excludes did their job
+    # rather than the corpus having gone stale.
+    assert report.files_checked > 50
+
+
+def test_cli_gate_exit_codes(tmp_path):
+    env = {**os.environ, "PYTHONPATH": REPO}
+    clean = subprocess.run(
+        [sys.executable, "-m", "taboo_brittleness_tpu.analysis",
+         "taboo_brittleness_tpu", "tools", "tests"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text(
+        "import time\n\n\ndef timed():\n    t0 = time.time()\n    return t0\n")
+    dirty = subprocess.run(
+        [sys.executable, "-m", "taboo_brittleness_tpu.analysis", str(scratch)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert dirty.returncode == 1
+    assert "TBX007" in dirty.stdout
+
+
+def test_cli_list_rules():
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "taboo_brittleness_tpu.analysis",
+         "--list-rules"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    for rule in RULES:
+        assert rule.code in out.stdout
+    assert "TBX101" in out.stdout
+
+
+def test_every_rule_has_unique_code_and_alias():
+    codes = [r.code for r in RULES]
+    aliases = [r.alias for r in RULES]
+    assert len(set(codes)) == len(codes) == 8
+    assert len(set(aliases)) == len(aliases)

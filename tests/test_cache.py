@@ -28,6 +28,7 @@ def test_save_load_roundtrip(tmp_path, rng):
     assert pair.all_probs.dtype == np.float32
     assert pair.residual_stream.dtype == np.float32
     assert pair.layer_idx == 1
+    # tbx: f32-ok — dtype-parity assertion on a tiny fixture tensor
     np.testing.assert_allclose(pair.all_probs, probs.astype(np.float32))
     assert pair.input_words == ["<bos>", "hi"]
     assert pair.response_text == "resp"

@@ -248,3 +248,46 @@ def test_response_layout_device_matches_host():
     np.testing.assert_array_equal(
         np.asarray(decode.response_layout_device(dec2).response_mask),
         decode.response_layout(dec2).response_mask)
+
+
+def test_cache_seed_recycles_kv_block(tiny_model):
+    """cache_seed (donated) must reproduce the fresh-cache decode exactly:
+    occupancy is reset and stale K/V stay masked by valid=False."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(7)
+    n_new = 5
+    prompts_a = [list(rng.integers(1, cfg.vocab_size, size=L)) for L in (4, 6)]
+    prompts_b = [list(rng.integers(1, cfg.vocab_size, size=L)) for L in (6, 3)]
+
+    def launch(prompts, seed=None):
+        padded, valid, pos = decode.pad_prompts(prompts, pad_to_multiple=8)
+        return decode.greedy_decode(
+            params, cfg, jnp.asarray(padded), jnp.asarray(valid),
+            jnp.asarray(pos), max_new_tokens=n_new, cache_seed=seed,
+            return_cache=True)
+
+    first = launch(prompts_a)
+    assert first.cache is not None
+    expected = launch(prompts_b)            # fresh cache: the oracle
+    recycled = launch(prompts_b, seed=first.cache)  # donated seed
+
+    np.testing.assert_array_equal(np.asarray(expected.tokens),
+                                  np.asarray(recycled.tokens))
+    np.testing.assert_array_equal(np.asarray(expected.lengths),
+                                  np.asarray(recycled.lengths))
+    # The donated seed's buffers must actually be consumed (recycled in
+    # place), not copied: jax marks them deleted after the call.
+    assert first.cache.k.is_deleted()
+
+
+def test_cache_seed_shape_mismatch_raises(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(8)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=4))]
+    padded, valid, pos = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(pos))
+    res = decode.greedy_decode(params, cfg, *args, max_new_tokens=3,
+                               return_cache=True)
+    with pytest.raises(ValueError, match="cache_seed shape"):
+        decode.greedy_decode(params, cfg, *args, max_new_tokens=5,
+                             cache_seed=res.cache)

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# One gate for the whole repo: lint (ruff, when installed) + tbx-check
+# (static TBX rules, then the deep jaxpr audit against the committed
+# baseline) + the tier-1 test suite.  Run from anywhere:
+#
+#     tools/check.sh
+#
+# Exit is non-zero if any stage fails; CI and pre-merge run exactly this.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff"
+  ruff check taboo_brittleness_tpu tools tests
+else
+  echo "== ruff: not installed; skipping lint (pip install ruff to enable)" >&2
+fi
+
+echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
+  --deep --baseline tools/tbx_baseline.json \
+  taboo_brittleness_tpu/ tools/ tests/
+
+echo "== tier-1 pytest"
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider

@@ -128,7 +128,7 @@ def main() -> int:
             if f.endswith(".json")) 
         manifest.extra.setdefault("resumed_words", {})[stage] = resumed
     os.makedirs(args.out, exist_ok=True)
-    t_all = time.time()
+    t_all = time.monotonic()
 
     # 1. Generation cache (the reference's run_generation main loop).
     from taboo_brittleness_tpu.pipelines import generation
@@ -231,7 +231,7 @@ def main() -> int:
     manifest.add_artifact(pr_json)
     print(f"[6/6] prompting attacks -> {pr_json}", flush=True)
 
-    manifest.extra["total_seconds"] = round(time.time() - t_all, 1)
+    manifest.extra["total_seconds"] = round(time.monotonic() - t_all, 1)
     path = manifest.save(os.path.join(args.out, "run_manifest.json"))
     print(f"manifest -> {path}  ({manifest.extra['total_seconds']} s total)")
     return 0
