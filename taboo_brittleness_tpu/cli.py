@@ -41,6 +41,13 @@ def _common(p: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler trace into this directory")
     p.add_argument("--no-manifest", action="store_true",
                    help="skip writing run_manifest.json")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="retries per word on transient failures before the "
+                        "word is quarantined (exponential backoff, seeded "
+                        "jitter; see runtime/resilience.py)")
+    p.add_argument("--fail-fast", action="store_true",
+                   help="abort the sweep on the first failed word instead "
+                        "of quarantining it and continuing")
 
 
 def _manifest(args, command: str):
@@ -60,6 +67,26 @@ def _load(args) -> Config:
         return config_mod.load_config(args.config)
     print(f"[config] {args.config} not found; using built-in defaults")
     return Config()
+
+
+def _report_failures(manifest, ledger_or_failures) -> int:
+    """Fold a sweep's failure ledger into the manifest and derive the exit
+    code: non-zero iff words were quarantined (partial results on disk are
+    still valid — the non-zero exit is the 'rerun me' signal, and a rerun
+    resumes the finished words for free)."""
+    if ledger_or_failures is None:
+        return 0
+    data = (ledger_or_failures.to_dict()
+            if hasattr(ledger_or_failures, "to_dict")
+            else dict(ledger_or_failures))
+    manifest.record_resilience(data)
+    quarantined = data.get("quarantined", {})
+    if not quarantined:
+        return 0
+    print(f"[resilience] {len(quarantined)} word(s) quarantined: "
+          f"{sorted(quarantined)} (see _failures.json next to the results)",
+          file=sys.stderr)
+    return 1
 
 
 def _mesh(config: Config):
@@ -129,18 +156,24 @@ def cmd_generate(args) -> int:
     from taboo_brittleness_tpu.pipelines import generation
     from taboo_brittleness_tpu.runtime.manifest import maybe_profile
 
+    from taboo_brittleness_tpu.runtime.resilience import FailureLedger
+
     config = _load(args)
     manifest = _manifest(args, "generate")
     processed = args.processed_dir or config.output.processed_dir
+    ledger = FailureLedger(processed)
     with maybe_profile(args.trace_dir), manifest.stage("generate"):
         done = generation.run_generation(
             config, model_loader=_loader(config, args, mesh=_mesh(config)),
             words=args.words,
-            processed_dir=processed, parity_dump=args.parity_dump)
+            processed_dir=processed, parity_dump=args.parity_dump,
+            max_retries=args.max_retries, fail_fast=args.fail_fast,
+            ledger=ledger)
     manifest.extra["generated"] = {w: len(v) for w, v in done.items()}
     print(json.dumps({w: len(v) for w, v in done.items()}))
+    rc = _report_failures(manifest, ledger)
     _finish(args, manifest, processed)
-    return 0
+    return rc
 
 
 def cmd_logit_lens(args) -> int:
@@ -300,19 +333,27 @@ def cmd_interventions(args) -> int:
         # word's figures render on ONE background thread as its results land
         # (the device keeps computing the next word meanwhile) — matplotlib
         # is a pure serial tail otherwise.
+        from taboo_brittleness_tpu.runtime.resilience import FailureLedger
+
         out_dir = args.output or os.path.join("results", "interventions")
+        ledger = FailureLedger(out_dir)
         with maybe_profile(args.trace_dir), manifest.stage("study-sweep"), \
                 StudyPlotRenderer(config, out_dir) as renderer:
             results = interventions.run_intervention_studies(
                 config, model_loader=loader, sae=sae, output_dir=out_dir,
                 mesh=mesh, forcing=args.forcing,
-                on_word_done=renderer.on_word_done)
+                on_word_done=renderer.on_word_done,
+                max_retries=args.max_retries, fail_fast=args.fail_fast,
+                ledger=ledger)
             plot_paths = renderer.join()
         for w in results:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
         for p_ in plot_paths:
             manifest.add_artifact(p_)
         print(f"studies ({len(results)} words) -> {out_dir}")
+        rc = _report_failures(manifest, ledger)
+        _finish(args, manifest, out_dir)
+        return rc
     _finish(args, manifest, out_dir)
     return 0
 
@@ -331,13 +372,15 @@ def cmd_token_forcing(args) -> int:
             # Per-word atomic JSONs make the sweep resumable: a crashed run
             # restarts at the first word without a file.
             output_dir=os.path.join(os.path.dirname(out) or ".", "words"),
-            force=args.force)
+            force=args.force,
+            max_retries=args.max_retries, fail_fast=args.fail_fast)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
     print(f"results -> {out}")
+    rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
-    return 0
+    return rc
 
 
 def cmd_prompting(args) -> int:
@@ -352,13 +395,15 @@ def cmd_prompting(args) -> int:
             words=args.words,
             modes=tuple(args.modes), output_path=out,
             output_dir=os.path.join(os.path.dirname(out) or ".", "words"),
-            force=args.force)
+            force=args.force,
+            max_retries=args.max_retries, fail_fast=args.fail_fast)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
     print(json.dumps(results["overall"], indent=2))
     print(f"results -> {out}")
+    rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
-    return 0
+    return rc
 
 
 def build_parser() -> argparse.ArgumentParser:

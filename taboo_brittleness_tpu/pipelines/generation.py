@@ -51,11 +51,14 @@ def generate_for_word(
     processed = processed_dir or config.output.processed_dir
     layer_idx = config.model.layer_idx
 
+    # Validated resume: a cell only counts as done if its artifact is
+    # structurally readable — a truncated npz / torn json from a killed run
+    # is quarantined (*.corrupt) and recomputed, never trusted or fatal.
     def cached(i: int) -> bool:
         if parity_dump:
-            return cache_io.has_pair(processed, word, i)
-        return (os.path.exists(cache_io.summary_path(processed, word, i))
-                or cache_io.has_pair(processed, word, i))
+            return cache_io.verify_pair(processed, word, i)
+        return (cache_io.verify_summary(cache_io.summary_path(processed, word, i))
+                or cache_io.verify_pair(processed, word, i))
 
     missing = [i for i in range(len(config.prompts)) if force or not cached(i)]
     if not missing:
@@ -156,17 +159,52 @@ def run_generation(
     words: Optional[Sequence[str]] = None,
     processed_dir: Optional[str] = None,
     parity_dump: bool = False,
+    max_retries: int = 2,
+    fail_fast: bool = False,
+    retry_policy=None,
+    ledger=None,
 ) -> Dict[str, List[int]]:
     """The reference's main loop (src/run_generation.py:132-158): per word, load
-    that word's checkpoint and fill its cache cells."""
+    that word's checkpoint and fill its cache cells.
+
+    Failure semantics (``runtime.resilience``): a failing word retries under
+    the :class:`~.resilience.RetryPolicy` (transient errors only), then is
+    quarantined in ``<processed_dir>/_failures.json`` and the sweep
+    CONTINUES — partial caches are already the resume story, so losing one
+    checkpoint must cost one word's cells, not the grid.  Quarantined words
+    are absent from the returned dict.  ``fail_fast=True`` restores
+    raise-on-first-failure (the pre-resilience contract)."""
+    from taboo_brittleness_tpu.runtime import resilience
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
+    processed = processed_dir or config.output.processed_dir
+    policy = retry_policy or resilience.RetryPolicy(max_retries=max_retries)
+    if ledger is None:
+        ledger = resilience.FailureLedger(processed)
 
     generated: Dict[str, List[int]] = {}
     word_list = list(words if words is not None else config.words)
     for i, word in enumerate(word_list):
-        params, model_cfg, tok = model_loader(word)
-        prefetch_next(model_loader, word_list, i)  # overlap next word's IO
-        generated[word] = generate_for_word(
-            params, model_cfg, tok, config, word,
-            processed_dir=processed_dir, parity_dump=parity_dump)
+        stage = {"name": "checkpoint.load"}
+
+        def run_one() -> List[int]:
+            stage["name"] = "checkpoint.load"
+            params, model_cfg, tok = model_loader(word)
+            prefetch_next(model_loader, word_list, i)  # overlap next word's IO
+            stage["name"] = "generate"
+            return generate_for_word(
+                params, model_cfg, tok, config, word,
+                processed_dir=processed_dir, parity_dump=parity_dump)
+
+        outcome = resilience.run_guarded(
+            word, run_one, policy=policy, ledger=ledger,
+            stage=lambda: stage["name"])
+        if not outcome.ok:
+            if fail_fast:
+                raise outcome.error
+            drop = getattr(model_loader, "drop_pending", None)
+            if drop is not None:
+                drop(word)
+            continue
+        generated[word] = outcome.value
     return generated

@@ -1274,12 +1274,18 @@ def run_intervention_study(
 
 def _atomic_json_dump(obj: Any, path: str) -> None:
     """Write-then-rename so a crash mid-write never leaves a truncated file:
-    the skip-if-exists resume logic treats existence as a completion marker."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=2)
-    os.replace(tmp, path)
+    the skip-if-exists resume logic treats existence as a completion marker.
+
+    Thin module-level wrapper over the shared
+    :func:`~taboo_brittleness_tpu.runtime.resilience.atomic_json_dump` —
+    kept as a *name* here because the host profiler
+    (tools/profile_study_host.py) wraps this attribute to time the study's
+    JSON tail; the implementation lives in the runtime layer so pipelines
+    never import IO helpers from sibling pipelines.
+    """
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+    atomic_json_dump(obj, path)
 
 
 def run_intervention_studies(
@@ -1293,6 +1299,10 @@ def run_intervention_studies(
     mesh: Any = None,
     forcing: bool = False,
     on_word_done: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    max_retries: int = 2,
+    fail_fast: bool = False,
+    retry_policy: Any = None,
+    ledger: Any = None,
 ) -> Dict[str, Any]:
     """The full 20-word study: per word, load that word's checkpoint and run
     both sweeps, prefetching the NEXT word's checkpoint on a host thread while
@@ -1314,67 +1324,119 @@ def run_intervention_studies(
     (computed or resumed) — the CLI uses it to render that word's figures on
     a background thread while the NEXT word computes, instead of paying a
     serial render tail after the sweep.
+
+    Failure semantics (``runtime.resilience``): a failing word retries under
+    the :class:`~.resilience.RetryPolicy` (transient errors only), then is
+    quarantined — recorded in ``<output_dir>/_failures.json`` with stage,
+    attempt count, and the final exception — and the sweep CONTINUES: a host
+    that loses one word must not take down the study.  Quarantined words are
+    absent from the returned dict; ``fail_fast=True`` restores
+    raise-on-first-failure.  A resumed word whose JSON is corrupt is
+    quarantined on disk (``*.corrupt``) and recomputed.
     """
+    import time as _time
+
+    from taboo_brittleness_tpu.runtime import resilience
+    from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+
     words = list(words if words is not None else config.words)
+    policy = retry_policy or resilience.RetryPolicy(max_retries=max_retries)
+    if ledger is None:
+        ledger = resilience.FailureLedger(output_dir)
+
+    def done_entry(w: str) -> Optional[Dict[str, Any]]:
+        p = os.path.join(output_dir, f"{w}.json")
+        if force or not os.path.exists(p):
+            return None
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError) as exc:
+            resilience.quarantine_file(p, reason=f"unreadable study: {exc}")
+            return None
 
     def done(w: str) -> bool:
-        return not force and os.path.exists(os.path.join(output_dir, f"{w}.json"))
+        return done_entry(w) is not None
 
     out: Dict[str, Any] = {}
     prepared_next: Optional[Dict[str, Any]] = None
     for i, word in enumerate(words):
         path = os.path.join(output_dir, f"{word}.json")
-        if done(word):
-            with open(path) as f:
-                out[word] = json.load(f)
+        saved = done_entry(word)
+        if saved is not None:
+            out[word] = saved
+            ledger.record_success(word)
             if on_word_done is not None:
                 on_word_done(word, out[word])
             continue
-        params, cfg, tok = model_loader(word)
-        prepared = (prepared_next
-                    if prepared_next and prepared_next["word"] == word
-                    else None)
+        # The pre-dispatched baseline handle (if any) is single-shot: a
+        # retry after a mid-study failure restarts from a fresh baseline.
+        prepared_cell = {"h": (prepared_next
+                               if prepared_next
+                               and prepared_next["word"] == word
+                               else None)}
         prepared_next = None
-        # Overlap the next word's checkpoint IO with this word's compute —
-        # but only a word that will actually RUN: prefetching a to-be-skipped
-        # word would pin its params in the loader's pending slot forever.
-        from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
+        stage = {"name": "checkpoint.load"}
 
-        todo = [w for w in words[i + 1:] if not done(w)]
-        if todo:
-            prefetch_next(model_loader, [word, todo[0]], 0)
-
-        # The in-flight baseline handle costs ~0.3 GB/chip at 9B shapes
-        # (B=10 prefill KV + residual) on top of the final chunks' buffers;
-        # TBX_CROSS_WORD_BASELINE=0 turns the pre-dispatch off if an HBM
-        # budget ever needs it back.
-        cross_word = os.environ.get("TBX_CROSS_WORD_BASELINE", "1") != "0"
-
-        def dispatch_next_baseline(nxt=todo[0] if todo else None):
+        def run_one() -> Dict[str, Any]:
             nonlocal prepared_next
-            if nxt is None or prepared_next is not None:
-                return
-            try:
-                p2, c2, t2 = model_loader(nxt)
-                prepared_next = prepare_word_dispatch(
-                    p2, c2, t2, config, nxt, mesh=mesh)
-            except Exception as e:  # noqa: BLE001 — must not lose THIS
-                # word's results to the next word's early load/dispatch
-                # failure.  A LOADER failure resurfaces at that word's own
-                # model_loader call (after this word's JSON is written); a
-                # dispatch failure falls back to the un-pipelined baseline,
-                # so log it — it would otherwise be invisible.
-                import sys
+            stage["name"] = "checkpoint.load"
+            params, cfg, tok = model_loader(word)
+            # Overlap the next word's checkpoint IO with this word's compute
+            # — but only a word that will actually RUN: prefetching a
+            # to-be-skipped word would pin its params in the loader's
+            # pending slot forever.
+            todo = [w for w in words[i + 1:]
+                    if w not in ledger.quarantined and not done(w)]
+            if todo:
+                prefetch_next(model_loader, [word, todo[0]], 0)
 
-                print(f"[study] next-word baseline pre-dispatch failed "
-                      f"({nxt}): {e}", file=sys.stderr)
-                prepared_next = None
+            # The in-flight baseline handle costs ~0.3 GB/chip at 9B shapes
+            # (B=10 prefill KV + residual) on top of the final chunks'
+            # buffers; TBX_CROSS_WORD_BASELINE=0 turns the pre-dispatch off
+            # if an HBM budget ever needs it back.
+            cross_word = os.environ.get("TBX_CROSS_WORD_BASELINE", "1") != "0"
 
-        out[word] = run_intervention_study(
-            params, cfg, tok, config, word, sae, output_path=path, mesh=mesh,
-            forcing=forcing, prepared=prepared,
-            after_arms_dispatched=(dispatch_next_baseline if cross_word
-                                   else None))
+            def dispatch_next_baseline(nxt=todo[0] if todo else None):
+                nonlocal prepared_next
+                if nxt is None or prepared_next is not None:
+                    return
+                try:
+                    p2, c2, t2 = model_loader(nxt)
+                    prepared_next = prepare_word_dispatch(
+                        p2, c2, t2, config, nxt, mesh=mesh)
+                except Exception as e:  # noqa: BLE001 — must not lose THIS
+                    # word's results to the next word's early load/dispatch
+                    # failure.  A LOADER failure resurfaces at that word's
+                    # own model_loader call (after this word's JSON is
+                    # written); a dispatch failure falls back to the
+                    # un-pipelined baseline, so log it — it would otherwise
+                    # be invisible.
+                    import sys
+
+                    print(f"[study] next-word baseline pre-dispatch failed "
+                          f"({nxt}): {e}", file=sys.stderr)
+                    prepared_next = None
+
+            stage["name"] = "study"
+            return run_intervention_study(
+                params, cfg, tok, config, word, sae, output_path=path,
+                mesh=mesh, forcing=forcing,
+                prepared=prepared_cell.pop("h", None),
+                after_arms_dispatched=(dispatch_next_baseline if cross_word
+                                       else None))
+
+        outcome = resilience.run_guarded(
+            word, run_one, policy=policy, ledger=ledger,
+            stage=lambda: stage["name"], sleep=_time.sleep)
+        if not outcome.ok:
+            if fail_fast:
+                raise outcome.error
+            drop = getattr(model_loader, "drop_pending", None)
+            if drop is not None:
+                drop(word)
+            continue
+        out[word] = outcome.value
         if on_word_done is not None:
             on_word_done(word, out[word])
     return out

@@ -248,9 +248,12 @@ def evaluate_word(
         # the reference-schema pair counted as "cached" here).  A
         # reference-schema pair still takes precedence (below): its analysis
         # path is the byte-level reference parity a parity dump exists for.
-        pair_cached = cache_io.has_pair(processed, word, p_idx)
+        # verify_* (not bare existence): a corrupt artifact quarantines to
+        # *.corrupt here and the prompt re-enters `missing` — a torn cache
+        # write downgrades to a recompute instead of aborting the eval.
+        pair_cached = cache_io.verify_pair(processed, word, p_idx)
         spath = cache_io.summary_path(processed, word, p_idx)
-        if not pair_cached and os.path.exists(spath):
+        if not pair_cached and cache_io.verify_summary(spath):
             want = (("agg_topk_ids", "target_prob") if plot_dir
                     else ("agg_topk_ids",))
             arrays, meta = cache_io.load_summary(spath, keys=want)

@@ -84,37 +84,53 @@ def run_prompting_attacks(
     force: bool = False,
     edit_fn: Optional[Callable] = None,
     edit_params: Any = None,
+    max_retries: int = 2,
+    fail_fast: bool = False,
+    retry_policy: Any = None,
 ) -> Dict[str, Any]:
     """Prompting-attack sweep over words; per-word success + overall means
     per mode (the paper's Table-1 'Naive/Adversarial prompting' rows).
 
-    Resume/memoization contract: :mod:`pipelines.word_sweep` (shared with
-    ``token_forcing.run_token_forcing``) — per-word atomic JSONs, payloads
-    memoized on (params, tokenizer) identity so a shared-model loader pays
-    one decode per mode for the entire word list.
+    Resume/memoization/failure contract: :mod:`pipelines.word_sweep` (shared
+    with ``token_forcing.run_token_forcing``) — per-word atomic JSONs,
+    payloads memoized on (params, tokenizer) identity so a shared-model
+    loader pays one decode per mode for the entire word list, and a failing
+    word retries then quarantines while the sweep continues (``overall``
+    covers the words that finished; the ``failures`` block carries the
+    ledger).
     """
-    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
     from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
 
     words = list(words if words is not None else config.words)
-    results = run_word_sweep(
+    outcome = run_word_sweep(
         config, model_loader=model_loader, words=words, modes=modes,
         compute_mode=lambda p, c, t, cf, m: _attack_responses(
             p, c, t, cf, m, edit_fn=edit_fn, edit_params=edit_params),
         score_word=lambda cf, w, m, payload: score_prompting(
             cf, w, m, payload),
-        output_dir=output_dir, force=force)
+        output_dir=output_dir, force=force,
+        max_retries=max_retries, fail_fast=fail_fast,
+        retry_policy=retry_policy)
+    results = outcome.results
 
+    scored = [w for w in words if w in results]
     overall = {
         mode: {
-            "success_rate": float(np.mean(
-                [results[w][mode]["success_rate"] for w in words])),
-            "pass_at_k": float(np.mean(
-                [results[w][mode]["pass_at_k"] for w in words])),
+            "success_rate": (float(np.mean(
+                [results[w][mode]["success_rate"] for w in scored]))
+                if scored else 0.0),
+            "pass_at_k": (float(np.mean(
+                [results[w][mode]["pass_at_k"] for w in scored]))
+                if scored else 0.0),
         }
         for mode in modes
     }
     out = {"overall": overall, "words": results}
+    if not outcome.ok or outcome.ledger.retried:
+        # Same contract as run_token_forcing: quarantines drive the exit
+        # code, retried-to-success counts ride along for the manifest.
+        out["failures"] = outcome.ledger.to_dict()
     if output_path:
-        _atomic_json_dump(out, output_path)
+        atomic_json_dump(out, output_path)
     return out

@@ -121,9 +121,10 @@ def _load_residual_pair(
     processed: str, word: str, p_idx: int, layer_idx: int,
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """(residual [T, D], response mask [T]) from either cache format, or None."""
-    # Our compact summary first.
+    # Our compact summary first (verify_*: a corrupt file quarantines to
+    # *.corrupt and the cell reads as missing — warn-and-skip, not fatal).
     spath = cache_io.summary_path(processed, word, p_idx)
-    if os.path.exists(spath):
+    if cache_io.verify_summary(spath):
         arrays, meta = cache_io.load_summary(spath)
         if "residual" not in arrays or meta.get("layer_idx") != layer_idx:
             return None
@@ -131,7 +132,7 @@ def _load_residual_pair(
         mask = np.asarray(chat.response_mask(token_ids), bool)
         return arrays["residual"], mask
     # Reference npz/json pair.
-    if cache_io.has_pair(processed, word, p_idx):
+    if cache_io.verify_pair(processed, word, p_idx):
         npz, js = cache_io.pair_paths(processed, word, p_idx)
         pair = cache_io.load_pair(npz, js, layer_idx=layer_idx)
         if pair.residual_stream is None:

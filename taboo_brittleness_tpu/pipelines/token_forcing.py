@@ -270,6 +270,9 @@ def run_token_forcing(
     force: bool = False,
     edit_fn: Optional[Callable] = None,
     edit_params: Any = None,
+    max_retries: int = 2,
+    fail_fast: bool = False,
+    retry_policy: Any = None,
 ) -> Dict[str, Any]:
     """Forcing sweep over words; per-word success + overall mean per mode
     (the paper's Table 1 'Token forcing' rows).
@@ -296,11 +299,15 @@ def run_token_forcing(
     never loaded) — a crash at word 19 of 20 costs one word, not the sweep.
     Pass ``force`` to redo.  ``output_path`` (the aggregate JSON) also writes
     atomically, last.  The resume + (params, tokenizer)-identity memoization
-    contract lives in :mod:`pipelines.word_sweep` (shared with the prompting
-    attacks).
+    + retry/quarantine contract lives in :mod:`pipelines.word_sweep` (shared
+    with the prompting attacks): a failing word retries
+    (``max_retries``, transient errors only) and is then quarantined while
+    the sweep continues — ``overall`` aggregates the words that finished and
+    the ``failures`` block carries the ledger (``fail_fast=True`` restores
+    raise-on-first-failure).
     """
-    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
     from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
 
     words = list(words if words is not None else config.words)
     kw = dict(edit_fn=edit_fn, edit_params=edit_params)
@@ -317,16 +324,26 @@ def run_token_forcing(
         return _score_entry(cf, word, "postgame", completions,
                             warmup_transcript=transcript)
 
-    results = run_word_sweep(
+    outcome = run_word_sweep(
         config, model_loader=model_loader, words=words, modes=modes,
         compute_mode=compute, score_word=score,
-        output_dir=output_dir, force=force)
+        output_dir=output_dir, force=force,
+        max_retries=max_retries, fail_fast=fail_fast,
+        retry_policy=retry_policy)
+    results = outcome.results
 
+    scored = [w for w in words if w in results]
     overall = {
-        mode: float(np.mean([results[w][mode]["success_rate"] for w in words]))
+        mode: (float(np.mean([results[w][mode]["success_rate"]
+                              for w in scored])) if scored else 0.0)
         for mode in modes
     }
     out = {"overall": overall, "words": results}
+    if not outcome.ok or outcome.ledger.retried:
+        # Quarantines drive the CLI's non-zero exit; retried-to-success
+        # counts ride along so the manifest records the transient-noise
+        # floor even on runs that ended clean.
+        out["failures"] = outcome.ledger.to_dict()
     if output_path:
-        _atomic_json_dump(out, output_path)
+        atomic_json_dump(out, output_path)
     return out

@@ -1,12 +1,14 @@
 """Shared per-word attack-sweep driver (token forcing + prompting).
 
 Both attack pipelines sweep the word list with the same contract, kept in
-ONE place so the resume and memoization rules cannot drift apart:
+ONE place so the resume, memoization, and FAILURE rules cannot drift apart:
 
 - **Resume:** with ``output_dir`` each word's entry writes atomically to
   ``<output_dir>/<word>.json`` as soon as it exists; a word whose file
   already covers every requested mode is skipped (its model is never
-  loaded).  A file from a narrower-modes run does NOT count as done.
+  loaded).  A file from a narrower-modes run does NOT count as done, and a
+  corrupt/truncated file is quarantined (renamed ``*.corrupt``) and treated
+  as not-done — never trusted, never fatal.
 - **Memoization:** the per-mode payload (decoded attack responses) is
   word-independent given the model, so it memoizes on the loaded
   ``(params, tokenizer)`` IDENTITY — a shared-model loader (tests, bench,
@@ -15,15 +17,43 @@ ONE place so the resume and memoization rules cannot drift apart:
   payloads contain decoded text.
 - **Prefetch:** the next *running* word's checkpoint IO overlaps this
   word's compute (``runtime.checkpoints.prefetch_next``).
+- **Failure:** (``runtime.resilience``) a failing word retries under the
+  :class:`~.resilience.RetryPolicy` (transient errors only — exponential
+  backoff, seeded jitter), then is QUARANTINED and the sweep continues: the
+  partial results return together with a :class:`~.resilience.FailureLedger`
+  (``<output_dir>/_failures.json``) recording stage, attempts, and the final
+  exception per word.  ``fail_fast=True`` restores raise-on-first-failure.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
 from taboo_brittleness_tpu.config import Config
+from taboo_brittleness_tpu.runtime import resilience
+from taboo_brittleness_tpu.runtime.resilience import (
+    FailureLedger, RetryPolicy, atomic_json_dump)
+
+
+@dataclasses.dataclass
+class SweepOutcome:
+    """Partial-results contract of :func:`run_word_sweep`: everything that
+    finished, plus the ledger describing everything that did not."""
+
+    results: Dict[str, Any]
+    ledger: FailureLedger
+
+    @property
+    def quarantined(self) -> Dict[str, Any]:
+        return self.ledger.quarantined
+
+    @property
+    def ok(self) -> bool:
+        return not self.ledger
 
 
 def run_word_sweep(
@@ -36,18 +66,30 @@ def run_word_sweep(
     score_word: Callable[[Config, str, str, Any], Dict[str, Any]],
     output_dir: Optional[str] = None,
     force: bool = False,
-) -> Dict[str, Any]:
-    """Per-word entries ``{word: {mode: score_word(...)}}``.
+    max_retries: int = 2,
+    fail_fast: bool = False,
+    retry_policy: Optional[RetryPolicy] = None,
+    ledger: Optional[FailureLedger] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> SweepOutcome:
+    """Per-word entries ``{word: {mode: score_word(...)}}`` plus the ledger.
 
     ``compute_mode(params, cfg, tok, config, mode)`` produces the
     word-independent payload for a mode under one model;
     ``score_word(config, word, mode, payload)`` turns it into the word's
-    entry for that mode.  Callers aggregate their own ``overall`` block.
+    entry for that mode.  Callers aggregate their own ``overall`` block
+    over ``outcome.results`` (quarantined words are absent from it).
+
+    ``retry_policy`` overrides the default
+    ``RetryPolicy(max_retries=max_retries)``; ``sleep`` is injectable so
+    tests exercise real backoff schedules without waiting them out.
     """
-    from taboo_brittleness_tpu.pipelines.interventions import _atomic_json_dump
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
     words = list(words)
+    policy = retry_policy or RetryPolicy(max_retries=max_retries)
+    if ledger is None:
+        ledger = FailureLedger(output_dir)
 
     def word_path(w: str) -> Optional[str]:
         return os.path.join(output_dir, f"{w}.json") if output_dir else None
@@ -56,8 +98,15 @@ def run_word_sweep(
         p = word_path(w)
         if p is None or force or not os.path.exists(p):
             return None
-        with open(p) as f:
-            entry = json.load(f)
+        try:
+            with open(p) as f:
+                entry = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError) as exc:
+            # A truncated/corrupt per-word file is a torn write from a killed
+            # run: quarantine it and recompute the word instead of letting
+            # one bad resume file abort the whole sweep.
+            resilience.quarantine_file(p, reason=f"unreadable entry: {exc}")
+            return None
         return entry if all(m in entry for m in modes) else None
 
     def done(w: str) -> bool:
@@ -70,21 +119,45 @@ def run_word_sweep(
         saved = load_done(word)
         if saved is not None:
             results[word] = saved
+            ledger.record_success(word)
             continue
-        params, cfg, tok = model_loader(word)
-        if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
-            memo_key, memo = (params, tok), {}
-        # next() stops at the first pending word — no full O(words²) rescan
-        # (and re-parse of every done word's JSON) per iteration.
-        nxt = next((w for w in words[i + 1:] if not done(w)), None)
-        if nxt is not None:
-            prefetch_next(model_loader, [word, nxt], 0)
-        entry: Dict[str, Any] = {}
-        for mode in modes:
-            if mode not in memo:
-                memo[mode] = compute_mode(params, cfg, tok, config, mode)
-            entry[mode] = score_word(config, word, mode, memo[mode])
-        results[word] = entry
+
+        stage = {"name": "checkpoint.load"}
+
+        def run_one() -> Dict[str, Any]:
+            nonlocal memo_key, memo
+            stage["name"] = "checkpoint.load"
+            params, cfg, tok = model_loader(word)
+            if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
+                memo_key, memo = (params, tok), {}
+            # next() stops at the first pending word — no full O(words²)
+            # rescan (and re-parse of every done word's JSON) per iteration.
+            nxt = next(
+                (w for w in words[i + 1:]
+                 if w not in ledger.quarantined and not done(w)), None)
+            if nxt is not None:
+                prefetch_next(model_loader, [word, nxt], 0)
+            entry: Dict[str, Any] = {}
+            for mode in modes:
+                stage["name"] = f"compute:{mode}"
+                if mode not in memo:
+                    memo[mode] = compute_mode(params, cfg, tok, config, mode)
+                entry[mode] = score_word(config, word, mode, memo[mode])
+            return entry
+
+        outcome = resilience.run_guarded(
+            word, run_one, policy=policy, ledger=ledger,
+            stage=lambda: stage["name"], sleep=sleep)
+        if not outcome.ok:
+            if fail_fast:
+                raise outcome.error
+            # Drop any stale prefetch state so the quarantined word's errored
+            # thread result cannot leak into a later retry/rerun.
+            drop = getattr(model_loader, "drop_pending", None)
+            if drop is not None:
+                drop(word)
+            continue
+        results[word] = outcome.value
         if output_dir:
-            _atomic_json_dump(entry, word_path(word))
-    return results
+            atomic_json_dump(outcome.value, word_path(word))
+    return SweepOutcome(results=results, ledger=ledger)

@@ -1,1 +1,3 @@
-"""Host-side runtime: cache IO, run manifests, checkpoint conversion."""
+"""Host-side runtime: cache IO, run manifests, checkpoint conversion, and
+the fault-tolerance subsystem (resilience: retries, watchdogs, failure
+ledger, deterministic fault injection)."""

@@ -21,9 +21,12 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import zipfile
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from taboo_brittleness_tpu.runtime import resilience
 
 
 def pair_paths(base_dir: str, word: str, prompt_idx: int, *, mkdir: bool = False) -> Tuple[str, str]:
@@ -71,9 +74,16 @@ def save_pair(
         arrays[resid_key] = residual_stream
     # Native parallel deflate for the GB-scale dump (falls back to numpy's
     # single-thread savez_compressed when the C++ writer is unavailable).
+    # Written tmp-then-rename: existence is the resume system's completion
+    # marker, so a crash mid-deflate must never leave a half-written pair
+    # that a later run trusts.
     from taboo_brittleness_tpu.runtime import native_io
 
-    native_io.save_npz(npz_path, arrays)
+    # (the ".npz"-suffixed tmp name matters: numpy's savez fallback appends
+    # ".npz" to any other name and the rename would miss the real file)
+    tmp = f"{npz_path}.tmp.npz"
+    native_io.save_npz(tmp, arrays)
+    os.replace(tmp, npz_path)
 
     meta: Dict[str, Any] = {
         "input_words": list(input_words),
@@ -82,8 +92,9 @@ def save_pair(
         "shapes": {k: list(v.shape) for k, v in arrays.items()},
         "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
     }
-    with open(json_path, "w") as f:
-        json.dump(meta, f)
+    resilience.atomic_json_dump(meta, json_path, indent=None)
+    resilience.fire("cache.write", path=npz_path)
+    resilience.fire("cache.write", path=json_path)
 
 
 @dataclasses.dataclass
@@ -149,7 +160,12 @@ def save_summary(path: str, summary: Dict[str, np.ndarray], meta: Dict[str, Any]
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays = {"__meta__": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
     arrays.update({k: np.asarray(v) for k, v in summary.items()})
-    native_io.save_npz(path, arrays)
+    # tmp-then-rename: a summary's existence marks its sweep cell done (the
+    # ".npz" tmp suffix keeps numpy's savez fallback from renaming it).
+    tmp = f"{path}.tmp.npz"
+    native_io.save_npz(tmp, arrays)
+    os.replace(tmp, path)
+    resilience.fire("cache.write", path=path)
 
 
 def load_summary(
@@ -165,3 +181,55 @@ def load_summary(
             names = [k for k in names if k in keys]
         arrays = {k: data[k] for k in names}
     return arrays, meta
+
+
+# ---------------------------------------------------------------------------
+# Validated resume: corrupt/truncated artifacts are quarantined (*.corrupt)
+# and reported missing, never trusted or fatal — a torn write from a killed
+# run costs one recomputed cell, not the study.
+# ---------------------------------------------------------------------------
+
+def _npz_readable(path: str) -> bool:
+    """Cheap integrity check: npz files are zip archives whose central
+    directory lives at the END of the file, so opening the directory (no
+    member decompression — GB-scale parity dumps stay untouched) catches
+    every truncation and most torn writes."""
+    try:
+        with zipfile.ZipFile(path) as z:
+            return bool(z.namelist())
+    except (zipfile.BadZipFile, OSError):
+        return False
+
+
+def verify_summary(path: str, *, quarantine: bool = True) -> bool:
+    """True iff the summary file exists and is structurally readable.  A
+    corrupt file is renamed ``*.corrupt`` (when ``quarantine``) so the cell
+    reads as not-done and recomputes."""
+    if not os.path.exists(path):
+        return False
+    if _npz_readable(path):
+        return True
+    if quarantine:
+        resilience.quarantine_file(path, reason="unreadable summary npz")
+    return False
+
+
+def verify_pair(base_dir: str, word: str, prompt_idx: int, *,
+                quarantine: bool = True) -> bool:
+    """True iff BOTH members of the (npz, json) pair exist and parse.  On
+    any corruption the whole pair is quarantined — a half-trusted pair
+    (readable npz, torn sidecar) must not count as done."""
+    npz_path, json_path = pair_paths(base_dir, word, prompt_idx, mkdir=False)
+    if not (os.path.exists(npz_path) and os.path.exists(json_path)):
+        return False
+    ok = _npz_readable(npz_path)
+    if ok:
+        try:
+            with open(json_path) as f:
+                json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError):
+            ok = False
+    if not ok and quarantine:
+        resilience.quarantine_file(npz_path, reason="corrupt pair")
+        resilience.quarantine_file(json_path, reason="corrupt pair")
+    return ok

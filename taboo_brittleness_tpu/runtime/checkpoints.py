@@ -31,6 +31,7 @@ from taboo_brittleness_tpu.models.params import (
     from_safetensors_dir,
     infer_config_from_hf_config_json,
 )
+from taboo_brittleness_tpu.runtime import resilience
 from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer, TokenizerLike
 
 
@@ -59,15 +60,27 @@ def resolve_snapshot_dir(repo_id: str, checkpoint_root: Optional[str] = None) ->
 
 
 class CheckpointManager:
-    """LRU cache of loaded (params, cfg, tokenizer) triples keyed by word."""
+    """LRU cache of loaded (params, cfg, tokenizer) triples keyed by word.
+
+    Failure semantics (``runtime.resilience``): with a ``retry_policy``,
+    transient load errors (interrupted safetensors reads, injected faults,
+    deadline overruns) retry with seeded exponential backoff; permanent ones
+    (missing snapshot/shard) raise immediately.  ``load_deadline`` watchdogs
+    each load attempt on a worker thread so a hung read becomes a retryable
+    :class:`~.resilience.DeadlineExceeded` instead of a silent stall.
+    """
 
     def __init__(self, model_cfg: ModelConfig, *,
                  checkpoint_root: Optional[str] = None, capacity: int = 1,
-                 mesh=None):
+                 mesh=None,
+                 retry_policy: Optional[resilience.RetryPolicy] = None,
+                 load_deadline: Optional[float] = None):
         self.model_cfg = model_cfg
         self.checkpoint_root = checkpoint_root
         self.capacity = max(1, capacity)
         self.mesh = mesh  # when set, params are placed per parallel.mesh policy
+        self.retry_policy = retry_policy
+        self.load_deadline = load_deadline
         self._cache: "OrderedDict[str, Tuple]" = OrderedDict()
         self._pending: Dict[str, threading.Thread] = {}
         self._pending_results: Dict[str, Tuple] = {}
@@ -76,6 +89,7 @@ class CheckpointManager:
         return self.model_cfg.checkpoint_template.format(word=word)
 
     def _load_triple(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
+        resilience.fire("checkpoint.read", word=word)
         snap = resolve_snapshot_dir(self.repo_id(word), self.checkpoint_root)
         cfg = infer_config_from_hf_config_json(
             snap, dtype=self.model_cfg.dtype, param_dtype=self.model_cfg.param_dtype)
@@ -87,6 +101,19 @@ class CheckpointManager:
         tok = HFTokenizer.from_pretrained(snap)
         return (params, cfg, tok)
 
+    def _load_guarded(self, word: str) -> Tuple:
+        """One load with the deadline watchdog applied; the retry wrapper
+        below composes around it (each attempt gets a fresh deadline)."""
+        return resilience.run_with_deadline(
+            lambda: self._load_triple(word), self.load_deadline,
+            stage=f"checkpoint.load:{word}")
+
+    def _load_with_retries(self, word: str) -> Tuple:
+        if self.retry_policy is None:
+            return self._load_guarded(word)
+        return self.retry_policy.call(
+            lambda: self._load_guarded(word), site=f"checkpoint.read:{word}")
+
     def prefetch(self, word: str) -> None:
         """Start loading ``word``'s checkpoint on a host thread.
 
@@ -95,20 +122,44 @@ class CheckpointManager:
         thread-safe); the next ``load(word)`` then joins the thread instead
         of doing the IO serially (VERDICT round-2 item 7: per-word sweep time
         was checkpoint-load + compute back-to-back).  Errors surface at
-        ``load`` time, not in the thread.
+        ``load`` time, not in the thread — and a transient prefetch error is
+        retried synchronously by ``load`` (the prefetch was an *attempt*,
+        not a verdict), so a flaky read never poisons ``_pending_results``.
         """
-        if word in self._cache or word in self._pending:
+        if word in self._cache:
             return
+        if word in self._pending:
+            # A finished-but-errored prefetch for a word nobody load()ed yet
+            # must not pin its stale error (or block a re-prefetch) forever:
+            # re-arm it.  A still-running or successful thread is left alone.
+            t = self._pending[word]
+            stale = (not t.is_alive()
+                     and word in self._pending_results
+                     and not self._pending_results[word][0])
+            if not stale:
+                return
+            self.drop_pending(word)
 
         def run():
             try:
+                resilience.fire("prefetch.thread", word=word)
                 self._pending_results[word] = (True, self._load_triple(word))
-            except BaseException as e:  # re-raised by load()
+            except BaseException as e:  # re-raised (or retried) by load()
                 self._pending_results[word] = (False, e)
 
         t = threading.Thread(target=run, name=f"prefetch-{word}", daemon=True)
         self._pending[word] = t
         t.start()
+
+    def drop_pending(self, word: str) -> None:
+        """Discard any pending prefetch state for ``word`` (joining its
+        thread): sweeps call this when a word is skipped or quarantined so a
+        stale thread result cannot leak into a later ``load`` of the same
+        word — the leak regression in tests/test_resilience.py."""
+        t = self._pending.pop(word, None)
+        if t is not None:
+            t.join()
+        self._pending_results.pop(word, None)
 
     def load(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
         if word in self._cache:
@@ -117,11 +168,19 @@ class CheckpointManager:
         if word in self._pending:
             self._pending.pop(word).join()
             ok, payload = self._pending_results.pop(word)
-            if not ok:
+            if ok:
+                triple = payload
+            elif (self.retry_policy is not None
+                    and resilience.is_transient(payload)):
+                # The failed prefetch counts as attempt 1; the policy owns
+                # the rest.  Surfacing the error as retryable (instead of
+                # raising the thread's exception verbatim) is what keeps one
+                # flaky IO from costing the word.
+                triple = self._load_with_retries(word)
+            else:
                 raise payload
-            triple = payload
         else:
-            triple = self._load_triple(word)
+            triple = self._load_with_retries(word)
         self._cache[word] = triple
         while len(self._cache) > self.capacity:
             # Drop oldest; its device buffers free once unreferenced (the

@@ -393,6 +393,12 @@ def generate(
     path) decode texts themselves afterwards (``decode_texts``), overlapping
     the tokenizer work with the device queue.
     """
+    # Named fault site (runtime.resilience): lets tests/ops arm launch-time
+    # failures without touching the traced decode itself.
+    from taboo_brittleness_tpu.runtime import resilience
+
+    resilience.fire("decode.launch", rows=len(prompts))
+
     rendered = []
     for i, p in enumerate(prompts):
         prefill = prefills[i] if prefills is not None else None

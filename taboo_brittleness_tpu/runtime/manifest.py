@@ -67,6 +67,12 @@ class RunManifest:
     stages: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     artifacts: List[str] = dataclasses.field(default_factory=list)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Resilience accounting (runtime.resilience.FailureLedger): words the run
+    # quarantined after exhausting retries, and per-word retry counts for
+    # words that eventually succeeded.  Empty blocks are omitted from the
+    # serialized manifest.
+    failures: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    retries: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @contextlib.contextmanager
     def stage(self, name: str, **meta: Any):
@@ -86,6 +92,13 @@ class RunManifest:
     def add_artifact(self, path: str) -> None:
         self.artifacts.append(path)
 
+    def record_resilience(self, ledger) -> None:
+        """Fold a :class:`~.resilience.FailureLedger` (or its dict form)
+        into the manifest's failures/retries blocks."""
+        data = ledger.to_dict() if hasattr(ledger, "to_dict") else dict(ledger)
+        self.failures.update(data.get("quarantined", {}))
+        self.retries.update(data.get("retried", {}))
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "run_id": self.run_id,
@@ -96,6 +109,8 @@ class RunManifest:
             "config": self.config,
             "stages": self.stages,
             "artifacts": self.artifacts,
+            **({"failures": self.failures} if self.failures else {}),
+            **({"retries": self.retries} if self.retries else {}),
             **({"extra": self.extra} if self.extra else {}),
         }
 

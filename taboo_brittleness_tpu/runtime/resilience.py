@@ -1,0 +1,593 @@
+"""Fault-tolerant sweep runtime: retries, deadlines, failure ledger, faults.
+
+The paper's core artifact is a 20-checkpoint x multi-prompt sweep grid whose
+cache *is* the checkpoint/resume story (``runtime/cache.py``, SURVEY.md §5) —
+but at production scale partial failure is the steady state, not the
+exception: a transient IO error mid-safetensors-stream, a corrupt resume
+file, or one missing shard must cost one retry or one word, never the study.
+This module makes failure handling a designed subsystem (the Sequoia stance:
+robustness as a first-class axis, arXiv:2402.12374) instead of an accident of
+whichever frame raised first:
+
+- :class:`RetryPolicy` — exponential backoff with *seeded* jitter and a
+  transient-vs-permanent error classification (:func:`is_transient`), so
+  retried runs are reproducible and permanent errors fail fast.
+- :class:`Deadline` / :func:`run_with_deadline` — watchdogs for host-side
+  stages (checkpoint load, decode launch): a hung IO thread becomes a
+  classified, retryable :class:`DeadlineExceeded` instead of a silent stall.
+- :class:`FailureLedger` — the per-sweep ``<output_dir>/_failures.json``
+  (atomic), recording per word: failing stage, attempt count, and the final
+  exception; sweeps return partial results plus this ledger and the CLI
+  exits non-zero iff it is non-empty.
+- :class:`FaultInjector` — a deterministic registry of named fault sites
+  (``checkpoint.read``, ``cache.write``, ``prefetch.thread``,
+  ``decode.launch``) that tests and the ``TABOO_FAULT_PLAN`` env hook can
+  arm with schedules (fail-N-then-succeed, always-fail, delay,
+  truncate-write).  Sites are no-ops when nothing is armed.
+
+Everything here is host-side control flow — none of it runs under trace
+(backoff sleeps and clocks would otherwise be baked into compiled programs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+# ---------------------------------------------------------------------------
+# Error taxonomy.
+# ---------------------------------------------------------------------------
+
+
+class InjectedFault(OSError):
+    """A deliberately injected *transient* fault (fault-injection harness)."""
+
+
+class InjectedPermanentFault(RuntimeError):
+    """A deliberately injected *permanent* fault — never retried."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """A host-side stage overran its watchdog deadline (classified
+    transient: a hung NFS read or wedged IO thread often succeeds on
+    retry)."""
+
+
+# OSErrors that retrying cannot fix: the filesystem object is missing or
+# forbidden, not flaky (a missing safetensors shard stays missing — there is
+# no hub egress in this environment).
+_PERMANENT_OS_ERRORS = (
+    FileNotFoundError,
+    NotADirectoryError,
+    IsADirectoryError,
+    PermissionError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient (worth retrying) vs permanent (fail fast / quarantine).
+
+    Transient: injected transient faults, deadline overruns, and IO-shaped
+    errors (``OSError`` family — interrupted reads, ``ETIMEDOUT``, connection
+    resets) EXCEPT the permanent subset above.  Everything else — value/shape
+    errors, missing keys, assertion failures — is a bug or a genuinely
+    missing artifact, and retrying would only replay it.
+    """
+    if isinstance(exc, InjectedPermanentFault):
+        return False
+    if isinstance(exc, (InjectedFault, DeadlineExceeded)):
+        return True
+    if isinstance(exc, _PERMANENT_OS_ERRORS):
+        return False
+    return isinstance(exc, (OSError, ConnectionError, TimeoutError))
+
+
+# ---------------------------------------------------------------------------
+# Atomic file helpers (shared by every pipeline — the skip-if-exists resume
+# logic treats existence as a completion marker, so no artifact may ever be
+# observable half-written).
+# ---------------------------------------------------------------------------
+
+
+def atomic_json_dump(obj: Any, path: str, *, indent: int = 2) -> None:
+    """Write-then-rename so a crash mid-write never leaves a truncated file."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+    os.replace(tmp, path)
+
+
+def quarantine_file(path: str, *, reason: str = "") -> Optional[str]:
+    """Rename a corrupt artifact to ``<path>.corrupt`` (never trusted, never
+    fatal): the resume logic then treats the cell as missing and recomputes,
+    while the bytes stay on disk for postmortem.  Returns the new path, or
+    None if the file had already vanished."""
+    if not os.path.exists(path):
+        return None
+    dst = f"{path}.corrupt"
+    try:
+        os.replace(path, dst)
+    except OSError:
+        return None
+    print(f"[resilience] quarantined corrupt file {path} -> {dst}"
+          + (f" ({reason})" if reason else ""), file=sys.stderr)
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with seeded jitter.
+
+    ``max_retries`` is the number of RE-tries: a call gets at most
+    ``max_retries + 1`` attempts.  Jitter is drawn from a ``random.Random``
+    seeded by ``(seed, site)``, so a given sweep's backoff schedule is
+    byte-reproducible (TBX006's determinism stance, applied to the host
+    control plane) while distinct sites still decorrelate.
+    """
+
+    max_retries: int = 2
+    base_delay: float = 0.5
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.25        # fraction of the delay, symmetric
+    seed: int = 0
+
+    def delays(self, site: str = "") -> Iterator[float]:
+        """The deterministic backoff schedule for one call site."""
+        rng = random.Random(f"{self.seed}:{site}")
+        delay = self.base_delay
+        for _ in range(self.max_retries):
+            jit = 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+            yield max(0.0, min(delay, self.max_delay) * jit)
+            delay *= self.multiplier
+
+    def call(
+        self,
+        fn: Callable[[], Any],
+        *,
+        site: str = "",
+        classify: Callable[[BaseException], bool] = is_transient,
+        sleep: Callable[[float], None] = time.sleep,
+        on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    ) -> Any:
+        """Run ``fn`` with retries on transient errors.
+
+        Permanent errors (per ``classify``) raise immediately; transient
+        errors consume the backoff schedule and re-raise once it is
+        exhausted.  ``on_retry(exc, attempt, delay)`` fires before each
+        backoff sleep (the ledger hook).  ``sleep`` is injectable so tests
+        never actually wait.
+        """
+        schedule = self.delays(site)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not classify(exc):
+                    raise
+                delay = next(schedule, None)
+                if delay is None:
+                    raise
+                if on_retry is not None:
+                    on_retry(exc, attempt, delay)
+                sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines / watchdogs.
+# ---------------------------------------------------------------------------
+
+
+class Deadline:
+    """Cooperative deadline for host-side stages: create with a budget, call
+    :meth:`check` at safe points.  Monotonic clock — wall-clock steps (NTP,
+    leap smears) can't fire or starve the watchdog."""
+
+    def __init__(self, seconds: float, *, stage: str = ""):
+        self.seconds = float(seconds)
+        self.stage = stage
+        self._end = time.monotonic() + self.seconds
+
+    def remaining(self) -> float:
+        return self._end - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self) -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"stage {self.stage or '<unnamed>'} exceeded its "
+                f"{self.seconds:.1f}s deadline")
+
+
+def run_with_deadline(
+    fn: Callable[[], Any],
+    timeout: Optional[float],
+    *,
+    stage: str = "",
+) -> Any:
+    """Run ``fn`` on a watchdog'd worker thread; raise
+    :class:`DeadlineExceeded` if it does not finish within ``timeout``
+    seconds.  ``timeout=None``/``<=0`` runs inline (no watchdog).
+
+    The overrun worker is daemonized and abandoned, not killed (Python
+    offers no safe cross-thread kill): callers pair this with
+    :class:`RetryPolicy`, so the classified timeout becomes a clean retry
+    while the wedged IO thread dies with the process.  JAX dispatch is
+    thread-safe, so checkpoint streaming / decode launch work unchanged on
+    the worker (the prefetch path already relies on this).
+    """
+    if timeout is None or timeout <= 0:
+        return fn()
+    result: Dict[str, Any] = {}
+
+    def run() -> None:
+        try:
+            result["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised on the caller
+            result["error"] = exc
+
+    t = threading.Thread(target=run, name=f"deadline-{stage or 'stage'}",
+                         daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise DeadlineExceeded(
+            f"stage {stage or '<unnamed>'} exceeded its {timeout:.1f}s "
+            "deadline (worker abandoned)")
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+# ---------------------------------------------------------------------------
+# Failure ledger.
+# ---------------------------------------------------------------------------
+
+LEDGER_FILENAME = "_failures.json"
+
+
+def _describe(exc: BaseException) -> Dict[str, Any]:
+    return {
+        "error_type": type(exc).__name__,
+        "error": str(exc),
+        "transient": is_transient(exc),
+    }
+
+
+class FailureLedger:
+    """Per-sweep failure record at ``<output_dir>/_failures.json`` (atomic).
+
+    - ``quarantined``: words whose final attempt failed — stage, attempt
+      count, and the final exception.  The sweep *continued* past them; the
+      CLI exits non-zero iff this block is non-empty.
+    - ``retried``: words that eventually succeeded but needed retries
+      (attempt counts) — the sweep's transient-noise floor, kept for the run
+      manifest.
+
+    A rerun loads the existing ledger and CLEARS a word's quarantine entry
+    when it finally succeeds, so the ledger always describes the current
+    state of the output directory, not the union of every past run.
+    """
+
+    def __init__(self, output_dir: Optional[str] = None, *,
+                 path: Optional[str] = None):
+        self.path = path or (os.path.join(output_dir, LEDGER_FILENAME)
+                             if output_dir else None)
+        self.quarantined: Dict[str, Dict[str, Any]] = {}
+        self.retried: Dict[str, int] = {}
+        if self.path and os.path.exists(self.path):
+            self._load_existing(self.path)
+
+    def _load_existing(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            self.quarantined = dict(prior.get("quarantined", {}))
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError) as exc:
+            # The ledger obeys its own rules: unparseable -> quarantine the
+            # file and start clean, never trust or crash.
+            quarantine_file(path, reason=f"unreadable ledger: {exc}")
+            self.quarantined = {}
+        # `retried` is per-run noise, not cross-run state: always reset.
+        self.retried = {}
+
+    def record_retry(self, word: str, stage: str, exc: BaseException,
+                     attempt: int) -> None:
+        self.retried[word] = attempt
+        self.save()
+
+    def record_quarantine(self, word: str, stage: str, exc: BaseException,
+                          attempts: int) -> None:
+        self.quarantined[word] = {
+            "stage": stage,
+            "attempts": attempts,
+            **_describe(exc),
+            # Epoch timestamp: serialized metadata for humans, not duration
+            # math (manifest wall_seconds owns durations).
+            "at": time.time(),
+        }
+        self.save()
+
+    def record_success(self, word: str) -> None:
+        """A word completed: clear any stale quarantine entry from a prior
+        run (resume semantics — the ledger describes what is MISSING now)."""
+        if word in self.quarantined:
+            del self.quarantined[word]
+            self.save()
+
+    def __bool__(self) -> bool:
+        return bool(self.quarantined)
+
+    @property
+    def words(self) -> List[str]:
+        return sorted(self.quarantined)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "quarantined": self.quarantined,
+            "retried": self.retried,
+        }
+
+    def save(self) -> None:
+        if self.path:
+            atomic_json_dump(self.to_dict(), self.path)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection.
+# ---------------------------------------------------------------------------
+
+#: The named fault sites threaded through the real paths.  Arming an unknown
+#: site is an error (a typo'd plan must fail loudly, not silently no-op).
+FAULT_SITES = (
+    "checkpoint.read",    # CheckpointManager._load_triple
+    "cache.write",        # runtime.cache save_pair / save_summary (post-write)
+    "prefetch.thread",    # CheckpointManager.prefetch worker
+    "decode.launch",      # runtime.decode.generate
+)
+
+_FAULT_MODES = ("fail", "delay", "truncate")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed schedule at one site.
+
+    - ``mode="fail"``: raise (``kind`` transient/permanent).
+    - ``mode="delay"``: sleep ``delay`` seconds (watchdog exercise).
+    - ``mode="truncate"``: truncate the file at the context's ``path`` to
+      half its size — a torn write, as seen by a later resume.
+    - ``times``: fire only on the first N *matching* calls
+      (fail-N-then-succeed); ``None`` fires every time (always-fail).
+    - ``match``: only fire when some context value (word, path, ...)
+      contains this substring; ``None`` matches every call.
+    """
+
+    mode: str = "fail"
+    times: Optional[int] = 1
+    kind: str = "transient"          # "transient" | "permanent"
+    delay: float = 0.0
+    match: Optional[str] = None
+    fired: int = 0                   # mutable call counter (determinism: the
+    #                                  schedule depends only on call order)
+
+    def __post_init__(self) -> None:
+        if self.mode not in _FAULT_MODES:
+            raise ValueError(
+                f"unknown fault mode {self.mode!r}; expected {_FAULT_MODES}")
+        if self.kind not in ("transient", "permanent"):
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; "
+                "expected 'transient' or 'permanent'")
+
+    def matches(self, context: Dict[str, Any]) -> bool:
+        if self.match is None:
+            return True
+        return any(self.match in str(v) for v in context.values())
+
+
+class FaultInjector:
+    """Deterministic registry of armed fault sites.
+
+    Tests arm programmatically (:meth:`arm`); operators arm via the
+    ``TABOO_FAULT_PLAN`` env var — either inline JSON or a path to a JSON
+    file — mapping site names to spec dicts (or lists of them)::
+
+        TABOO_FAULT_PLAN='{"checkpoint.read":
+            {"mode": "fail", "times": 2, "match": "ship"}}'
+
+    Firing is thread-safe (the prefetch site runs on worker threads) and
+    counts per spec in call order, so a plan replays identically run to run.
+    """
+
+    def __init__(self) -> None:
+        self._specs: Dict[str, List[FaultSpec]] = {}
+        self._lock = threading.Lock()
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, site: str, spec: Optional[FaultSpec] = None,
+            **kw: Any) -> FaultSpec:
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; known sites: {FAULT_SITES}")
+        spec = spec if spec is not None else FaultSpec(**kw)
+        with self._lock:
+            self._specs.setdefault(site, []).append(spec)
+        return spec
+
+    def clear(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(site, None)
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._specs)
+
+    @classmethod
+    def from_plan(cls, plan: Dict[str, Any]) -> "FaultInjector":
+        inj = cls()
+        for site, specs in plan.items():
+            if isinstance(specs, dict):
+                specs = [specs]
+            for spec in specs:
+                inj.arm(site, **spec)
+        return inj
+
+    @classmethod
+    def from_env(cls, env_var: str = "TABOO_FAULT_PLAN") -> "FaultInjector":
+        raw = os.environ.get(env_var, "").strip()
+        if not raw:
+            return cls()
+        if not raw.lstrip().startswith("{"):
+            with open(raw) as f:
+                raw = f.read()
+        return cls.from_plan(json.loads(raw))
+
+    # -- firing ------------------------------------------------------------
+
+    def fire(self, site: str, **context: Any) -> None:
+        """Evaluate ``site``'s armed schedules against ``context``; no-op
+        when nothing matches.  Raises / delays / truncates per the first
+        matching spec with shots remaining."""
+        with self._lock:
+            specs = list(self._specs.get(site, ()))
+            spec = None
+            for s in specs:
+                if not s.matches(context):
+                    continue
+                if s.times is not None and s.fired >= s.times:
+                    continue
+                s.fired += 1
+                spec = s
+                break
+        if spec is None:
+            return
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(context.items()))
+        label = f"{site}" + (f" [{detail}]" if detail else "")
+        if spec.mode == "delay":
+            time.sleep(spec.delay)
+            return
+        if spec.mode == "truncate":
+            path = context.get("path")
+            if path and os.path.exists(path):
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+            return
+        if spec.kind == "permanent":
+            raise InjectedPermanentFault(
+                f"injected permanent fault at {label}")
+        raise InjectedFault(f"injected transient fault at {label}")
+
+
+# Module-level default injector: lazily built from TABOO_FAULT_PLAN on first
+# use so `fire()` at the real sites costs one None-check when nothing is
+# armed (the common case — the sites live on hot-ish host paths).
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get_injector() -> FaultInjector:
+    global _injector
+    with _injector_lock:
+        if _injector is None:
+            _injector = FaultInjector.from_env()
+        return _injector
+
+
+def set_injector(injector: Optional[FaultInjector]) -> None:
+    """Install (or with None, reset-to-env) the process-wide injector —
+    the test hook."""
+    global _injector
+    with _injector_lock:
+        _injector = injector
+
+
+def fire(site: str, **context: Any) -> None:
+    """The sites' entry point: ``resilience.fire("checkpoint.read",
+    word=word)``.  Fast no-op unless a plan armed this site."""
+    inj = get_injector()
+    if not inj.armed:
+        return
+    inj.fire(site, **context)
+
+
+# ---------------------------------------------------------------------------
+# Sweep helper: retry-then-quarantine one unit of work.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WordOutcome:
+    """Result of :func:`run_guarded`: either ``value`` (success) or the
+    exception that exhausted the policy (quarantine)."""
+
+    word: str
+    value: Any = None
+    error: Optional[BaseException] = None
+    attempts: int = 1
+    stage: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+def run_guarded(
+    word: str,
+    fn: Callable[[], Any],
+    *,
+    policy: RetryPolicy,
+    ledger: Optional[FailureLedger] = None,
+    stage: Callable[[], str] = lambda: "run",
+    sleep: Callable[[float], None] = time.sleep,
+) -> WordOutcome:
+    """Run one word's work under ``policy``; on final failure return (not
+    raise) the error so the sweep can quarantine and continue.  ``stage`` is
+    a thunk so the caller can report which sub-stage was active when the
+    last attempt died.  Ledger updates (retries, quarantine, clears) happen
+    here so every sweep shares one bookkeeping path.
+    """
+    attempts = {"n": 1}
+
+    def on_retry(exc: BaseException, attempt: int, delay: float) -> None:
+        attempts["n"] = attempt + 1
+        if ledger is not None:
+            ledger.record_retry(word, stage(), exc, attempt)
+        print(f"[resilience] {word}: attempt {attempt} failed at "
+              f"{stage()} ({type(exc).__name__}: {exc}); retrying in "
+              f"{delay:.2f}s", file=sys.stderr)
+
+    try:
+        value = policy.call(fn, site=f"{stage()}:{word}", sleep=sleep,
+                            on_retry=on_retry)
+    except Exception as exc:  # noqa: BLE001 — quarantine, don't crash the sweep
+        if ledger is not None:
+            ledger.record_quarantine(word, stage(), exc, attempts["n"])
+        return WordOutcome(word=word, error=exc, attempts=attempts["n"],
+                           stage=stage())
+    if ledger is not None:
+        ledger.record_success(word)
+    return WordOutcome(word=word, value=value, attempts=attempts["n"],
+                       stage=stage())

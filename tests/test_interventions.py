@@ -770,8 +770,11 @@ def test_cross_word_pipelining_survives_next_word_load_failure(
             raise Crash("checkpoint gone")
         return params, cfg, tok
 
+    # fail_fast=True: the assertion is specifically that the failure
+    # resurfaces at word 2's own load (the default retry+quarantine path is
+    # covered by tests/test_sweep_resilience.py).
     with pytest.raises(Crash):
         iv.run_intervention_studies(
             config2, model_loader=loader, sae=sae, words=[WORD, "word2"],
-            output_dir=out_dir)
+            output_dir=out_dir, fail_fast=True)
     assert os_mod.path.exists(os_mod.path.join(out_dir, f"{WORD}.json"))

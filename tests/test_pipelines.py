@@ -62,10 +62,13 @@ def test_mid_sweep_crash_then_resume_matches_uninterrupted(tiny_setup, tmp_path)
             raise RuntimeError("simulated mid-sweep crash")
         return params, cfg, tok
 
+    # fail_fast restores the pre-resilience contract (raise on first failed
+    # word); the default now retries + quarantines and CONTINUES — that path
+    # is covered by tests/test_sweep_resilience.py.
     with pytest.raises(RuntimeError, match="simulated"):
         generation.run_generation(
             config, model_loader=crashing_loader, words=WORDS,
-            processed_dir=resumed)
+            processed_dir=resumed, fail_fast=True)
     # Word 1's cells survived the crash; word 2 never ran.
     for i in range(2):
         assert os.path.exists(cache_io.summary_path(resumed, WORDS[0], i))

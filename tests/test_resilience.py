@@ -1,0 +1,401 @@
+"""Unit tests for the fault-tolerance runtime (runtime/resilience.py):
+retry policy + classification, watchdog deadlines, failure ledger, the
+deterministic fault injector, and the CheckpointManager integration
+(prefetch-error recovery, stale-pending regression)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.runtime import resilience
+from taboo_brittleness_tpu.runtime.resilience import (
+    Deadline, DeadlineExceeded, FailureLedger, FaultInjector, FaultSpec,
+    InjectedFault, InjectedPermanentFault, RetryPolicy, run_with_deadline)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test gets a fresh process-wide injector (and leaves none)."""
+    resilience.set_injector(FaultInjector())
+    yield
+    resilience.set_injector(FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# Classification.
+# ---------------------------------------------------------------------------
+
+def test_error_classification():
+    assert resilience.is_transient(OSError("flaky read"))
+    assert resilience.is_transient(TimeoutError("slow"))
+    assert resilience.is_transient(ConnectionResetError("reset"))
+    assert resilience.is_transient(DeadlineExceeded("over budget"))
+    assert resilience.is_transient(InjectedFault("injected"))
+    # Permanent: missing/forbidden files, logic errors, injected-permanent.
+    assert not resilience.is_transient(FileNotFoundError("no shard"))
+    assert not resilience.is_transient(PermissionError("denied"))
+    assert not resilience.is_transient(ValueError("bad shape"))
+    assert not resilience.is_transient(KeyError("missing"))
+    assert not resilience.is_transient(InjectedPermanentFault("injected"))
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy.
+# ---------------------------------------------------------------------------
+
+def test_retry_transient_fail_n_then_succeed():
+    policy = RetryPolicy(max_retries=3, base_delay=0.01)
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert policy.call(flaky, sleep=slept.append) == "ok"
+    assert calls["n"] == 3
+    assert len(slept) == 2
+
+
+def test_retry_permanent_raises_immediately():
+    policy = RetryPolicy(max_retries=5, base_delay=0.01)
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        policy.call(broken, sleep=lambda d: None)
+    assert calls["n"] == 1
+
+
+def test_retry_exhaustion_reraises_last_error():
+    policy = RetryPolicy(max_retries=2, base_delay=0.01)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError(f"attempt {calls['n']}")
+
+    with pytest.raises(OSError, match="attempt 3"):
+        policy.call(always, sleep=lambda d: None)
+    assert calls["n"] == 3  # 1 try + 2 retries
+
+
+def test_backoff_is_exponential_jittered_and_seeded():
+    policy = RetryPolicy(max_retries=4, base_delay=1.0, multiplier=2.0,
+                         jitter=0.25, seed=7)
+    a = list(policy.delays("site"))
+    b = list(policy.delays("site"))
+    assert a == b  # deterministic given (seed, site)
+    assert a != list(policy.delays("other-site"))  # sites decorrelate
+    assert a != list(RetryPolicy(max_retries=4, base_delay=1.0,
+                                 multiplier=2.0, jitter=0.25,
+                                 seed=8).delays("site"))
+    # Exponential envelope with +-25% jitter around 1, 2, 4, 8.
+    for got, nominal in zip(a, (1.0, 2.0, 4.0, 8.0)):
+        assert 0.75 * nominal <= got <= 1.25 * nominal
+    # And jitter actually moved the values off the nominal schedule.
+    assert any(abs(got - nominal) > 1e-6
+               for got, nominal in zip(a, (1.0, 2.0, 4.0, 8.0)))
+
+
+def test_backoff_respects_max_delay():
+    policy = RetryPolicy(max_retries=6, base_delay=1.0, multiplier=10.0,
+                         max_delay=5.0, jitter=0.0)
+    assert max(policy.delays("s")) <= 5.0
+
+
+def test_on_retry_callback_sees_attempts_and_delays():
+    policy = RetryPolicy(max_retries=2, base_delay=0.01)
+    seen = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise OSError("x")
+        return 42
+
+    policy.call(flaky, sleep=lambda d: None,
+                on_retry=lambda exc, attempt, delay: seen.append(
+                    (type(exc).__name__, attempt, delay > 0)))
+    assert seen == [("OSError", 1, True)]
+
+
+# ---------------------------------------------------------------------------
+# Deadlines.
+# ---------------------------------------------------------------------------
+
+def test_run_with_deadline_passes_through_fast_fn():
+    assert run_with_deadline(lambda: "done", 5.0, stage="fast") == "done"
+    # None / 0 disables the watchdog entirely (inline execution).
+    assert run_with_deadline(lambda: "inline", None) == "inline"
+    assert run_with_deadline(lambda: "inline", 0) == "inline"
+
+
+def test_run_with_deadline_raises_on_overrun():
+    with pytest.raises(DeadlineExceeded, match="slow-stage"):
+        run_with_deadline(lambda: time.sleep(5.0), 0.05, stage="slow-stage")
+
+
+def test_run_with_deadline_propagates_worker_exception():
+    def boom():
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError):
+        run_with_deadline(boom, 5.0)
+
+
+def test_cooperative_deadline_check():
+    d = Deadline(60.0, stage="long")
+    d.check()  # plenty of budget: no raise
+    assert d.remaining() > 0
+    expired = Deadline(0.0, stage="none")
+    with pytest.raises(DeadlineExceeded):
+        expired.check()
+
+
+# ---------------------------------------------------------------------------
+# Failure ledger.
+# ---------------------------------------------------------------------------
+
+def test_ledger_records_and_persists_atomically(tmp_path):
+    out = str(tmp_path)
+    ledger = FailureLedger(out)
+    ledger.record_retry("ship", "checkpoint.load", OSError("flaky"), 1)
+    ledger.record_quarantine("moon", "compute:pregame",
+                             ValueError("bad"), attempts=3)
+    path = os.path.join(out, resilience.LEDGER_FILENAME)
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")  # atomic: no tmp left behind
+    with open(path) as f:
+        data = json.load(f)
+    assert data["retried"] == {"ship": 1}
+    q = data["quarantined"]["moon"]
+    assert q["stage"] == "compute:pregame"
+    assert q["attempts"] == 3
+    assert q["error_type"] == "ValueError"
+    assert q["transient"] is False
+    assert bool(ledger)
+    assert ledger.words == ["moon"]
+
+
+def test_ledger_resume_clears_on_success(tmp_path):
+    out = str(tmp_path)
+    FailureLedger(out).record_quarantine(
+        "moon", "study", OSError("x"), attempts=3)
+    # A new run loads the prior quarantine state...
+    ledger = FailureLedger(out)
+    assert "moon" in ledger.quarantined
+    # ...and clears it once the word finally succeeds.
+    ledger.record_success("moon")
+    assert not ledger
+    with open(os.path.join(out, resilience.LEDGER_FILENAME)) as f:
+        assert json.load(f)["quarantined"] == {}
+
+
+def test_ledger_quarantines_its_own_corrupt_file(tmp_path):
+    path = os.path.join(str(tmp_path), resilience.LEDGER_FILENAME)
+    with open(path, "w") as f:
+        f.write('{"quarantined": {"moon"')  # torn write
+    ledger = FailureLedger(str(tmp_path))
+    assert not ledger  # starts clean
+    assert os.path.exists(path + ".corrupt")
+
+
+# ---------------------------------------------------------------------------
+# Fault injector.
+# ---------------------------------------------------------------------------
+
+def test_injector_fail_n_then_succeed_schedule():
+    inj = FaultInjector()
+    inj.arm("checkpoint.read", mode="fail", times=2, match="ship")
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            inj.fire("checkpoint.read", word="ship")
+    inj.fire("checkpoint.read", word="ship")  # schedule exhausted: no-op
+    inj.fire("checkpoint.read", word="moon")  # never matched: no-op
+
+
+def test_injector_permanent_and_always_fail():
+    inj = FaultInjector()
+    inj.arm("decode.launch", mode="fail", kind="permanent", times=None)
+    for _ in range(3):
+        with pytest.raises(InjectedPermanentFault):
+            inj.fire("decode.launch")
+
+
+def test_injector_truncate_write(tmp_path):
+    path = str(tmp_path / "artifact.json")
+    with open(path, "wb") as f:
+        f.write(b"x" * 100)
+    inj = FaultInjector()
+    inj.arm("cache.write", mode="truncate", times=1)
+    inj.fire("cache.write", path=path)
+    assert os.path.getsize(path) == 50
+    inj.fire("cache.write", path=path)  # exhausted: untouched
+    assert os.path.getsize(path) == 50
+
+
+def test_injector_rejects_unknown_site_and_mode():
+    inj = FaultInjector()
+    with pytest.raises(ValueError, match="unknown fault site"):
+        inj.arm("no.such.site", mode="fail")
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        FaultSpec(mode="explode")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="sideways")
+
+
+def test_injector_from_env_plan(tmp_path, monkeypatch):
+    plan = {"checkpoint.read": {"mode": "fail", "times": 1, "match": "ship"},
+            "cache.write": [{"mode": "truncate", "times": 2}]}
+    # Inline JSON form.
+    monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps(plan))
+    inj = FaultInjector.from_env()
+    with pytest.raises(InjectedFault):
+        inj.fire("checkpoint.read", word="gemma-2-9b-it-taboo-ship")
+    # File form.
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
+    monkeypatch.setenv("TABOO_FAULT_PLAN", plan_path)
+    inj2 = FaultInjector.from_env()
+    with pytest.raises(InjectedFault):
+        inj2.fire("checkpoint.read", word="ship")
+    # Unset -> inert injector.
+    monkeypatch.delenv("TABOO_FAULT_PLAN")
+    assert not FaultInjector.from_env().armed
+
+
+def test_module_level_fire_is_noop_when_unarmed():
+    resilience.fire("decode.launch", rows=3)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Quarantine + atomic json helpers.
+# ---------------------------------------------------------------------------
+
+def test_quarantine_file_renames_and_tolerates_missing(tmp_path):
+    p = str(tmp_path / "entry.json")
+    with open(p, "w") as f:
+        f.write("{broken")
+    dst = resilience.quarantine_file(p, reason="test")
+    assert dst == p + ".corrupt"
+    assert not os.path.exists(p)
+    assert os.path.exists(dst)
+    assert resilience.quarantine_file(str(tmp_path / "gone.json")) is None
+
+
+def test_atomic_json_dump_roundtrip_and_no_tmp(tmp_path):
+    p = str(tmp_path / "nested" / "out.json")
+    resilience.atomic_json_dump({"a": [1, 2]}, p)
+    with open(p) as f:
+        assert json.load(f) == {"a": [1, 2]}
+    assert not os.path.exists(p + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager integration.
+# ---------------------------------------------------------------------------
+
+class _FlakyManager:
+    """A CheckpointManager with _load_triple stubbed: fail per plan."""
+
+    def __new__(cls, fails_by_word, loaded):
+        from taboo_brittleness_tpu.config import ModelConfig
+        from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
+
+        mgr = CheckpointManager(
+            ModelConfig(), retry_policy=RetryPolicy(max_retries=3,
+                                                    base_delay=0.0))
+
+        def load_triple(word):
+            loaded.append(word)
+            remaining = fails_by_word.get(word, 0)
+            if remaining:
+                fails_by_word[word] = remaining - 1
+                raise OSError(f"flaky load of {word}")
+            return (f"params-{word}", f"cfg-{word}", f"tok-{word}")
+
+        mgr._load_triple = load_triple
+        return mgr
+
+
+def test_manager_load_retries_transient_errors():
+    loaded = []
+    mgr = _FlakyManager({"ship": 2}, loaded)
+    assert mgr.load("ship")[0] == "params-ship"
+    assert loaded == ["ship", "ship", "ship"]
+
+
+def test_manager_prefetch_error_is_retried_at_load_not_raised():
+    """A transient prefetch failure must surface as a retryable load, not
+    poison _pending_results (the tentpole's prefetch contract)."""
+    loaded = []
+    mgr = _FlakyManager({"ship": 1}, loaded)
+    mgr.prefetch("ship")
+    mgr._pending["ship"].join()
+    assert mgr._pending_results["ship"][0] is False
+    # load() treats the failed prefetch as attempt 1 and retries.
+    assert mgr.load("ship")[0] == "params-ship"
+    assert loaded == ["ship", "ship"]
+    assert not mgr._pending and not mgr._pending_results
+
+
+def test_manager_permanent_prefetch_error_still_raises():
+    from taboo_brittleness_tpu.config import ModelConfig
+    from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
+
+    mgr = CheckpointManager(ModelConfig(),
+                            retry_policy=RetryPolicy(max_retries=3,
+                                                     base_delay=0.0))
+    mgr._load_triple = lambda word: (_ for _ in ()).throw(
+        FileNotFoundError("no snapshot"))
+    mgr.prefetch("ship")
+    with pytest.raises(FileNotFoundError):
+        mgr.load("ship")
+
+
+def test_manager_stale_errored_prefetch_does_not_leak_across_sweep():
+    """Regression (satellite): a word whose prefetch errored but that was
+    never load()ed must not pin its stale error — a later prefetch re-arms
+    and a later load succeeds with the fresh result."""
+    loaded = []
+    mgr = _FlakyManager({"ship": 1}, loaded)
+    mgr.prefetch("ship")
+    mgr._pending["ship"].join()          # errored, nobody load()s it
+    assert mgr._pending_results["ship"][0] is False
+    # The sweep skips/quarantines ship, moves on, then a rerun prefetches it
+    # again: the stale errored entry must be replaced, not returned early.
+    mgr.prefetch("ship")
+    mgr._pending["ship"].join()
+    assert mgr._pending_results["ship"][0] is True
+    assert mgr.load("ship")[0] == "params-ship"
+    assert not mgr._pending and not mgr._pending_results
+
+
+def test_manager_drop_pending_discards_thread_state():
+    loaded = []
+    mgr = _FlakyManager({"ship": 5}, loaded)
+    mgr.prefetch("ship")
+    mgr.drop_pending("ship")
+    assert not mgr._pending and not mgr._pending_results
+    mgr.drop_pending("never-prefetched")  # idempotent / unknown word ok
+
+
+def test_manager_load_deadline_classifies_hang_as_transient():
+    from taboo_brittleness_tpu.config import ModelConfig
+    from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
+
+    mgr = CheckpointManager(ModelConfig(), load_deadline=0.05)
+    mgr._load_triple = lambda word: time.sleep(5.0)
+    with pytest.raises(DeadlineExceeded) as ei:
+        mgr.load("ship")
+    assert resilience.is_transient(ei.value)

@@ -1,0 +1,356 @@
+"""End-to-end fault-tolerant sweeps on the tiny model (ISSUE 2 acceptance):
+with faults armed on 3/20 words (2 transient, 1 permanent) the sweep must
+complete the other 19, quarantine exactly the permanent failure with an
+accurate ``_failures.json``, exit non-zero at the CLI, and resume the done
+words on rerun without recomputation."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu import cli
+from taboo_brittleness_tpu.config import Config, ExperimentConfig, ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.pipelines import generation
+from taboo_brittleness_tpu.pipelines import token_forcing as tf
+from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+from taboo_brittleness_tpu.runtime import cache as cache_io
+from taboo_brittleness_tpu.runtime import resilience
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector, RetryPolicy
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+WORDS = [f"w{i:02d}" for i in range(20)]
+TRANSIENT = ["w03", "w11"]
+PERMANENT = "w07"
+
+# No-sleep policy: the schedules are still real (seeded, exponential), the
+# tests just never wait them out.
+FAST = RetryPolicy(max_retries=2, base_delay=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    resilience.set_injector(FaultInjector())
+    yield
+    resilience.set_injector(FaultInjector())
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    tok = WordTokenizer(WORDS + ["secret", "word", "is", "My", "hint"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=1, top_k=2, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        word_plurals={w: [w] for w in WORDS},
+        prompts=["Give me a hint"],
+    )
+    return params, cfg, tok, config
+
+
+def _arm_issue_faults():
+    """2 words fail twice transiently (recover within max_retries=2), one
+    word fails permanently — armed at the checkpoint.read site."""
+    inj = FaultInjector()
+    for w in TRANSIENT:
+        inj.arm("checkpoint.read", mode="fail", times=2, match=w)
+    inj.arm("checkpoint.read", mode="fail", kind="permanent", times=None,
+            match=PERMANENT)
+    resilience.set_injector(inj)
+    return inj
+
+
+def _counting_loader(tiny, loads):
+    params, cfg, tok, _ = tiny
+
+    def loader(word):
+        loads.append(word)
+        resilience.fire("checkpoint.read", word=word)
+        return params, cfg, tok
+
+    return loader
+
+
+def test_word_sweep_retries_quarantines_and_resumes(tiny, tmp_path):
+    """The acceptance scenario, driven through run_token_forcing (the real
+    run_word_sweep consumer)."""
+    params, cfg, tok, config = tiny
+    out_dir = str(tmp_path / "words")
+    loads = []
+    _arm_issue_faults()
+
+    res = tf.run_token_forcing(
+        config, model_loader=_counting_loader(tiny, loads), words=WORDS,
+        modes=("pregame",), output_dir=out_dir, retry_policy=FAST)
+
+    # 19 words completed, the permanent failure quarantined.
+    done = set(res["words"])
+    assert done == set(WORDS) - {PERMANENT}
+    for w in done:
+        assert os.path.exists(os.path.join(out_dir, f"{w}.json"))
+    assert not os.path.exists(os.path.join(out_dir, f"{PERMANENT}.json"))
+
+    # The transient words were retried to success (2 failures + 1 success
+    # each); the permanent word failed FAST — one attempt, no retries (a
+    # missing shard stays missing; burning the backoff budget on it would
+    # just slow the sweep down).
+    assert loads.count(TRANSIENT[0]) == 3
+    assert loads.count(TRANSIENT[1]) == 3
+    assert loads.count(PERMANENT) == 1
+
+    # _failures.json is accurate.
+    with open(os.path.join(out_dir, resilience.LEDGER_FILENAME)) as f:
+        ledger = json.load(f)
+    assert set(ledger["quarantined"]) == {PERMANENT}
+    entry = ledger["quarantined"][PERMANENT]
+    assert entry["stage"] == "checkpoint.load"
+    assert entry["attempts"] == 1
+    assert entry["error_type"] == "InjectedPermanentFault"
+    assert entry["transient"] is False
+    assert set(ledger["retried"]) == set(TRANSIENT)
+    assert res["failures"]["quarantined"].keys() == {PERMANENT}
+
+    # overall aggregates the words that finished (not NaN, not crash).
+    assert 0.0 <= res["overall"]["pregame"] <= 1.0
+
+    # Rerun with faults cleared: the 19 done words resume WITHOUT
+    # recomputation (their models never load), the quarantined word runs
+    # and its ledger entry clears.
+    resilience.set_injector(FaultInjector())
+    loads.clear()
+    res2 = tf.run_token_forcing(
+        config, model_loader=_counting_loader(tiny, loads), words=WORDS,
+        modes=("pregame",), output_dir=out_dir, retry_policy=FAST)
+    assert loads == [PERMANENT]
+    assert set(res2["words"]) == set(WORDS)
+    assert "failures" not in res2
+    with open(os.path.join(out_dir, resilience.LEDGER_FILENAME)) as f:
+        assert json.load(f)["quarantined"] == {}
+
+
+def test_word_sweep_fail_fast_aborts_on_first_quarantine(tiny, tmp_path):
+    params, cfg, tok, config = tiny
+    _arm_issue_faults()
+    with pytest.raises(resilience.InjectedPermanentFault):
+        tf.run_token_forcing(
+            config, model_loader=_counting_loader(tiny, []), words=WORDS,
+            modes=("pregame",), output_dir=str(tmp_path / "words"),
+            retry_policy=FAST, fail_fast=True)
+
+
+def test_corrupt_word_json_is_quarantined_and_recomputed(tiny, tmp_path):
+    """Satellite: a truncated <word>.json must read as not-done (quarantined
+    to *.corrupt, warned, recomputed) instead of raising JSONDecodeError."""
+    params, cfg, tok, config = tiny
+    out_dir = str(tmp_path / "words")
+    words = WORDS[:3]
+    loader = _counting_loader(tiny, [])
+    tf.run_token_forcing(config, model_loader=loader, words=words,
+                         modes=("pregame",), output_dir=out_dir,
+                         retry_policy=FAST)
+
+    # Tear one word's resume file.
+    torn = os.path.join(out_dir, f"{words[1]}.json")
+    with open(torn, "w") as f:
+        f.write('{"pregame": {"succ')
+
+    loads = []
+    res = tf.run_token_forcing(
+        config, model_loader=_counting_loader(tiny, loads), words=words,
+        modes=("pregame",), output_dir=out_dir, retry_policy=FAST)
+    assert loads == [words[1]]                      # only the torn word reran
+    assert os.path.exists(torn + ".corrupt")        # original preserved
+    assert set(res["words"]) == set(words)
+    with open(torn) as f:
+        assert "pregame" in json.load(f)            # recomputed cleanly
+
+
+def test_run_word_sweep_outcome_contract(tiny, tmp_path):
+    """run_word_sweep itself returns partial results + the ledger."""
+    params, cfg, tok, config = tiny
+    _arm_issue_faults()
+    outcome = run_word_sweep(
+        config, model_loader=_counting_loader(tiny, []), words=WORDS,
+        modes=("m",),
+        compute_mode=lambda p, c, t, cf, m: "payload",
+        score_word=lambda cf, w, m, payload: {"word": w},
+        output_dir=str(tmp_path / "words"), retry_policy=FAST)
+    assert not outcome.ok
+    assert set(outcome.results) == set(WORDS) - {PERMANENT}
+    assert set(outcome.quarantined) == {PERMANENT}
+
+
+def test_generation_quarantines_and_resumes_with_validated_cache(
+        tiny, tmp_path):
+    """run_generation: permanent checkpoint fault -> word quarantined, grid
+    continues; a truncated summary npz is quarantined on resume and ONLY
+    that cell recomputes."""
+    params, cfg, tok, config = tiny
+    processed = str(tmp_path / "processed")
+    words = WORDS[:4]
+    inj = FaultInjector()
+    inj.arm("checkpoint.read", mode="fail", kind="permanent", times=None,
+            match=words[2])
+    resilience.set_injector(inj)
+
+    done = generation.run_generation(
+        config, model_loader=_counting_loader(tiny, []), words=words,
+        processed_dir=processed, retry_policy=FAST)
+    assert set(done) == set(words) - {words[2]}
+    with open(os.path.join(processed, resilience.LEDGER_FILENAME)) as f:
+        assert set(json.load(f)["quarantined"]) == {words[2]}
+
+    # Truncate one finished cell's summary npz (torn write simulation).
+    spath = cache_io.summary_path(processed, words[0], 0)
+    size = os.path.getsize(spath)
+    with open(spath, "r+b") as f:
+        f.truncate(size // 2)
+
+    resilience.set_injector(FaultInjector())
+    done2 = generation.run_generation(
+        config, model_loader=_counting_loader(tiny, []), words=words,
+        processed_dir=processed, retry_policy=FAST)
+    # The torn cell (and the quarantined word's cells) recomputed; every
+    # other cell resumed.
+    assert done2[words[0]] == [0]
+    assert done2[words[2]] == [0]
+    assert done2[words[1]] == []
+    assert os.path.exists(spath + ".corrupt")
+    assert cache_io.verify_summary(spath)
+
+
+def test_truncate_fault_plus_validated_resume_roundtrip(tiny, tmp_path):
+    """Arm the cache.write truncate fault: the torn artifact is caught by
+    the validated resume (quarantined + recomputed), closing the loop
+    between the injector and the resume story."""
+    params, cfg, tok, config = tiny
+    processed = str(tmp_path / "processed")
+    inj = FaultInjector()
+    inj.arm("cache.write", mode="truncate", times=1)
+    resilience.set_injector(inj)
+    generation.run_generation(
+        config, model_loader=_counting_loader(tiny, []), words=WORDS[:1],
+        processed_dir=processed, retry_policy=FAST)
+    spath = cache_io.summary_path(processed, WORDS[0], 0)
+    assert os.path.exists(spath)
+
+    resilience.set_injector(FaultInjector())
+    done = generation.run_generation(
+        config, model_loader=_counting_loader(tiny, []), words=WORDS[:1],
+        processed_dir=processed, retry_policy=FAST)
+    assert done[WORDS[0]] == [0]                     # recomputed, not trusted
+    assert os.path.exists(spath + ".corrupt")
+    arrays, meta = cache_io.load_summary(spath)      # the fresh cell loads
+    assert meta["word"] == WORDS[0]
+    assert arrays["target_prob"].dtype == np.float32
+
+
+def test_cache_write_leaves_no_tmp_files(tiny, tmp_path):
+    """Satellite: save_pair / save_summary are tmp+rename atomic."""
+    params, cfg, tok, config = tiny
+    processed = str(tmp_path / "processed")
+    # Summary first (a pre-existing pair would satisfy the summary-mode
+    # cache check and skip the summary write), parity pair second.
+    generation.generate_for_word(
+        params, cfg, tok, config, WORDS[0], processed_dir=processed)
+    generation.generate_for_word(
+        params, cfg, tok, config, WORDS[0],
+        processed_dir=processed, parity_dump=True)
+    leftovers = [
+        os.path.join(root, name)
+        for root, _, names in os.walk(processed)
+        for name in names if ".tmp" in name
+    ]
+    assert leftovers == []
+    # And both artifact forms verify.
+    assert cache_io.verify_pair(processed, WORDS[0], 0)
+    assert cache_io.verify_summary(cache_io.summary_path(processed, WORDS[0], 0))
+
+
+def test_intervention_studies_quarantine_and_continue(tiny, tmp_path):
+    """The studies driver (its own loop, not run_word_sweep) shares the
+    retry/quarantine contract: one permanently failing word is ledgered and
+    the study continues; the rerun resumes the finished words."""
+    import dataclasses as dc
+
+    from taboo_brittleness_tpu.config import InterventionConfig
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+
+    params, cfg, tok, config = tiny
+    config2 = dc.replace(
+        config,
+        intervention=InterventionConfig(budgets=(1,), random_trials=1,
+                                        ranks=(1,), spike_top_k=2))
+    sae = sae_ops.init_random(jax.random.PRNGKey(5), cfg.hidden_size, 16)
+    out_dir = str(tmp_path / "studies")
+    words = [WORDS[0], PERMANENT, WORDS[2]]
+    inj = FaultInjector()
+    inj.arm("checkpoint.read", mode="fail", kind="permanent", times=None,
+            match=PERMANENT)
+    resilience.set_injector(inj)
+
+    loads = []
+    out = iv.run_intervention_studies(
+        config2, model_loader=_counting_loader(tiny, loads), sae=sae,
+        words=words, output_dir=out_dir, retry_policy=FAST)
+    assert set(out) == {WORDS[0], WORDS[2]}
+    for w in (WORDS[0], WORDS[2]):
+        assert os.path.exists(os.path.join(out_dir, f"{w}.json"))
+    with open(os.path.join(out_dir, resilience.LEDGER_FILENAME)) as f:
+        ledger = json.load(f)
+    assert set(ledger["quarantined"]) == {PERMANENT}
+
+    # Rerun, faults cleared: done words resume without loading their models.
+    resilience.set_injector(FaultInjector())
+    loads.clear()
+    out2 = iv.run_intervention_studies(
+        config2, model_loader=_counting_loader(tiny, loads), sae=sae,
+        words=words, output_dir=out_dir, retry_policy=FAST)
+    assert loads == [PERMANENT]
+    assert set(out2) == set(words)
+    with open(os.path.join(out_dir, resilience.LEDGER_FILENAME)) as f:
+        assert json.load(f)["quarantined"] == {}
+
+
+def test_cli_token_forcing_exits_nonzero_on_quarantine(tiny, tmp_path,
+                                                       monkeypatch):
+    """The CLI contract: exit code is non-zero iff words were quarantined,
+    and the run manifest carries the failures/retries blocks."""
+    params, cfg, tok, config = tiny
+    _arm_issue_faults()
+    monkeypatch.setattr(cli, "_load", lambda args: config)
+    monkeypatch.setattr(cli, "_mesh", lambda c: None)
+    monkeypatch.setattr(cli, "_loader",
+                        lambda c, a, mesh=None: _counting_loader(tiny, []))
+
+    # Inject the no-sleep policy so the CLI run retries without waiting out
+    # real backoff delays (everything else flows through the real pipeline).
+    orig_tf = tf.run_token_forcing
+
+    def fast_tf(*a, **kw):
+        kw.setdefault("retry_policy", FAST)
+        return orig_tf(*a, **kw)
+
+    monkeypatch.setattr(tf, "run_token_forcing", fast_tf)
+    monkeypatch.chdir(tmp_path)
+
+    rc = cli.main(["token-forcing", "--modes", "pregame",
+                   "--words", *WORDS])
+    assert rc == 1
+    with open(tmp_path / "results" / "token_forcing" / "run_manifest.json") as f:
+        manifest = json.load(f)
+    assert set(manifest["failures"]) == {PERMANENT}
+    assert set(manifest["retries"]) == set(TRANSIENT)
+
+    # Rerun with no faults resumes and exits 0.
+    resilience.set_injector(FaultInjector())
+    assert cli.main(["token-forcing", "--modes", "pregame",
+                     "--words", *WORDS]) == 0

@@ -95,10 +95,14 @@ def test_run_token_forcing_resumable(setup, tmp_path):
         model=config.model, experiment=config.experiment,
         word_plurals={WORD: [WORD], "word2": ["word2"]},
         prompts=config.prompts, token_forcing=config.token_forcing)
+    # fail_fast=True: this test simulates a hard mid-sweep CRASH (process
+    # death), so the failure must propagate; the default retry+quarantine
+    # path is covered by tests/test_sweep_resilience.py.
     with pytest.raises(Crash):
         tf.run_token_forcing(
             config2, model_loader=crashing_loader, words=[WORD, "word2"],
-            modes=("pregame",), output_path=out, output_dir=words_dir)
+            modes=("pregame",), output_path=out, output_dir=words_dir,
+            fail_fast=True)
     # The completed word's JSON survived the crash; the aggregate did not
     # (it writes last) — but nothing is truncated/corrupt.
     assert os.path.exists(os.path.join(words_dir, f"{WORD}.json"))
