@@ -116,10 +116,62 @@ def _entry_greedy_decode():
     return fn, (params, ids, valid, pos)
 
 
+def _entry_residual_measure():
+    # The sweep's readout program — PR-3's AOT-warm-started hot path (one
+    # vocab-width lens readout per row; the f32 probability slab must stay
+    # transient inside each lax.map chunk).
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, T = 2, 6
+    residual = jax.ShapeDtypeStruct((B, T, cfg.hidden_size), jnp.float32)
+    seqs = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    mask = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+    tgt = jax.ShapeDtypeStruct((B,), jnp.int32)
+
+    def fn(p, r, s, m, t):
+        return iv._residual_measure(p, cfg, r, s, m, t, top_k=3, resp_start=1)
+
+    return fn, (params, residual, seqs, mask, tgt)
+
+
+def _entry_nll_cached():
+    # The sweep's ΔNLL program (prefill-KV continuation) — the third
+    # AOT-warm-started production program.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, T, s = 2, 6, 2
+    kv = jax.ShapeDtypeStruct(
+        (cfg.num_layers, B, s, cfg.num_kv_heads, cfg.head_dim),
+        jnp.bfloat16)
+    cache_valid = jax.ShapeDtypeStruct((B, s), jnp.bool_)
+    seqs = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    valid = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+    pos = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    nmask = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+
+    def fn(p, ck, cv, cval, sq, vl, ps, nm):
+        return iv._nll_cached_jit(p, cfg, ck, cv, cval, sq, vl, ps, nm,
+                                  resp_start=s)
+
+    return fn, (params, kv, kv, cache_valid, seqs, valid, pos, nmask)
+
+
 ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
     ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
     ("runtime.decode.greedy_decode", _entry_greedy_decode),
+    ("pipelines.interventions._residual_measure", _entry_residual_measure),
+    ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
 ]
 
 

@@ -56,6 +56,25 @@ class LensTap(NamedTuple):
     topk_probs: jax.Array
 
 
+def _lens_logits(
+    params: Params,
+    cfg: Gemma2Config,
+    h: jax.Array,
+    *,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """f32 lens logits: lm_head(final_norm(h)), optionally softcapped."""
+    x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    logits = x @ params["embed"].astype(cfg.compute_dtype).T
+    # tbx: f32-ok — lens softmax must run in f32 (bf16 renormalization skews
+    # the tiny target probs); the [B, T, V] tensor lives only inside one scan
+    # step and XLA fuses the reduction into the unembed epilogue.
+    logits = logits.astype(jnp.float32)
+    if logit_softcap is not None:
+        logits = softcap(logits, logit_softcap)
+    return logits
+
+
 def lens_probs(
     params: Params,
     cfg: Gemma2Config,
@@ -71,15 +90,32 @@ def lens_probs(
     ``Gemma2ForCausalLM.forward`` *outside* ``lm_head`` — so the reference lens
     distribution is over bare logits.  Pass ``logit_softcap`` to opt into the
     capped variant (matches the model's actual final-logit path, ``unembed``)."""
-    x = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    logits = x @ params["embed"].astype(cfg.compute_dtype).T
-    # tbx: f32-ok — lens softmax must run in f32 (bf16 renormalization skews
-    # the tiny target probs); the [B, T, V] tensor lives only inside one scan
-    # step and XLA fuses the reduction into the unembed epilogue.
-    logits = logits.astype(jnp.float32)
-    if logit_softcap is not None:
-        logits = softcap(logits, logit_softcap)
+    logits = _lens_logits(params, cfg, h, logit_softcap=logit_softcap)
     return jax.nn.softmax(logits, axis=-1)
+
+
+def lens_probs_foldexp(
+    params: Params,
+    cfg: Gemma2Config,
+    h: jax.Array,
+    *,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """:func:`lens_probs` normalized as ``exp(logit - logsumexp)`` instead of
+    ``jax.nn.softmax``.
+
+    Same math (softmax IS exp(l - lse)), different op schedule: softmax lowers
+    to max-subtract / exp / sum / **divide**, where the divide is one more
+    full [*, V] elementwise pass over the probability slab; the lse form lets
+    XLA fold the subtract+exp into whatever consumes the probabilities (the
+    readout's masked positional sum), skipping that pass.  Per-element results
+    differ only in final rounding (one fused ``exp(l-lse)`` vs ``exp(l-max)/
+    sum``), which is why the hot readout path adopts it behind a variant
+    switch (``interventions._residual_measure``) while the reference-parity
+    lens taps keep byte-stable ``softmax``."""
+    logits = _lens_logits(params, cfg, h, logit_softcap=logit_softcap)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    return jnp.exp(logits - lse)
 
 
 def make_lens_tap(

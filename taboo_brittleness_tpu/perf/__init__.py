@@ -1,0 +1,9 @@
+"""Performance accounting: per-phase roofline ceilings (``perf.roofline``).
+
+The sweep's hot path is a mix of bounds — decode is HBM-bandwidth-bound
+(weights + KV stream per generated token), readout/NLL are matmul-bound
+(vocab-width unembeds) — so one blended MFU number cannot say whether any
+phase is near the hardware.  This package computes each phase's OWN ceiling
+and the achieved fraction of it; ``bench.py`` publishes the result in
+``results/bench_detail.json`` (``sweep.phase_roofline``).
+"""

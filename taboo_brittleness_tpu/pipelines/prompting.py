@@ -21,7 +21,6 @@ word list; real per-word checkpoints recompute.
 
 from __future__ import annotations
 
-import os  # noqa: F401  (kept: output_path dirname use below)
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -41,6 +40,21 @@ def _mode_prompts(config: Config, mode: str) -> List[str]:
     if mode == "adversarial":
         return list(config.prompting.adversarial_prompts)
     raise ValueError(f"unknown prompting mode {mode!r}; expected {MODES}")
+
+
+def prompt_provenance(config: Config, mode: str) -> str:
+    """Provenance marker stamped into every prompting result JSON: the
+    shipped prompt lists are documented STAND-INS for the paper's appendix
+    sets (not extractable offline), so numbers computed from them must not
+    be read as paper-comparable Table-1 rows (ADVICE round 5).  A YAML
+    override (``prompting:`` section) is labeled as such instead."""
+    from taboo_brittleness_tpu import config as config_mod
+
+    default = (config_mod.NAIVE_PROMPTS if mode == "naive"
+               else config_mod.ADVERSARIAL_PROMPTS)
+    return ("representative stand-ins (not the paper's appendix prompts)"
+            if _mode_prompts(config, mode) == list(default)
+            else "user-supplied (yaml prompting: override)")
 
 
 def _attack_responses(
@@ -67,6 +81,7 @@ def score_prompting(config: Config, word: str, mode: str,
     return {
         "word": word,
         "mode": mode,
+        "prompt_provenance": prompt_provenance(config, mode),
         "success_rate": float(np.mean(leaks)) if leaks else 0.0,
         "pass_at_k": float(any(leaks)),
         "responses": list(responses),
@@ -126,7 +141,11 @@ def run_prompting_attacks(
         }
         for mode in modes
     }
-    out = {"overall": overall, "words": results}
+    out = {
+        "overall": overall,
+        "prompt_provenance": {m: prompt_provenance(config, m) for m in modes},
+        "words": results,
+    }
     if not outcome.ok or outcome.ledger.retried:
         # Same contract as run_token_forcing: quarantines drive the exit
         # code, retried-to-success counts ride along for the manifest.

@@ -392,10 +392,16 @@ def generate(
     enqueue more device programs behind the decode (the sweep measurement
     path) decode texts themselves afterwards (``decode_texts``), overlapping
     the tokenizer work with the device queue.
+
+    Single-device launches route through the AOT program registry
+    (``runtime.aot``): a warm-started/deserialized executable for this exact
+    signature runs without re-tracing; anything else falls back to the plain
+    jit call.  Sharded launches (``input_sharding``) always take the jit path
+    — executables are specialized to input shardings.
     """
     # Named fault site (runtime.resilience): lets tests/ops arm launch-time
     # failures without touching the traced decode itself.
-    from taboo_brittleness_tpu.runtime import resilience
+    from taboo_brittleness_tpu.runtime import aot, resilience
 
     resilience.fire("decode.launch", rows=len(prompts))
 
@@ -419,15 +425,22 @@ def generate(
             return arr
         return jax.device_put(arr, input_sharding)
 
-    result = greedy_decode(
-        params, cfg,
-        place(padded), place(valid), place(positions),
-        max_new_tokens=max_new_tokens,
-        edit_fn=edit_fn,
-        edit_params=edit_params,
-        decode_edit=decode_edit,
-        capture_residual_layer=capture_residual_layer,
-        return_prefill_cache=return_prefill_cache,
+    result = aot.dispatch(
+        "decode", greedy_decode,
+        dynamic=dict(
+            params=params,
+            prompt_ids=place(padded), prompt_valid=place(valid),
+            prompt_positions=place(positions),
+            edit_params=edit_params,
+        ),
+        static=dict(
+            cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
+            decode_edit=decode_edit,
+            stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+            capture_residual_layer=capture_residual_layer,
+            return_prefill_cache=return_prefill_cache,
+        ),
+        route=input_sharding is None,
     )
     texts = decode_texts(tok, result) if return_texts else None
     return result, texts, ids

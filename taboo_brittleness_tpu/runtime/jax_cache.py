@@ -1,4 +1,4 @@
-"""Persistent XLA compilation cache (default on).
+"""Persistent XLA compilation cache (default on) + AOT executable store.
 
 The sweep's compiled programs are large (the 330-row decode compiles in
 minutes on the remote helper) and keyed on stable shapes, so recompiling
@@ -13,13 +13,35 @@ serialized executable, not a local-only artifact.  JAX keys entries on the
 program, compile options, and backend, so a runtime upgrade simply misses
 and recompiles.
 
-Opt out with ``TBX_COMPILE_CACHE=0``; relocate with ``TBX_COMPILE_CACHE_DIR``.
+The compile cache removes the *compile* from a warm process but not the
+*Python tracing* (~6 warm words of study time, VERDICT r05 weak #6).
+:class:`AotStore` closes that half: whole compiled executables
+(``jax.experimental.serialize_executable``) persist under the same cache
+root, keyed on (backend, device kind, jax version, package-source hash,
+program signature), so a warm process skips tracing AND compiling — see
+``runtime/aot.py`` for the registry that loads them.  A source-tree edit
+changes the hash and cleanly invalidates every stored program.
+
+Opt out with ``TBX_COMPILE_CACHE=0`` (compile cache) / ``TBX_AOT_CACHE=0``
+(executable store); relocate with ``TBX_CACHE_ROOT`` (both) or
+``TBX_COMPILE_CACHE_DIR`` / ``TBX_AOT_CACHE_DIR`` (each).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Optional
+import pickle
+import re
+import sys
+import tempfile
+from typing import Any, Optional
+
+
+def cache_root() -> str:
+    """The one on-disk cache root every persistent artifact lives under."""
+    return (os.environ.get("TBX_CACHE_ROOT")
+            or os.path.expanduser("~/.cache/taboo_brittleness_tpu"))
 
 
 def enable(path: Optional[str] = None) -> Optional[str]:
@@ -31,7 +53,7 @@ def enable(path: Optional[str] = None) -> Optional[str]:
     if os.environ.get("TBX_COMPILE_CACHE", "1") == "0":
         return None
     path = (path or os.environ.get("TBX_COMPILE_CACHE_DIR")
-            or os.path.expanduser("~/.cache/taboo_brittleness_tpu/jax"))
+            or os.path.join(cache_root(), "jax"))
     import jax
 
     try:
@@ -44,8 +66,6 @@ def enable(path: Optional[str] = None) -> Optional[str]:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_compilation_cache_dir", path)
     except (OSError, AttributeError) as e:   # unwritable dir / old jax
-        import sys
-
         try:
             jax.config.update("jax_compilation_cache_dir", None)
         except Exception:  # noqa: BLE001 — best-effort revert
@@ -53,3 +73,136 @@ def enable(path: Optional[str] = None) -> Optional[str]:
         print(f"[jax-cache] disabled: {e}", file=sys.stderr)
         return None
     return path
+
+
+# ---------------------------------------------------------------------------
+# AOT executable store.
+# ---------------------------------------------------------------------------
+
+_SOURCE_HASH: Optional[str] = None
+
+
+def source_fingerprint() -> str:
+    """Hash of every .py file in the package — the AOT store's invalidation
+    salt.  A stored executable embeds the traced program; any source edit
+    could change what a fresh trace would produce, so any source edit must
+    miss (stale-executable reuse would silently run OLD code)."""
+    global _SOURCE_HASH
+    if _SOURCE_HASH is None:
+        import taboo_brittleness_tpu as pkg
+
+        root = os.path.dirname(os.path.abspath(pkg.__file__))
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                h.update(os.path.relpath(p, root).encode())
+                try:
+                    with open(p, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    h.update(b"<unreadable>")
+        _SOURCE_HASH = h.hexdigest()
+    return _SOURCE_HASH
+
+
+def _sanitize(s: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", s)
+
+
+class AotStore:
+    """Pickle-on-disk store of serialized XLA executables.
+
+    Layout: ``<root>/aot/<backend>-<device kind>-jax<version>-<src hash>/
+    <program>-<signature>.pkl`` — every axis that could make a stored
+    executable wrong for this process is in the directory name, so a
+    mismatched store can only MISS, never serve a stale program.
+
+    All failures degrade to a miss (load) or a skipped write (save) with one
+    stderr note: the store is an accelerator, never a correctness dependency.
+    Backends whose executables don't support serialization (raise on
+    ``serialize``) simply never populate it.
+    """
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.enabled = os.environ.get("TBX_AOT_CACHE", "1") != "0"
+        self._warned = False
+        self.dir: Optional[str] = None
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            kind = "cpu"
+            devs = jax.devices()
+            if devs:
+                kind = getattr(devs[0], "device_kind", "cpu") or "cpu"
+            tag = _sanitize(f"{jax.default_backend()}-{kind}-jax{jax.__version__}"
+                            f"-{source_fingerprint()[:12]}")
+            base = (path or os.environ.get("TBX_AOT_CACHE_DIR")
+                    or os.path.join(cache_root(), "aot"))
+            self.dir = os.path.join(base, tag)
+            os.makedirs(self.dir, exist_ok=True)
+        except Exception as e:  # noqa: BLE001 — never a hard failure
+            self._warn(f"store disabled: {e}")
+            self.enabled = False
+            self.dir = None
+
+    def _warn(self, msg: str) -> None:
+        if not self._warned:
+            print(f"[aot-store] {msg}", file=sys.stderr)
+            self._warned = True
+
+    def _path(self, name: str, key: str) -> str:
+        return os.path.join(self.dir, f"{_sanitize(name)}-{key}.pkl")
+
+    def load(self, name: str, key: str) -> Optional[Any]:
+        """Deserialize a stored executable -> callable Compiled, or None."""
+        if not self.enabled:
+            return None
+        path = self._path(name, key)
+        if not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — a corrupt/stale entry is a miss
+            self._warn(f"load failed for {name} ({type(e).__name__}: {e}); "
+                       "falling back to trace+compile")
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+            return None
+
+    def save(self, name: str, key: str, compiled: Any) -> bool:
+        """Serialize a Compiled to disk (atomic tmp+rename); False on any
+        failure (e.g. a backend whose executables don't serialize)."""
+        if not self.enabled:
+            return False
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self._path(name, key))
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            return True
+        except Exception as e:  # noqa: BLE001 — store is best-effort
+            self._warn(f"save failed for {name} ({type(e).__name__}: {e}); "
+                       "executables will not persist across processes")
+            return False

@@ -17,6 +17,11 @@ _flags = [
 _flags.append("--xla_force_host_platform_device_count=8")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 
+# The study driver's AOT warm start (interventions.warm_start_study) is
+# opt-in under test: it would trace ~9 extra tiny programs per driver test
+# for no assertion value.  tests/test_aot.py exercises it explicitly.
+os.environ.setdefault("TBX_AOT_WARMSTART", "off")
+
 import jax  # noqa: E402
 
 # The environment's sitecustomize (axon TPU plugin) overrides JAX_PLATFORMS at

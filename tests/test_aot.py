@@ -1,0 +1,205 @@
+"""AOT warm-start subsystem (runtime/aot.py + jax_cache.AotStore +
+interventions.study_program_specs): the registry serves warm-started
+executables to the real study call sites with zero misses, results are
+identical to the plain jit path, and executables round-trip the on-disk
+store across (simulated) processes."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import (
+    Config, ExperimentConfig, InterventionConfig, ModelConfig)
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.pipelines import interventions as iv
+from taboo_brittleness_tpu.runtime import aot, jax_cache
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    tok = WordTokenizer([WORD, "hint", "clue", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=5),
+        intervention=InterventionConfig(
+            budgets=(1, 2), random_trials=2, ranks=(1, 2), spike_top_k=2),
+        word_plurals={WORD: [WORD, WORD + "s"]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), d_model=cfg.hidden_size,
+                              d_sae=32)
+    return params, cfg, tok, config, sae
+
+
+@pytest.fixture()
+def fresh_registry():
+    aot.reset()
+    yield
+    aot.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry mechanics.
+# ---------------------------------------------------------------------------
+
+def test_signature_separates_shapes_dtypes_weakness_and_statics(fresh_registry):
+    e = aot.entry("sig", jax.jit(lambda x: x))
+    base = e.signature({"x": jnp.zeros((2, 3), jnp.float32)}, {"k": 1})
+    assert base == e.signature({"x": jnp.ones((2, 3), jnp.float32)}, {"k": 1})
+    assert base != e.signature({"x": jnp.zeros((3, 2), jnp.float32)}, {"k": 1})
+    assert base != e.signature({"x": jnp.zeros((2, 3), jnp.int32)}, {"k": 1})
+    assert base != e.signature({"x": jnp.zeros((2, 3), jnp.float32)}, {"k": 2})
+    # Weak-typed python scalars compile differently from strong arrays: the
+    # key must see the difference (a mismatch would make Compiled.call fail).
+    assert (e.signature({"x": 1.0}, {})
+            != e.signature({"x": jnp.zeros((), jnp.float32)}, {}))
+    assert e.signature({"x": 1.0}, {}) == e.signature({"x": 2.0}, {})
+
+
+def test_build_then_call_hits_and_matches_jit(fresh_registry):
+    fn = jax.jit(lambda x, *, scale: x * scale)
+    e = aot.entry("mul", fn)
+    dyn = {"x": jnp.arange(4.0), "scale": jnp.asarray(3.0)}
+    rec = e.build(dyn, {}, execute=True)
+    assert rec["source"] == "compiled"
+    assert rec["trace_seconds"] >= 0 and rec["compile_seconds"] >= 0
+    out = e.call({"x": jnp.arange(4.0), "scale": jnp.asarray(3.0)}, {})
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4.0) * 3)
+    assert e.hits == 1 and e.misses == 0
+    # A different signature misses and takes the jit path.
+    out2 = e.call({"x": jnp.arange(8.0), "scale": jnp.asarray(3.0)}, {})
+    assert np.asarray(out2).shape == (8,)
+    assert e.misses == 1
+
+
+def test_dispatch_disabled_env_is_plain_jit(fresh_registry, monkeypatch):
+    monkeypatch.setenv("TBX_AOT", "0")
+    fn = jax.jit(lambda x: x + 1)
+    out = aot.dispatch("off", fn, dynamic={"x": jnp.zeros((2,))}, static={})
+    np.testing.assert_array_equal(np.asarray(out), np.ones((2,)))
+    assert "off" not in aot.stats()          # registry never touched
+
+
+# ---------------------------------------------------------------------------
+# Warm start covers the study exactly (the drift gate).
+# ---------------------------------------------------------------------------
+
+def test_warm_start_then_study_zero_misses(setup, fresh_registry):
+    """THE guard that keeps study_program_specs honest: after a warm start,
+    the real study must run entirely on warm-started programs.  If a
+    pipeline change alters any launch signature, this fails loudly instead
+    of silently re-introducing the 73-second first word."""
+    params, cfg, tok, config, sae = setup
+    rep = iv.warm_start_study(params, cfg, tok, config, sae, store=None)
+    assert rep["errors"] == 0
+    assert {r["label"].split("[")[0] for r in rep["programs"]} >= {
+        "decode", "readout", "nll"}
+    res = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert set(res["ablation"]["budgets"]) == {"1", "2"}
+    s = aot.stats()
+    for name in ("decode", "readout", "nll"):
+        assert s[name]["misses"] == 0, (name, s)
+        assert s[name]["fallbacks"] == 0, (name, s)
+        assert s[name]["hits"] > 0, (name, s)
+
+
+def test_aot_study_results_identical_to_plain_jit(setup, fresh_registry,
+                                                  monkeypatch):
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_AOT", "0")
+    plain = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    monkeypatch.setenv("TBX_AOT", "1")
+    iv.warm_start_study(params, cfg, tok, config, sae, store=None)
+    warm = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert (json.dumps(plain, sort_keys=True, default=float)
+            == json.dumps(warm, sort_keys=True, default=float))
+
+
+def test_studies_driver_sync_warm_start(setup, fresh_registry, tmp_path,
+                                        monkeypatch):
+    """run_intervention_studies(warm_start='sync') wires the warm start into
+    the driver itself (the CLI path) and still writes per-word results."""
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_AOT_CACHE", "0")    # no ~/.cache writes from tests
+
+    def loader(word):
+        return params, cfg, tok
+
+    out = iv.run_intervention_studies(
+        config, model_loader=loader, sae=sae, words=[WORD],
+        output_dir=str(tmp_path), warm_start="sync")
+    assert WORD in out
+    assert os.path.exists(tmp_path / f"{WORD}.json")
+    s = aot.stats()
+    assert all(s[n]["misses"] == 0 for n in ("decode", "readout", "nll")), s
+
+
+# ---------------------------------------------------------------------------
+# On-disk executable store (cross-process reuse).
+# ---------------------------------------------------------------------------
+
+def test_store_round_trip_serves_disk_hits(setup, fresh_registry, tmp_path):
+    """Process 1 compiles + stores; 'process 2' (fresh registry) loads every
+    program from disk — tracing and compiling both skipped — and the loaded
+    executables drive a bit-identical study."""
+    params, cfg, tok, config, sae = setup
+    store = jax_cache.AotStore(path=str(tmp_path))
+    rep1 = iv.warm_start_study(params, cfg, tok, config, sae, store=store)
+    if rep1["errors"] or not os.listdir(store.dir):
+        pytest.skip("executable serialization unsupported on this backend")
+    compiled = [r for r in rep1["programs"] if r.get("source") == "compiled"]
+    assert compiled and all(r.get("stored") for r in compiled)
+
+    aot.reset()
+    store2 = jax_cache.AotStore(path=str(tmp_path))
+    rep2 = iv.warm_start_study(params, cfg, tok, config, sae, store=store2)
+    srcs = {r["label"]: r["source"] for r in rep2["programs"]}
+    assert all(v in ("disk", "memory", "jit") for v in srcs.values()), srcs
+    assert sum(1 for v in srcs.values() if v == "disk") >= 3
+
+    res = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert set(res["projection"]["ranks"]) == {"1", "2"}
+    s = aot.stats()
+    assert all(s[n]["misses"] == 0 for n in ("decode", "readout", "nll")), s
+
+
+def test_store_corrupt_entry_is_a_miss(setup, fresh_registry, tmp_path):
+    params, cfg, tok, config, sae = setup
+    store = jax_cache.AotStore(path=str(tmp_path))
+    rep = iv.warm_start_study(params, cfg, tok, config, sae, store=store)
+    files = sorted(os.listdir(store.dir)) if store.dir else []
+    if rep["errors"] or not files:
+        pytest.skip("executable serialization unsupported on this backend")
+    victim = os.path.join(store.dir, files[0])
+    with open(victim, "wb") as f:
+        f.write(b"not a pickle")
+    store2 = jax_cache.AotStore(path=str(tmp_path))
+    name, key = files[0][:-4].rsplit("-", 1)
+    assert store2.load(name, key) is None
+    assert os.path.exists(victim + ".corrupt")   # quarantined, not retried
+
+
+def test_store_dir_keys_on_source_fingerprint(tmp_path):
+    store = jax_cache.AotStore(path=str(tmp_path))
+    assert jax_cache.source_fingerprint()[:12] in os.path.basename(store.dir)
+
+
+def test_store_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TBX_AOT_CACHE", "0")
+    store = jax_cache.AotStore(path=str(tmp_path))
+    assert not store.enabled
+    assert store.load("x", "y") is None
+    assert store.save("x", "y", object()) is False

