@@ -678,6 +678,122 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
     }
 
 
+def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
+                     on_accel: bool = False) -> dict:
+    """Measure the telemetry subsystem's wall cost on a sweep smoke.
+
+    The obs contract (taboo_brittleness_tpu/obs) is "always-on is free":
+    spans, the JSONL sink, progress heartbeats, and watermark samples ride
+    every sweep by default, so their cost must stay noise-level (<2% wall).
+    This stage proves it per round: the SAME 2-word token-forcing smoke runs
+    with ``TBX_OBS=0`` and ``TBX_OBS=1``, interleaved A/B over ``reps`` with
+    a compile warm-up first, and the headline publishes the min-over-reps
+    delta (min is the noise-robust wall statistic — means smear scheduler
+    hiccups into whichever arm they hit)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.config import Config
+    from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+    from taboo_brittleness_tpu.runtime import decode as decode_mod
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    # Smoke shape: MANY words with a modest fixed-length decode each
+    # (stop_ids=(-1,), the dedup-proof bench idiom — the tiny CPU model's
+    # greedy decode otherwise early-exits).  Many words serve two purposes:
+    # the per-word obs cost (~0.1 ms of spans + throttled progress writes)
+    # is exercised at sweep cardinality, and the run's wall noise — CPU
+    # launch jitter is several percent per launch — averages down by
+    # 1/sqrt(launches) so a <2% effect is resolvable at all.  The decode
+    # rides in score_word (per word), NOT compute_mode (memoized across the
+    # shared-model word list, which would collapse the sweep to one launch).
+    n_words = 4 if on_accel else 24
+    rows, smoke_prompt = 8, 16
+    smoke_tokens = new_tokens if on_accel else max(new_tokens, 64)
+    words = [f"obsword{i:02d}" for i in range(n_words)]
+    tok = WordTokenizer(words + ["hint", "clue"], vocab_size=cfg.vocab_size)
+    config = Config(word_plurals={w: [w] for w in words})
+    seeds = {"n": 0}
+
+    def smoke_decode(word):
+        # Fresh inputs per call (per word x rep): the TPU runtime dedupes
+        # byte-identical re-executions, which would zero the compute both
+        # arms are supposed to share.
+        seeds["n"] += 1
+        rng = np.random.default_rng(31_000 + seeds["n"])
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=smoke_prompt))
+                   for _ in range(rows)]
+        padded, valid, positions = decode_mod.pad_prompts(prompts)
+        dec = decode_mod.greedy_decode(
+            params, cfg, jnp.asarray(padded), jnp.asarray(valid),
+            jnp.asarray(positions), max_new_tokens=smoke_tokens,
+            stop_ids=(-1,))
+        jax.block_until_ready(dec.tokens)
+        return {"word": word, "rows": rows}
+
+    def run(obs_on: bool) -> tuple:
+        prev = os.environ.get("TBX_OBS")
+        os.environ["TBX_OBS"] = "1" if obs_on else "0"
+        out_dir = tempfile.mkdtemp(prefix="tbx_obs_ab_")
+        try:
+            t0 = time.perf_counter()
+            run_word_sweep(
+                config, model_loader=lambda w: (params, cfg, tok),
+                words=words, modes=("smoke",),
+                compute_mode=lambda p, c, t, cf, m: None,
+                score_word=lambda cf, w, m, payload: smoke_decode(w),
+                output_dir=out_dir, pipeline="obs_ab_smoke")
+            dt = time.perf_counter() - t0
+            events_path = os.path.join(out_dir, "_events.jsonl")
+            n_events = 0
+            if os.path.exists(events_path):
+                with open(events_path) as f:
+                    n_events = sum(1 for _ in f)
+            return dt, n_events
+        finally:
+            if prev is None:
+                os.environ.pop("TBX_OBS", None)
+            else:
+                os.environ["TBX_OBS"] = prev
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    run(False)                              # compile warm-up, off the books
+    off, on, events = [], [], 0
+    for r in range(reps):
+        # Alternate arm order per rep so slow drift (thermal, page cache,
+        # background load) cancels instead of biasing one arm.
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for obs_on in order:
+            dt, n = run(obs_on)
+            (on if obs_on else off).append(dt)
+            if obs_on:
+                events = max(events, n)
+
+    # Ratio of TOTALS: the per-run scatter of a few-hundred-ms CPU decode is
+    # larger than the obs cost itself, so min-vs-min is a coin flip; summing
+    # reps integrates the noise away while paired ordering keeps it fair.
+    off_total, on_total = float(np.sum(off)), float(np.sum(on))
+    overhead = (on_total - off_total) / off_total if off_total > 0 else None
+    return {
+        "reps": reps,
+        "smoke": {"words": len(words), "rows": rows,
+                  "prompt_len": smoke_prompt, "new_tokens": smoke_tokens,
+                  "workload": "run_word_sweep + per-word fixed-length decode"},
+        "obs_off_seconds": [round(x, 4) for x in off],
+        "obs_on_seconds": [round(x, 4) for x in on],
+        "obs_off_seconds_total": round(off_total, 4),
+        "obs_on_seconds_total": round(on_total, 4),
+        "overhead_pct": (round(100.0 * overhead, 2)
+                         if overhead is not None else None),
+        "events_per_run": events,
+        "budget": "obs-on must stay <2% wall over obs-off (ratio of "
+                  "paired-rep totals)",
+    }
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -783,6 +899,13 @@ def main() -> int:
             projection_word_seconds=(
                 sweep["word_seconds_10_cells_plus_baseline"] if sweep else 0.0))
 
+    obs_ab = None
+    if os.environ.get("BENCH_OBS_AB", "1") == "1":
+        obs_ab = _obs_overhead_ab(
+            params, cfg, new_tokens,
+            reps=int(os.environ.get("BENCH_OBS_AB_REPS", "5")),
+            on_accel=on_accel)
+
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "results", "bench_detail.json")
     headline = {
@@ -820,6 +943,9 @@ def main() -> int:
             study and study.get("first_word_over_steady")),
         "warm_start_seconds": (
             study and study.get("warm_start", {}).get("measured_seconds")),
+        # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;
+        # the contract is <2% wall overhead (detail block "obs_overhead").
+        "obs_overhead_pct": (obs_ab and obs_ab.get("overhead_pct")),
         "detail": detail_path,
     }
 
@@ -837,7 +963,8 @@ def main() -> int:
 
         os.makedirs(os.path.dirname(detail_path), exist_ok=True)
         _atomic_json_dump(
-            {"headline": headline, "sweep": sweep, "study": study},
+            {"headline": headline, "sweep": sweep, "study": study,
+             "obs_overhead": obs_ab},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract
         print(f"bench_detail.json write failed (headline unaffected): {e}",

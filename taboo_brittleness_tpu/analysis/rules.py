@@ -512,6 +512,54 @@ class CapturedConstantRule:
                         "every trace; pass it as an argument")
 
 
+# ---------------------------------------------------------------------------
+# TBX009 — bare print() in package code.
+# ---------------------------------------------------------------------------
+
+_PKG_MARKER = "taboo_brittleness_tpu/"
+_PRINT_EXEMPT_MARKER = "taboo_brittleness_tpu/analysis/"
+
+
+class BarePrintRule:
+    """``print(...)`` inside the ``taboo_brittleness_tpu`` package: package
+    code emits telemetry through ``taboo_brittleness_tpu.obs`` (structured
+    events + stderr mirror via ``obs.warn``), not prints — a print is
+    invisible to the event stream, unparseable by tooling, and historically
+    how runtime failures went unrecorded (the stray warm-start/pre-dispatch
+    prints this rule was written to retire).
+
+    Scope is the package only: ``tools/`` and ``tests/`` scripts print by
+    design, and the ``analysis/`` subpackage (the tbx-check CLI itself) is
+    exempt — its stdout IS its interface.  User-facing CLI output keeps an
+    explicit ``# tbx: TBX009-ok — <reason>`` pragma per line, so every
+    remaining print in the package is a reviewed decision."""
+
+    code = "TBX009"
+    alias = "print"
+    summary = "bare print() in package code (use obs events / obs.warn)"
+
+    def _in_scope(self, rel: str) -> bool:
+        rel = rel.replace(os.sep, "/")
+        if _PRINT_EXEMPT_MARKER in rel:
+            return False
+        return _PKG_MARKER in rel or rel.startswith("taboo_brittleness_tpu")
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx.rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            yield ctx.finding(
+                node, self.code, self.alias,
+                "bare print() in package code — emit a structured event "
+                "(obs.event / obs.warn mirrors to stderr) so the telemetry "
+                "stream sees it; CLI stdout contracts get an explicit "
+                "`# tbx: TBX009-ok — <reason>` pragma")
+
+
 RULES = [
     HostSyncRule(),
     VocabF32Rule(),
@@ -521,6 +569,7 @@ RULES = [
     NondeterminismRule(),
     WallClockRule(),
     CapturedConstantRule(),
+    BarePrintRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -59,12 +59,13 @@ def _manifest(args, command: str):
 def _finish(args, manifest, out_dir: str) -> None:
     if not args.no_manifest:
         path = manifest.save(os.path.join(out_dir, "run_manifest.json"))
-        print(f"manifest -> {path}")
+        print(f"manifest -> {path}")  # tbx: TBX009-ok — CLI stdout contract (manifest path)
 
 
 def _load(args) -> Config:
     if os.path.exists(args.config):
         return config_mod.load_config(args.config)
+    # tbx: TBX009-ok — CLI stdout contract (config fallback notice)
     print(f"[config] {args.config} not found; using built-in defaults")
     return Config()
 
@@ -83,6 +84,7 @@ def _report_failures(manifest, ledger_or_failures) -> int:
     quarantined = data.get("quarantined", {})
     if not quarantined:
         return 0
+    # tbx: TBX009-ok — CLI stderr contract (quarantine summary)
     print(f"[resilience] {len(quarantined)} word(s) quarantined: "
           f"{sorted(quarantined)} (see _failures.json next to the results)",
           file=sys.stderr)
@@ -138,6 +140,7 @@ def _sae(config: Config, path: Optional[str]):
         try:
             if not os.path.exists(out):
                 convert_gemma_scope.convert(root, out, config.sae.sae_id)
+                # tbx: TBX009-ok — CLI stdout contract (SAE convert notice)
                 print(f"[sae] converted {config.sae.release}/"
                       f"{config.sae.sae_id} -> {out}")
             return sae_ops.load(out)
@@ -170,7 +173,7 @@ def cmd_generate(args) -> int:
             max_retries=args.max_retries, fail_fast=args.fail_fast,
             ledger=ledger)
     manifest.extra["generated"] = {w: len(v) for w, v in done.items()}
-    print(json.dumps({w: len(v) for w, v in done.items()}))
+    print(json.dumps({w: len(v) for w, v in done.items()}))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
     rc = _report_failures(manifest, ledger)
     _finish(args, manifest, processed)
     return rc
@@ -202,8 +205,8 @@ def cmd_logit_lens(args) -> int:
             processed_dir=args.processed_dir, output_path=out, mesh=mesh)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
-    print(json.dumps(results["overall"], indent=2))
-    print(f"results -> {out}")
+    print(json.dumps(results["overall"], indent=2))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
+    print(f"results -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     _finish(args, manifest, os.path.dirname(out))
     return 0
 
@@ -221,8 +224,8 @@ def cmd_sae_baseline(args) -> int:
     sae_baseline.save_metrics_csv(results, csv_path)
     manifest.add_artifact(csv_path)
     manifest.extra["overall"] = results["overall"]
-    print(json.dumps(results["overall"], indent=2))
-    print(f"metrics -> {csv_path}")
+    print(json.dumps(results["overall"], indent=2))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
+    print(f"metrics -> {csv_path}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     _finish(args, manifest, os.path.dirname(csv_path))
     return 0
 
@@ -324,8 +327,8 @@ def cmd_interventions(args) -> int:
             "targeted_drop": block[m]["targeted"]["secret_prob_drop"],
             "random_drop": block[m]["random_mean"]["secret_prob_drop"],
         } for m in block}
-        print(json.dumps(summary, indent=2))
-        print(f"study -> {out}")
+        print(json.dumps(summary, indent=2))  # tbx: TBX009-ok — CLI stdout contract (study summary JSON)
+        print(f"study -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
         out_dir = os.path.dirname(out)
     else:
         # Full sweep over config.words: resumable (skip-if-exists per word),
@@ -350,7 +353,7 @@ def cmd_interventions(args) -> int:
             manifest.add_artifact(os.path.join(out_dir, f"{w}.json"))
         for p_ in plot_paths:
             manifest.add_artifact(p_)
-        print(f"studies ({len(results)} words) -> {out_dir}")
+        print(f"studies ({len(results)} words) -> {out_dir}")  # tbx: TBX009-ok — CLI stdout contract (results path)
         rc = _report_failures(manifest, ledger)
         _finish(args, manifest, out_dir)
         return rc
@@ -376,8 +379,8 @@ def cmd_token_forcing(args) -> int:
             max_retries=args.max_retries, fail_fast=args.fail_fast)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
-    print(json.dumps(results["overall"], indent=2))
-    print(f"results -> {out}")
+    print(json.dumps(results["overall"], indent=2))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
+    print(f"results -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
     return rc
@@ -399,8 +402,8 @@ def cmd_prompting(args) -> int:
             max_retries=args.max_retries, fail_fast=args.fail_fast)
     manifest.add_artifact(out)
     manifest.extra["overall"] = results["overall"]
-    print(json.dumps(results["overall"], indent=2))
-    print(f"results -> {out}")
+    print(json.dumps(results["overall"], indent=2))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
+    print(f"results -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
     return rc

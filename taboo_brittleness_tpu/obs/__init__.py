@@ -1,0 +1,222 @@
+"""Host-side telemetry for every pipeline: spans, metrics, watermarks, progress.
+
+One subsystem, four surfaces (see the submodule docstrings for depth):
+
+- :mod:`~taboo_brittleness_tpu.obs.trace` — hierarchical spans
+  (run → word → phase → program) appended as JSONL to
+  ``<output_dir>/_events.jsonl``; render with ``tools/trace_report.py``.
+- :mod:`~taboo_brittleness_tpu.obs.metrics` — process-wide
+  counters/gauges/histograms, snapshotted into the run manifest.
+- :mod:`~taboo_brittleness_tpu.obs.memory` — HBM live/peak + host RSS
+  watermarks at span boundaries (plus an optional background sampler).
+- :mod:`~taboo_brittleness_tpu.obs.progress` — the ``_progress.json``
+  heartbeat (current word/phase, EMA ETA, last-event age).
+
+Contract, repo-wide: obs code is host-side (no new jit entry points),
+fail-open (telemetry errors never take down a run), stdlib + jax
+introspection only, and env-gated — ``TBX_OBS=0`` disables the sink
+entirely; ``TBX_OBS_MEM`` / ``TBX_OBS_MEM_HZ`` / ``TBX_OBS_PROGRESS_S``
+tune the samplers.  Package code emits events through this module instead
+of printing (tbx-check rule TBX009 enforces it).
+
+Sweep drivers wrap their word loop in :func:`sweep_observer`::
+
+    with obs.sweep_observer(output_dir, pipeline="token_forcing",
+                            words=words) as ob:
+        for word in words:
+            with ob.word(word):
+                with ob.phase("checkpoint.load"):
+                    ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import uuid
+from typing import Any, Iterator, Optional, Sequence
+
+from taboo_brittleness_tpu.obs import memory, metrics, progress, trace
+from taboo_brittleness_tpu.obs.trace import (
+    EVENTS_FILENAME, NULL_SPAN, SCHEMA_VERSION, Tracer, activate, deactivate,
+    enabled, event, events_path, get_tracer, iter_events, last_seq, span)
+from taboo_brittleness_tpu.obs.progress import (
+    PROGRESS_FILENAME, ProgressReporter, read_progress)
+
+__all__ = [
+    "EVENTS_FILENAME", "PROGRESS_FILENAME", "SCHEMA_VERSION",
+    "ProgressReporter", "SweepObserver", "Tracer",
+    "activate", "deactivate", "enabled", "event", "events_path",
+    "get_tracer", "iter_events", "last_seq", "memory", "metrics", "progress",
+    "read_progress", "span", "sweep_observer", "trace", "warn",
+]
+
+
+def warn(message: str, *, name: str = "log.warn", **attrs: Any) -> None:
+    """Structured replacement for the package's stray ``print(...)``s: emits
+    a point event (when a tracer is active) AND mirrors the line to stderr so
+    interactive runs keep their signal.  Fail-open on both paths."""
+    event(name, level="warn", message=message, **attrs)
+    try:
+        sys.stderr.write(message + "\n")
+    except Exception:  # noqa: BLE001 — a closed stderr must not kill a run
+        pass
+
+
+class SweepObserver:
+    """The per-sweep bundle of tracer + run span + progress heartbeat that
+    :func:`sweep_observer` yields.  A disabled observer (``active=False``)
+    has the same surface with every method a no-op, so drivers never branch.
+    """
+
+    def __init__(self, *, tracer: Optional[Tracer] = None,
+                 run_span=None,
+                 reporter: Optional[ProgressReporter] = None,
+                 owns_tracer: bool = False,
+                 mem_sampler: Optional[memory.MemorySampler] = None):
+        self.tracer = tracer
+        self.run_span = run_span
+        self.reporter = reporter
+        self._owns_tracer = owns_tracer
+        self._mem_sampler = mem_sampler
+
+    @property
+    def active(self) -> bool:
+        return self.tracer is not None
+
+    # -- span helpers ------------------------------------------------------
+
+    @contextlib.contextmanager
+    def word(self, word: str, *, resumed: bool = False) -> Iterator[Any]:
+        """One word's span + progress bookkeeping.  The span is yielded so
+        the driver can attach late attributes (retry counts, quarantine)."""
+        if not self.active:
+            yield NULL_SPAN
+            return
+        if self.reporter is not None:
+            self.reporter.word_started(word)
+        sp = self.tracer.span("word", kind="word", word=word)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.end(error=e)
+            if self.reporter is not None:
+                self.reporter.word_quarantined(word)
+            raise
+        else:
+            quarantined = sp.attrs.get("quarantined", False)
+            sp.end()
+            if self.reporter is None:
+                pass
+            elif quarantined:
+                self.reporter.word_quarantined(word)
+            elif resumed:
+                self.reporter.word_skipped(word)
+            else:
+                self.reporter.word_done(word)
+                metrics.histogram("word.seconds").observe(
+                    _span_duration(sp))
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **attrs: Any) -> Iterator[Any]:
+        if not self.active:
+            yield NULL_SPAN
+            return
+        if self.reporter is not None:
+            self.reporter.phase(name)
+        sp = self.tracer.span(name, kind="phase", **attrs)
+        try:
+            with sp:
+                yield sp
+        finally:
+            if self.reporter is not None:
+                self.reporter.phase(None)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        if self.tracer is not None:
+            try:
+                self.tracer.event(name, **attrs)
+            except Exception:  # noqa: BLE001 — fail-open
+                pass
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        if not self.active:
+            return
+        try:
+            _publish_aot_stats()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._mem_sampler is not None:
+            self._mem_sampler.stop()
+        if self.run_span is not None:
+            self.run_span.end(error=error)
+        if self.reporter is not None:
+            self.reporter.stop(status="error" if error is not None else "done")
+        if self._owns_tracer and self.tracer is not None:
+            deactivate(self.tracer)
+
+
+def _span_duration(sp) -> float:
+    import time
+
+    return time.monotonic() - sp._t0
+
+
+def _publish_aot_stats() -> None:
+    """Fold the AOT registry's hit/miss/fallback counters into the metrics
+    registry at sweep close — the cache-hit-rate snapshot the manifest keeps."""
+    from taboo_brittleness_tpu.runtime import aot
+
+    for name, st in aot.stats().items():
+        for k, v in st.items():
+            metrics.gauge(f"aot.{name}.{k}").set(v)
+
+
+@contextlib.contextmanager
+def sweep_observer(output_dir: Optional[str], *, pipeline: str,
+                   words: Sequence[str] = (),
+                   run_id: Optional[str] = None) -> Iterator[SweepObserver]:
+    """Activate telemetry for one sweep (tracer + run span + progress
+    heartbeat + optional background memory sampler), fail-open end to end.
+
+    Inert (yields a no-op observer) when obs is disabled (``TBX_OBS=0``) or
+    there is no ``output_dir`` to write next to.  When a tracer is already
+    active (a sweep nested inside an instrumented driver — e.g. bench's
+    study block), the nested sweep reuses it: its run span and events land
+    in the OUTER sink, keeping one coherent timeline, and only the outermost
+    observer owns deactivation."""
+    import os
+
+    if not enabled() or not output_dir:
+        yield SweepObserver()
+        return
+    try:
+        outer = get_tracer()
+        owns = outer is None
+        if owns:
+            os.makedirs(output_dir, exist_ok=True)
+            tracer = activate(
+                os.path.join(output_dir, EVENTS_FILENAME),
+                run_id=run_id or uuid.uuid4().hex[:12])
+        else:
+            tracer = outer
+        run_span = tracer.span(
+            "sweep", kind="run", pipeline=pipeline, words_total=len(words))
+        reporter = ProgressReporter(
+            os.path.join(output_dir, PROGRESS_FILENAME),
+            total_words=len(words), run_id=tracer.run_id,
+            tracer=tracer).start()
+        sampler = memory.MemorySampler(tracer).start()
+        ob = SweepObserver(tracer=tracer, run_span=run_span,
+                           reporter=reporter, owns_tracer=owns,
+                           mem_sampler=sampler)
+    except Exception:  # noqa: BLE001 — observability must never block a sweep
+        yield SweepObserver()
+        return
+    try:
+        yield ob
+    except BaseException as e:
+        ob.close(error=e)
+        raise
+    else:
+        ob.close()

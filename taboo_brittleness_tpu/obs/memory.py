@@ -1,0 +1,145 @@
+"""HBM live/peak watermarks + host RSS, sampled without touching the graph.
+
+The 1.16 GB-per-prompt ``all_probs`` hazard (PAPER.md; the reason TBX002
+exists) is invisible at run time unless someone watches HBM: a launch that
+fits on word 3 can OOM on word 17 when a leaked buffer or an unexpectedly
+retained prefill cache shifts the baseline.  This module makes the watermark
+a recorded signal:
+
+- :func:`sample` reads ``jax.local_devices()[i].memory_stats()`` (live bytes,
+  peak bytes, limit — TPU backends publish these; CPU returns nothing) plus
+  the host's RSS from ``/proc/self``, entirely host-side and fail-open.
+  Span boundaries attach this (``trace.Tracer``), so every word/phase end
+  carries the watermark it left behind.
+- :class:`MemorySampler` is the optional LOW-RATE background thread for the
+  gaps between boundaries (a leak inside one long phase), off by default and
+  armed with ``TBX_OBS_MEM_HZ`` (samples/second, fractional fine).
+
+``peak_bytes_in_use`` is cumulative per process on most backends; deltas
+between consecutive samples, not absolute peaks, localize a regression.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size from /proc/self/statm (Linux); None where
+    procfs is unavailable (the sample just omits the field)."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+# Local-device handles are stable for the life of the process; cache them so
+# per-span samples don't re-enter jax's client bookkeeping every time.
+_DEVICES: Optional[list] = None
+
+
+def _local_devices() -> list:
+    global _DEVICES
+    if _DEVICES is None:
+        import jax
+
+        _DEVICES = list(jax.local_devices())
+    return _DEVICES
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-local-device memory stats via jax introspection; [] when jax is
+    absent, uninitialized, or the backend publishes nothing (CPU)."""
+    try:
+        out = []
+        for d in _local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 — per-device introspection varies
+                stats = None
+            if not stats:
+                continue
+            out.append({
+                "device": str(d.id),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            })
+        return out
+    except Exception:  # noqa: BLE001 — no jax / no backend: host-only sample
+        return []
+
+
+def sample(*, compact: bool = False) -> Dict[str, Any]:
+    """One watermark sample.  ``compact=True`` is the span-boundary form:
+    megabytes, short keys, device list collapsed to totals — small enough to
+    ride on every word/phase end event."""
+    rss = host_rss_bytes()
+    devices = device_memory_stats()
+    if not compact:
+        out: Dict[str, Any] = {"rss_bytes": rss, "devices": devices}
+        return out
+    out = {}
+    if rss is not None:
+        out["rss_mb"] = round(rss / 1e6, 1)
+    if devices:
+        live = sum(d["bytes_in_use"] or 0 for d in devices)
+        peak = sum(d["peak_bytes_in_use"] or 0 for d in devices)
+        out["hbm_live_mb"] = round(live / 1e6, 1)
+        if peak:
+            out["hbm_peak_mb"] = round(peak / 1e6, 1)
+    return out
+
+
+def sampler_hz() -> float:
+    """Background-sampler rate from ``TBX_OBS_MEM_HZ``; 0 (default) = off."""
+    try:
+        return max(0.0, float(os.environ.get("TBX_OBS_MEM_HZ", "0")))
+    except ValueError:
+        return 0.0
+
+
+class MemorySampler:
+    """Optional background watermark sampler: emits ``mem.sample`` point
+    events through ``tracer`` at ``hz`` samples/second until stopped.
+    Daemonized and fail-open; ``hz<=0`` never starts a thread."""
+
+    def __init__(self, tracer, hz: Optional[float] = None):
+        self.tracer = tracer
+        self.hz = sampler_hz() if hz is None else hz
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MemorySampler":
+        if self.hz <= 0 or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="tbx-obs-mem", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                self.tracer.event("mem.sample", **sample(compact=True))
+            except Exception:  # noqa: BLE001 — sampling must never crash a run
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "MemorySampler":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()

@@ -1,0 +1,236 @@
+"""Live sweep progress: a heartbeat-rewritten ``_progress.json``.
+
+A stalled remote sweep used to be diagnosable only by attaching to the host
+or waiting for the run to (not) finish.  The reporter makes the current state
+one ``cat`` away: a daemon thread atomically rewrites
+``<output_dir>/_progress.json`` every few seconds with the current word and
+phase, words done/total, an ETA from a completed-word EMA, the age of the
+last telemetry event, and the heartbeat's own timestamp — so both "which word
+is it on" and "is it even alive" are answerable without attaching.
+
+Staleness has two distinct signals, deliberately:
+
+- ``updated_at`` older than ~2 heartbeat intervals → the PROCESS is gone or
+  wedged (the heartbeat thread itself stopped).
+- ``last_event_age_seconds`` large while ``updated_at`` is fresh → the
+  process is alive but the PIPELINE has gone quiet (a hung checkpoint read,
+  a compile that never returns) — exactly the "where did the time go" case
+  the span stream then answers.
+
+Everything is fail-open and stdlib-only; the file is written via the shared
+atomic tmp+rename so readers never see a torn JSON.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+PROGRESS_FILENAME = "_progress.json"
+
+#: EMA weight for completed-word seconds: ~last 6 words dominate, so the ETA
+#: tracks drift (later checkpoints decoding longer responses) without one
+#: outlier word whipsawing it.
+_EMA_ALPHA = 0.3
+
+
+def heartbeat_interval() -> float:
+    try:
+        return max(0.2, float(os.environ.get("TBX_OBS_PROGRESS_S", "5")))
+    except ValueError:
+        return 5.0
+
+
+class ProgressReporter:
+    """Heartbeat thread + thread-safe state setters.
+
+    Use as a context manager; drivers call :meth:`word_started`,
+    :meth:`word_done`, :meth:`word_skipped`, and :meth:`phase` as the sweep
+    moves.  ``tracer`` (optional) supplies ``last_event_age_seconds``;
+    ``clock`` is injectable so tests drive time instead of sleeping."""
+
+    def __init__(self, path: str, *, total_words: int,
+                 run_id: Optional[str] = None,
+                 tracer=None,
+                 interval: Optional[float] = None,
+                 min_write_interval: float = 0.5,
+                 clock=time.monotonic):
+        self.path = path
+        self.run_id = run_id
+        self.tracer = tracer
+        self.interval = heartbeat_interval() if interval is None else interval
+        # Word/phase transitions write through only this often; faster
+        # transitions (memoized words resolving in ms) just update in-memory
+        # state and let the heartbeat flush — progress IO must stay
+        # noise-level even when the sweep itself is fast.
+        self.min_write_interval = min_write_interval
+        self._clock = clock
+        self._last_write: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._state: Dict[str, Any] = {
+            "current_word": None,
+            "phase": None,
+            "words_done": 0,
+            "words_total": total_words,
+            "words_quarantined": 0,
+            "status": "running",
+        }
+        self._word_t0: Optional[float] = None
+        self._ema: Optional[float] = None
+
+    # -- state setters (all thread-safe, all fail-open at the write) -------
+
+    def word_started(self, word: str) -> None:
+        with self._lock:
+            self._state["current_word"] = word
+            self._state["phase"] = None
+            self._word_t0 = self._clock()
+        self._write_throttled()
+
+    def phase(self, name: Optional[str]) -> None:
+        with self._lock:
+            self._state["phase"] = name
+
+    def word_done(self, word: str, *, seconds: Optional[float] = None) -> None:
+        with self._lock:
+            if seconds is None and self._word_t0 is not None:
+                seconds = self._clock() - self._word_t0
+            self._word_t0 = None
+            self._state["words_done"] += 1
+            if seconds is not None:
+                self._ema = (seconds if self._ema is None
+                             else _EMA_ALPHA * seconds
+                             + (1.0 - _EMA_ALPHA) * self._ema)
+        self._write_throttled()
+
+    def word_skipped(self, word: str) -> None:
+        """A resumed word: counts toward done but not toward the EMA (a
+        skip costs milliseconds and would poison the ETA)."""
+        with self._lock:
+            self._state["words_done"] += 1
+        self._write_throttled()
+
+    def word_quarantined(self, word: str) -> None:
+        with self._lock:
+            self._state["words_quarantined"] += 1
+            self._word_t0 = None
+        self._write_throttled()
+
+    def finish(self, status: str = "done") -> None:
+        with self._lock:
+            self._state["status"] = status
+            self._state["current_word"] = None
+            self._state["phase"] = None
+        self.write_now()
+
+    # -- snapshot / write --------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            state = dict(self._state)
+            ema = self._ema
+            word_t0 = self._word_t0
+        remaining = max(
+            0, state["words_total"] - state["words_done"]
+            - state["words_quarantined"])
+        eta = None
+        if ema is not None:
+            eta = ema * remaining
+            if word_t0 is not None and remaining > 0:
+                # Credit the in-flight word's elapsed time against its slot.
+                eta -= min(ema, max(0.0, self._clock() - word_t0))
+        out = {
+            "v": 1,
+            "run_id": self.run_id,
+            "pid": os.getpid(),
+            # Epoch timestamp: the reader computes staleness as now - this.
+            # tbx: wallclock-ok — heartbeat freshness mark, not duration math
+            "updated_at": time.time(),
+            "heartbeat_seconds": self.interval,
+            **state,
+            "word_seconds_ema": round(ema, 3) if ema is not None else None,
+            "eta_seconds": round(eta, 1) if eta is not None else None,
+        }
+        if self.tracer is not None:
+            try:
+                out["last_event_age_seconds"] = round(
+                    self.tracer.last_event_age(), 3)
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+    def write_now(self) -> None:
+        try:
+            atomic_json_dump(self.snapshot(), self.path)
+            self._last_write = self._clock()
+        except Exception:  # noqa: BLE001 — progress must never kill the sweep
+            pass
+
+    def _write_throttled(self) -> None:
+        last = self._last_write
+        if last is None or self._clock() - last >= self.min_write_interval:
+            self.write_now()
+
+    # -- heartbeat thread --------------------------------------------------
+
+    def start(self) -> "ProgressReporter":
+        if self._thread is None:
+            self.write_now()
+            self._thread = threading.Thread(
+                target=self._run, name="tbx-obs-progress", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.write_now()
+            # Keep the event sink at most a heartbeat stale too (the tracer
+            # buffers writes): a wedged pipeline's last events reach disk
+            # even though nothing is emitting.
+            flush = getattr(self.tracer, "flush", None)
+            if flush is not None:
+                try:
+                    flush()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def stop(self, *, status: str = "done") -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        self.finish(status)
+
+    def __enter__(self) -> "ProgressReporter":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(status="error" if exc_type is not None else "done")
+
+
+def read_progress(path: str, *,
+                  stale_after: Optional[float] = None) -> Dict[str, Any]:
+    """Load a progress file and derive liveness:
+
+    - ``age_seconds``: now - updated_at (wall clock; the writer may be
+      another host, so monotonic cannot apply here).
+    - ``stale``: age > ``stale_after`` (default: 3x the file's own heartbeat
+      interval) — the process is presumed dead or wedged.
+    """
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    # tbx: wallclock-ok — cross-process freshness check needs the epoch clock
+    age = max(0.0, time.time() - float(data.get("updated_at", 0)))
+    threshold = (stale_after if stale_after is not None
+                 else 3.0 * float(data.get("heartbeat_seconds", 5.0)))
+    data["age_seconds"] = round(age, 3)
+    data["stale"] = bool(age > threshold and data.get("status") == "running")
+    return data

@@ -174,6 +174,7 @@ def run_generation(
     checkpoint must cost one word's cells, not the grid.  Quarantined words
     are absent from the returned dict.  ``fail_fast=True`` restores
     raise-on-first-failure (the pre-resilience contract)."""
+    from taboo_brittleness_tpu import obs
     from taboo_brittleness_tpu.runtime import resilience
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
@@ -184,27 +185,36 @@ def run_generation(
 
     generated: Dict[str, List[int]] = {}
     word_list = list(words if words is not None else config.words)
-    for i, word in enumerate(word_list):
-        stage = {"name": "checkpoint.load"}
+    with obs.sweep_observer(processed, pipeline="generation",
+                            words=word_list) as ob:
+        for i, word in enumerate(word_list):
+            stage = {"name": "checkpoint.load"}
 
-        def run_one() -> List[int]:
-            stage["name"] = "checkpoint.load"
-            params, model_cfg, tok = model_loader(word)
-            prefetch_next(model_loader, word_list, i)  # overlap next word's IO
-            stage["name"] = "generate"
-            return generate_for_word(
-                params, model_cfg, tok, config, word,
-                processed_dir=processed_dir, parity_dump=parity_dump)
+            def run_one() -> List[int]:
+                stage["name"] = "checkpoint.load"
+                with ob.phase("checkpoint.load"):
+                    params, model_cfg, tok = model_loader(word)
+                prefetch_next(model_loader, word_list, i)  # overlap next IO
+                stage["name"] = "generate"
+                with ob.phase("generate") as psp:
+                    cells = generate_for_word(
+                        params, model_cfg, tok, config, word,
+                        processed_dir=processed_dir, parity_dump=parity_dump)
+                    psp.set(cells_generated=len(cells))
+                    return cells
 
-        outcome = resilience.run_guarded(
-            word, run_one, policy=policy, ledger=ledger,
-            stage=lambda: stage["name"])
-        if not outcome.ok:
-            if fail_fast:
-                raise outcome.error
-            drop = getattr(model_loader, "drop_pending", None)
-            if drop is not None:
-                drop(word)
-            continue
-        generated[word] = outcome.value
+            with ob.word(word) as wsp:
+                outcome = resilience.run_guarded(
+                    word, run_one, policy=policy, ledger=ledger,
+                    stage=lambda: stage["name"])
+                wsp.set(attempts=outcome.attempts)
+                if not outcome.ok:
+                    wsp.set(quarantined=True, stage=outcome.stage)
+                    if fail_fast:
+                        raise outcome.error
+                    drop = getattr(model_loader, "drop_pending", None)
+                    if drop is not None:
+                        drop(word)
+                    continue
+                generated[word] = outcome.value
     return generated

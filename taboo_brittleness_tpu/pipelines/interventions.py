@@ -1641,10 +1641,12 @@ def run_intervention_studies(
             try:
                 warm_start_study(params, cfg, tok, config, sae, mesh=mesh)
             except Exception as e:  # noqa: BLE001 — the jit path always works
-                import sys
+                from taboo_brittleness_tpu import obs
 
-                print(f"[study] AOT warm start failed (continuing on the "
-                      f"plain jit path): {e}", file=sys.stderr)
+                obs.warn(f"[study] AOT warm start failed (continuing on the "
+                         f"plain jit path): {e}",
+                         name="study.warm_start_failed",
+                         error=f"{type(e).__name__}: {e}"[:300])
 
         if warm_mode == "sync":
             _warm()
@@ -1668,88 +1670,104 @@ def run_intervention_studies(
     def done(w: str) -> bool:
         return done_entry(w) is not None
 
+    from taboo_brittleness_tpu import obs
+
     out: Dict[str, Any] = {}
     prepared_next: Optional[Dict[str, Any]] = None
-    for i, word in enumerate(words):
-        path = os.path.join(output_dir, f"{word}.json")
-        saved = done_entry(word)
-        if saved is not None:
-            out[word] = saved
-            ledger.record_success(word)
+    observer = obs.sweep_observer(output_dir, pipeline="interventions",
+                                  words=words)
+    with observer as ob:
+        for i, word in enumerate(words):
+            path = os.path.join(output_dir, f"{word}.json")
+            saved = done_entry(word)
+            if saved is not None:
+                out[word] = saved
+                ledger.record_success(word)
+                with ob.word(word, resumed=True) as wsp:
+                    wsp.set(resumed=True)
+                if on_word_done is not None:
+                    on_word_done(word, out[word])
+                continue
+            # The pre-dispatched baseline handle (if any) is single-shot: a
+            # retry after a mid-study failure restarts from a fresh baseline.
+            prepared_cell = {"h": (prepared_next
+                                   if prepared_next
+                                   and prepared_next["word"] == word
+                                   else None)}
+            prepared_next = None
+            stage = {"name": "checkpoint.load"}
+
+            def run_one() -> Dict[str, Any]:
+                nonlocal prepared_next
+                stage["name"] = "checkpoint.load"
+                with ob.phase("checkpoint.load") as psp:
+                    psp.set(pipelined=prepared_cell.get("h") is not None)
+                    params, cfg, tok = model_loader(word)
+                # Build the study's compiled programs behind this (first)
+                # word's checkpoint IO / host prep — see maybe_warm_start.
+                maybe_warm_start(params, cfg, tok)
+                # Overlap the next word's checkpoint IO with this word's
+                # compute — but only a word that will actually RUN:
+                # prefetching a to-be-skipped word would pin its params in
+                # the loader's pending slot forever.
+                todo = [w for w in words[i + 1:]
+                        if w not in ledger.quarantined and not done(w)]
+                if todo:
+                    prefetch_next(model_loader, [word, todo[0]], 0)
+
+                # The in-flight baseline handle costs ~0.3 GB/chip at 9B
+                # shapes (B=10 prefill KV + residual) on top of the final
+                # chunks' buffers; TBX_CROSS_WORD_BASELINE=0 turns the
+                # pre-dispatch off if an HBM budget ever needs it back.
+                cross_word = os.environ.get(
+                    "TBX_CROSS_WORD_BASELINE", "1") != "0"
+
+                def dispatch_next_baseline(nxt=todo[0] if todo else None):
+                    nonlocal prepared_next
+                    if nxt is None or prepared_next is not None:
+                        return
+                    try:
+                        p2, c2, t2 = model_loader(nxt)
+                        prepared_next = prepare_word_dispatch(
+                            p2, c2, t2, config, nxt, mesh=mesh)
+                        ob.event("study.pre_dispatch", word=nxt)
+                    except Exception as e:  # noqa: BLE001 — must not lose
+                        # THIS word's results to the next word's early
+                        # load/dispatch failure.  A LOADER failure resurfaces
+                        # at that word's own model_loader call (after this
+                        # word's JSON is written); a dispatch failure falls
+                        # back to the un-pipelined baseline, so log it — it
+                        # would otherwise be invisible.
+                        obs.warn(
+                            f"[study] next-word baseline pre-dispatch failed "
+                            f"({nxt}): {e}",
+                            name="study.pre_dispatch_failed", word=nxt,
+                            error=f"{type(e).__name__}: {e}"[:300])
+                        prepared_next = None
+
+                stage["name"] = "study"
+                with ob.phase("study"):
+                    return run_intervention_study(
+                        params, cfg, tok, config, word, sae, output_path=path,
+                        mesh=mesh, forcing=forcing,
+                        prepared=prepared_cell.pop("h", None),
+                        after_arms_dispatched=(dispatch_next_baseline
+                                               if cross_word else None))
+
+            with ob.word(word) as wsp:
+                outcome = resilience.run_guarded(
+                    word, run_one, policy=policy, ledger=ledger,
+                    stage=lambda: stage["name"], sleep=_time.sleep)
+                wsp.set(attempts=outcome.attempts)
+                if not outcome.ok:
+                    wsp.set(quarantined=True, stage=outcome.stage)
+                    if fail_fast:
+                        raise outcome.error
+                    drop = getattr(model_loader, "drop_pending", None)
+                    if drop is not None:
+                        drop(word)
+                    continue
+                out[word] = outcome.value
             if on_word_done is not None:
                 on_word_done(word, out[word])
-            continue
-        # The pre-dispatched baseline handle (if any) is single-shot: a
-        # retry after a mid-study failure restarts from a fresh baseline.
-        prepared_cell = {"h": (prepared_next
-                               if prepared_next
-                               and prepared_next["word"] == word
-                               else None)}
-        prepared_next = None
-        stage = {"name": "checkpoint.load"}
-
-        def run_one() -> Dict[str, Any]:
-            nonlocal prepared_next
-            stage["name"] = "checkpoint.load"
-            params, cfg, tok = model_loader(word)
-            # Build the study's compiled programs behind this (first) word's
-            # checkpoint IO / host prep — see maybe_warm_start.
-            maybe_warm_start(params, cfg, tok)
-            # Overlap the next word's checkpoint IO with this word's compute
-            # — but only a word that will actually RUN: prefetching a
-            # to-be-skipped word would pin its params in the loader's
-            # pending slot forever.
-            todo = [w for w in words[i + 1:]
-                    if w not in ledger.quarantined and not done(w)]
-            if todo:
-                prefetch_next(model_loader, [word, todo[0]], 0)
-
-            # The in-flight baseline handle costs ~0.3 GB/chip at 9B shapes
-            # (B=10 prefill KV + residual) on top of the final chunks'
-            # buffers; TBX_CROSS_WORD_BASELINE=0 turns the pre-dispatch off
-            # if an HBM budget ever needs it back.
-            cross_word = os.environ.get("TBX_CROSS_WORD_BASELINE", "1") != "0"
-
-            def dispatch_next_baseline(nxt=todo[0] if todo else None):
-                nonlocal prepared_next
-                if nxt is None or prepared_next is not None:
-                    return
-                try:
-                    p2, c2, t2 = model_loader(nxt)
-                    prepared_next = prepare_word_dispatch(
-                        p2, c2, t2, config, nxt, mesh=mesh)
-                except Exception as e:  # noqa: BLE001 — must not lose THIS
-                    # word's results to the next word's early load/dispatch
-                    # failure.  A LOADER failure resurfaces at that word's
-                    # own model_loader call (after this word's JSON is
-                    # written); a dispatch failure falls back to the
-                    # un-pipelined baseline, so log it — it would otherwise
-                    # be invisible.
-                    import sys
-
-                    print(f"[study] next-word baseline pre-dispatch failed "
-                          f"({nxt}): {e}", file=sys.stderr)
-                    prepared_next = None
-
-            stage["name"] = "study"
-            return run_intervention_study(
-                params, cfg, tok, config, word, sae, output_path=path,
-                mesh=mesh, forcing=forcing,
-                prepared=prepared_cell.pop("h", None),
-                after_arms_dispatched=(dispatch_next_baseline if cross_word
-                                       else None))
-
-        outcome = resilience.run_guarded(
-            word, run_one, policy=policy, ledger=ledger,
-            stage=lambda: stage["name"], sleep=_time.sleep)
-        if not outcome.ok:
-            if fail_fast:
-                raise outcome.error
-            drop = getattr(model_loader, "drop_pending", None)
-            if drop is not None:
-                drop(word)
-            continue
-        out[word] = outcome.value
-        if on_word_done is not None:
-            on_word_done(word, out[word])
     return out

@@ -166,19 +166,27 @@ def analyze_word_on_device(
     # the tapped residuals (no persistent [B, T, V] buffer).  Under tp the
     # vocab-sharded variant merges candidates via tp_topk.
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
-        top_ids, _ = lens.aggregate_from_residual_tp(
+        top_ids, top_probs = lens.aggregate_from_residual_tp(
             params, model_cfg, res.residual, seqs_in,
             resp_in, top_k=top_k, mesh=mesh)
     else:
-        top_ids, _ = lens.aggregate_from_residual(
+        top_ids, top_probs = lens.aggregate_from_residual(
             params, model_cfg, res.residual, seqs_in,
             resp_in, top_k=top_k)
     texts = decode.decode_texts(tok, dec)    # overlaps the queued lens work
     layout = (layout_host if pad_rows else decode.response_layout(dec))
     seqs, valid = layout.sequences, layout.valid
     top_ids = np.asarray(top_ids)[:B]                      # [B, K]
+    top_probs = np.asarray(top_probs)[:B]                  # [B, K]
 
-    guesses = [[tok.decode([int(i)]).strip() for i in row] for row in top_ids]
+    # A row with NO aggregate mass (empty response: the model stopped
+    # immediately, so every position was masked out) has no guesses — the
+    # cached reference path returns [] there (`summed.sum() <= 0` in
+    # analyze_cached_pair); argsorting the zero vector instead would
+    # fabricate top-k ids out of tie-ordering.
+    guesses = [([tok.decode([int(i)]).strip() for i in row]
+                if top_probs[b].sum() > 0 else [])
+               for b, row in enumerate(top_ids)]
     tp = np.moveaxis(np.asarray(res.tap.target_prob), 1, 0)   # [L,B,T] -> [B,L,T]
     target_probs = [tp[b][:, valid[b]] for b in range(B)]
 
@@ -254,14 +262,21 @@ def evaluate_word(
         pair_cached = cache_io.verify_pair(processed, word, p_idx)
         spath = cache_io.summary_path(processed, word, p_idx)
         if not pair_cached and cache_io.verify_summary(spath):
-            want = (("agg_topk_ids", "target_prob") if plot_dir
-                    else ("agg_topk_ids",))
+            want = (("agg_topk_ids", "agg_topk_probs", "target_prob")
+                    if plot_dir else ("agg_topk_ids", "agg_topk_probs"))
             arrays, meta = cache_io.load_summary(spath, keys=want)
             agg = arrays.get("agg_topk_ids")
             if agg is not None and agg.shape[-1] >= config.model.top_k:
                 ids = agg[: config.model.top_k]
-                guesses_by_prompt.append(
-                    [tok.decode([int(i)]).strip() for i in ids])
+                probs = arrays.get("agg_topk_probs")
+                # Zero aggregate mass = empty response = no guesses — the
+                # same convention as the device and cached-pair paths (the
+                # stored ids would just be tie-order over a zero vector).
+                if probs is not None and float(probs.sum()) <= 0:
+                    guesses_by_prompt.append([])
+                else:
+                    guesses_by_prompt.append(
+                        [tok.decode([int(i)]).strip() for i in ids])
                 if plot_dir:
                     words_list = list(meta.get("input_words", []))
                     start = meta.get(
@@ -325,15 +340,22 @@ def run_evaluation(
 ) -> Dict[str, Any]:
     """Full evaluation: per-word guesses -> metrics -> results JSON
     (reference src/01_reproduce_logit_lens.py:268-295,344-348)."""
+    from taboo_brittleness_tpu import obs
+
     words = list(words if words is not None else config.words)
     if plot_dir is None and config.output.save_plots and output_path:
         plot_dir = os.path.join(os.path.dirname(output_path), "plots")
     predictions: Dict[str, List[List[str]]] = {}
-    for word in words:
-        predictions[word] = evaluate_word(
-            config, word, tok,
-            model_loader=model_loader, processed_dir=processed_dir,
-            plot_dir=plot_dir, mesh=mesh)
+    obs_dir = os.path.dirname(output_path) if output_path else (
+        processed_dir or config.output.processed_dir)
+    with obs.sweep_observer(obs_dir, pipeline="logit_lens", words=words) as ob:
+        for word in words:
+            with ob.word(word):
+                with ob.phase("evaluate"):
+                    predictions[word] = evaluate_word(
+                        config, word, tok,
+                        model_loader=model_loader, processed_dir=processed_dir,
+                        plot_dir=plot_dir, mesh=mesh)
 
     results = metrics_mod.calculate_metrics(predictions, words, config.word_plurals)
     for word in words:

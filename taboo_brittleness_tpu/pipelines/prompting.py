@@ -126,7 +126,7 @@ def run_prompting_attacks(
             cf, w, m, payload),
         output_dir=output_dir, force=force,
         max_retries=max_retries, fail_fast=fail_fast,
-        retry_policy=retry_policy)
+        retry_policy=retry_policy, pipeline="prompting")
     results = outcome.results
 
     scored = [w for w in words if w in results]

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from taboo_brittleness_tpu import metrics as metrics_mod
+from taboo_brittleness_tpu import obs
 from taboo_brittleness_tpu.config import Config
 from taboo_brittleness_tpu.feature_map import FEATURE_MAP, latents_to_word_guesses
 from taboo_brittleness_tpu.ops import sae as sae_ops
@@ -136,13 +137,17 @@ def _load_residual_pair(
         npz, js = cache_io.pair_paths(processed, word, p_idx)
         pair = cache_io.load_pair(npz, js, layer_idx=layer_idx)
         if pair.residual_stream is None:
-            print(f"Warning: {word} prompt {p_idx + 1} has no residual_stream_l{layer_idx}; skipping")
+            obs.warn(f"Warning: {word} prompt {p_idx + 1} has no "
+                     f"residual_stream_l{layer_idx}; skipping",
+                     name="sae_baseline.missing_residual",
+                     word=word, prompt=p_idx)
             return None
         start = chat.find_model_response_start(pair.input_words)
         mask = np.zeros(pair.residual_stream.shape[0], bool)
         mask[start:] = True
         return pair.residual_stream, mask
-    print(f"Warning: no cache for {word} prompt {p_idx + 1}; skipping")
+    obs.warn(f"Warning: no cache for {word} prompt {p_idx + 1}; skipping",
+             name="sae_baseline.missing_cache", word=word, prompt=p_idx)
     return None
 
 

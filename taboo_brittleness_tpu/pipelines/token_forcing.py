@@ -329,7 +329,7 @@ def run_token_forcing(
         compute_mode=compute, score_word=score,
         output_dir=output_dir, force=force,
         max_retries=max_retries, fail_fast=fail_fast,
-        retry_policy=retry_policy)
+        retry_policy=retry_policy, pipeline="token_forcing")
     results = outcome.results
 
     scored = [w for w in words if w in results]

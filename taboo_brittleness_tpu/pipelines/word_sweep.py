@@ -33,6 +33,7 @@ import os
 import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
+from taboo_brittleness_tpu import obs
 from taboo_brittleness_tpu.config import Config
 from taboo_brittleness_tpu.runtime import resilience
 from taboo_brittleness_tpu.runtime.resilience import (
@@ -71,6 +72,7 @@ def run_word_sweep(
     retry_policy: Optional[RetryPolicy] = None,
     ledger: Optional[FailureLedger] = None,
     sleep: Callable[[float], None] = time.sleep,
+    pipeline: str = "word_sweep",
 ) -> SweepOutcome:
     """Per-word entries ``{word: {mode: score_word(...)}}`` plus the ledger.
 
@@ -83,6 +85,11 @@ def run_word_sweep(
     ``retry_policy`` overrides the default
     ``RetryPolicy(max_retries=max_retries)``; ``sleep`` is injectable so
     tests exercise real backoff schedules without waiting them out.
+
+    Telemetry (``taboo_brittleness_tpu.obs``, fail-open, ``TBX_OBS``-gated):
+    with an ``output_dir`` the sweep writes a span stream to
+    ``<output_dir>/_events.jsonl`` (run → word → phase) and heartbeats
+    ``<output_dir>/_progress.json``; ``pipeline`` labels the run span.
     """
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
@@ -115,49 +122,61 @@ def run_word_sweep(
     results: Dict[str, Any] = {}
     memo_key: Any = None
     memo: Dict[str, Any] = {}
-    for i, word in enumerate(words):
-        saved = load_done(word)
-        if saved is not None:
-            results[word] = saved
-            ledger.record_success(word)
-            continue
+    with obs.sweep_observer(output_dir, pipeline=pipeline, words=words) as ob:
+        for i, word in enumerate(words):
+            saved = load_done(word)
+            if saved is not None:
+                results[word] = saved
+                ledger.record_success(word)
+                with ob.word(word, resumed=True) as wsp:
+                    wsp.set(resumed=True)
+                continue
 
-        stage = {"name": "checkpoint.load"}
+            stage = {"name": "checkpoint.load"}
 
-        def run_one() -> Dict[str, Any]:
-            nonlocal memo_key, memo
-            stage["name"] = "checkpoint.load"
-            params, cfg, tok = model_loader(word)
-            if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
-                memo_key, memo = (params, tok), {}
-            # next() stops at the first pending word — no full O(words²)
-            # rescan (and re-parse of every done word's JSON) per iteration.
-            nxt = next(
-                (w for w in words[i + 1:]
-                 if w not in ledger.quarantined and not done(w)), None)
-            if nxt is not None:
-                prefetch_next(model_loader, [word, nxt], 0)
-            entry: Dict[str, Any] = {}
-            for mode in modes:
-                stage["name"] = f"compute:{mode}"
-                if mode not in memo:
-                    memo[mode] = compute_mode(params, cfg, tok, config, mode)
-                entry[mode] = score_word(config, word, mode, memo[mode])
-            return entry
+            def run_one() -> Dict[str, Any]:
+                nonlocal memo_key, memo
+                stage["name"] = "checkpoint.load"
+                with ob.phase("checkpoint.load"):
+                    params, cfg, tok = model_loader(word)
+                if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:
+                    memo_key, memo = (params, tok), {}
+                # next() stops at the first pending word — no full O(words²)
+                # rescan (and re-parse of every done word's JSON) per iteration.
+                nxt = next(
+                    (w for w in words[i + 1:]
+                     if w not in ledger.quarantined and not done(w)), None)
+                if nxt is not None:
+                    prefetch_next(model_loader, [word, nxt], 0)
+                entry: Dict[str, Any] = {}
+                for mode in modes:
+                    stage["name"] = f"compute:{mode}"
+                    with ob.phase(f"compute:{mode}") as psp:
+                        psp.set(memoized=mode in memo)
+                        if mode not in memo:
+                            memo[mode] = compute_mode(
+                                params, cfg, tok, config, mode)
+                        entry[mode] = score_word(config, word, mode, memo[mode])
+                return entry
 
-        outcome = resilience.run_guarded(
-            word, run_one, policy=policy, ledger=ledger,
-            stage=lambda: stage["name"], sleep=sleep)
-        if not outcome.ok:
-            if fail_fast:
-                raise outcome.error
-            # Drop any stale prefetch state so the quarantined word's errored
-            # thread result cannot leak into a later retry/rerun.
-            drop = getattr(model_loader, "drop_pending", None)
-            if drop is not None:
-                drop(word)
-            continue
-        results[word] = outcome.value
-        if output_dir:
-            atomic_json_dump(outcome.value, word_path(word))
+            with ob.word(word) as wsp:
+                outcome = resilience.run_guarded(
+                    word, run_one, policy=policy, ledger=ledger,
+                    stage=lambda: stage["name"], sleep=sleep)
+                wsp.set(attempts=outcome.attempts)
+                if not outcome.ok:
+                    wsp.set(quarantined=True, stage=outcome.stage)
+                    if fail_fast:
+                        raise outcome.error
+                    # Drop any stale prefetch state so the quarantined word's
+                    # errored thread result cannot leak into a later
+                    # retry/rerun.
+                    drop = getattr(model_loader, "drop_pending", None)
+                    if drop is not None:
+                        drop(word)
+                    continue
+                results[word] = outcome.value
+                if output_dir:
+                    with ob.phase("write"):
+                        atomic_json_dump(outcome.value, word_path(word))
     return SweepOutcome(results=results, ledger=ledger)

@@ -53,6 +53,17 @@ def enabled() -> bool:
     return os.environ.get("TBX_AOT", "1") != "0"
 
 
+def _obs_event(name: str, **attrs: Any) -> None:
+    """Lazily-imported, fail-open telemetry point event (no-op without an
+    active tracer)."""
+    try:
+        from taboo_brittleness_tpu import obs
+
+        obs.event(name, **attrs)
+    except Exception:  # noqa: BLE001 — telemetry must never poison dispatch
+        pass
+
+
 def _static_repr(v: Any) -> str:
     """Stable string for a static argument: functions by qualified name
     (their identity IS the jit static), everything else by repr."""
@@ -103,11 +114,13 @@ class AotEntry:
         if prog is not None:
             try:
                 out = prog(**dynamic)
-            except Exception:  # noqa: BLE001 — never poison the run
+            except Exception as e:  # noqa: BLE001 — never poison the run
                 # E.g. an input landed on an unexpected device: drop the
                 # program and take the always-correct jit path.
                 self.programs.pop(key, None)
                 self.fallbacks += 1
+                _obs_event("aot.fallback", entry=self.name, key=key,
+                           error=f"{type(e).__name__}: {e}"[:300])
                 return self.jit_fn(**dynamic, **static)
             self.hits += 1
             return out
@@ -181,6 +194,10 @@ class AotEntry:
             with self._lock:
                 ev.set()
                 self._building.pop(key, None)
+        # Telemetry: the cold-start profile, one event per built program
+        # (trace/compile/load/execute split — runs on the warm-start thread,
+        # so the span stream shows the build overlapping word 0's IO).
+        _obs_event("aot.build", **rec)
         return rec
 
 

@@ -140,12 +140,19 @@ class CheckpointManager:
                 return
             self.drop_pending(word)
 
+        from taboo_brittleness_tpu import obs
+
+        obs.event("checkpoint.prefetch.start", word=word)
+
         def run():
             try:
                 resilience.fire("prefetch.thread", word=word)
                 self._pending_results[word] = (True, self._load_triple(word))
+                obs.event("checkpoint.prefetch.done", word=word)
             except BaseException as e:  # re-raised (or retried) by load()
                 self._pending_results[word] = (False, e)
+                obs.event("checkpoint.prefetch.failed", word=word,
+                          error=f"{type(e).__name__}: {e}"[:300])
 
         t = threading.Thread(target=run, name=f"prefetch-{word}", daemon=True)
         self._pending[word] = t
@@ -162,25 +169,32 @@ class CheckpointManager:
         self._pending_results.pop(word, None)
 
     def load(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
+        from taboo_brittleness_tpu import obs
+
         if word in self._cache:
             self._cache.move_to_end(word)
+            obs.event("checkpoint.load", word=word, source="cache")
             return self._cache[word]
-        if word in self._pending:
-            self._pending.pop(word).join()
-            ok, payload = self._pending_results.pop(word)
-            if ok:
-                triple = payload
-            elif (self.retry_policy is not None
-                    and resilience.is_transient(payload)):
-                # The failed prefetch counts as attempt 1; the policy owns
-                # the rest.  Surfacing the error as retryable (instead of
-                # raising the thread's exception verbatim) is what keeps one
-                # flaky IO from costing the word.
-                triple = self._load_with_retries(word)
+        with obs.span("checkpoint.load", kind="program", word=word) as sp:
+            if word in self._pending:
+                self._pending.pop(word).join()
+                ok, payload = self._pending_results.pop(word)
+                if ok:
+                    triple = payload
+                    sp.set(source="prefetch")
+                elif (self.retry_policy is not None
+                        and resilience.is_transient(payload)):
+                    # The failed prefetch counts as attempt 1; the policy owns
+                    # the rest.  Surfacing the error as retryable (instead of
+                    # raising the thread's exception verbatim) is what keeps
+                    # one flaky IO from costing the word.
+                    sp.set(source="prefetch-retry")
+                    triple = self._load_with_retries(word)
+                else:
+                    raise payload
             else:
-                raise payload
-        else:
-            triple = self._load_with_retries(word)
+                sp.set(source="sync")
+                triple = self._load_with_retries(word)
         self._cache[word] = triple
         while len(self._cache) > self.capacity:
             # Drop oldest; its device buffers free once unreferenced (the

@@ -401,6 +401,8 @@ def generate(
     """
     # Named fault site (runtime.resilience): lets tests/ops arm launch-time
     # failures without touching the traced decode itself.
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
     from taboo_brittleness_tpu.runtime import aot, resilience
 
     resilience.fire("decode.launch", rows=len(prompts))
@@ -425,24 +427,31 @@ def generate(
             return arr
         return jax.device_put(arr, input_sharding)
 
-    result = aot.dispatch(
-        "decode", greedy_decode,
-        dynamic=dict(
-            params=params,
-            prompt_ids=place(padded), prompt_valid=place(valid),
-            prompt_positions=place(positions),
-            edit_params=edit_params,
-        ),
-        static=dict(
-            cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
-            decode_edit=decode_edit,
-            stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
-            capture_residual_layer=capture_residual_layer,
-            return_prefill_cache=return_prefill_cache,
-        ),
-        route=input_sharding is None,
-    )
-    texts = decode_texts(tok, result) if return_texts else None
+    obs_metrics.counter("decode.launches").inc()
+    obs_metrics.counter("decode.rows").inc(len(prompts))
+    # Program span: host-side dispatch only (the launch is async — the span
+    # covers tracing/dispatch and, with return_texts, the blocking token
+    # pull; device time shows up in whichever span later blocks).
+    with obs.span("decode", kind="program", rows=len(prompts),
+                  cols=int(padded.shape[1]), new_tokens=max_new_tokens):
+        result = aot.dispatch(
+            "decode", greedy_decode,
+            dynamic=dict(
+                params=params,
+                prompt_ids=place(padded), prompt_valid=place(valid),
+                prompt_positions=place(positions),
+                edit_params=edit_params,
+            ),
+            static=dict(
+                cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
+                decode_edit=decode_edit,
+                stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+                capture_residual_layer=capture_residual_layer,
+                return_prefill_cache=return_prefill_cache,
+            ),
+            route=input_sharding is None,
+        )
+        texts = decode_texts(tok, result) if return_texts else None
     return result, texts, ids
 
 

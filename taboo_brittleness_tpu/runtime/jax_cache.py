@@ -70,9 +70,23 @@ def enable(path: Optional[str] = None) -> Optional[str]:
             jax.config.update("jax_compilation_cache_dir", None)
         except Exception:  # noqa: BLE001 — best-effort revert
             pass
-        print(f"[jax-cache] disabled: {e}", file=sys.stderr)
+        _obs_warn(f"[jax-cache] disabled: {e}", name="jax_cache.disabled")
         return None
     return path
+
+
+def _obs_warn(msg: str, *, name: str) -> None:
+    """Structured event + stderr mirror (fail-open; obs imported lazily so
+    this module stays importable before the package's obs layer)."""
+    try:
+        from taboo_brittleness_tpu import obs
+
+        obs.warn(msg, name=name)
+    except Exception:  # noqa: BLE001
+        try:
+            print(msg, file=sys.stderr)  # tbx: TBX009-ok — obs-unavailable fallback
+        except Exception:  # noqa: BLE001
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -153,7 +167,7 @@ class AotStore:
 
     def _warn(self, msg: str) -> None:
         if not self._warned:
-            print(f"[aot-store] {msg}", file=sys.stderr)
+            _obs_warn(f"[aot-store] {msg}", name="aot_store.warn")
             self._warned = True
 
     def _path(self, name: str, key: str) -> str:

@@ -27,6 +27,11 @@ def _git_commit() -> Optional[str]:
             ["git", "rev-parse", "HEAD"],
             capture_output=True, text=True, timeout=5,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))))
+        # A failed rev-parse (not a repo, corrupt .git) exits non-zero and
+        # prints its complaint to stderr; stdout alone once let that pass
+        # as a bogus "commit".  Trust stdout only on success.
+        if out.returncode != 0:
+            return None
         return out.stdout.strip() or None
     except Exception:
         return None
@@ -99,6 +104,27 @@ class RunManifest:
         self.failures.update(data.get("quarantined", {}))
         self.retries.update(data.get("retried", {}))
 
+    def _obs_block(self) -> Dict[str, Any]:
+        """Observability stamp: the obs schema version, the events-file path
+        (when a tracer is/was active this process), and a snapshot of the
+        process metrics registry (decode launches, retries, word-time
+        histograms, AOT hit rates...).  Fail-open: a broken obs import
+        reduces the block to the schema version."""
+        block: Dict[str, Any] = {}
+        try:
+            from taboo_brittleness_tpu import obs
+
+            block["schema_version"] = obs.SCHEMA_VERSION
+            path = obs.events_path()
+            if path:
+                block["events_path"] = path
+            snap = obs.metrics.snapshot()
+            if snap:
+                block["metrics"] = snap
+        except Exception:  # noqa: BLE001 — manifest must never fail a run
+            pass
+        return block
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "run_id": self.run_id,
@@ -109,6 +135,7 @@ class RunManifest:
             "config": self.config,
             "stages": self.stages,
             "artifacts": self.artifacts,
+            "obs": self._obs_block(),
             **({"failures": self.failures} if self.failures else {}),
             **({"retries": self.retries} if self.retries else {}),
             **({"extra": self.extra} if self.extra else {}),

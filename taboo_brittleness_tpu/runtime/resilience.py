@@ -116,9 +116,52 @@ def quarantine_file(path: str, *, reason: str = "") -> Optional[str]:
         os.replace(path, dst)
     except OSError:
         return None
-    print(f"[resilience] quarantined corrupt file {path} -> {dst}"
-          + (f" ({reason})" if reason else ""), file=sys.stderr)
+    _obs_warn(f"[resilience] quarantined corrupt file {path} -> {dst}"
+              + (f" ({reason})" if reason else ""),
+              name="resilience.quarantine_file", path=path, reason=reason)
     return dst
+
+
+def _obs_warn(message: str, *, name: str, **attrs: Any) -> None:
+    """Structured event + stderr mirror via the obs emitter; lazily imported
+    (obs.trace fires this module's ``obs.event_write`` fault site, so the
+    dependency must stay one-way at import time) and fail-open."""
+    try:
+        from taboo_brittleness_tpu import obs
+
+        obs.warn(message, name=name, **attrs)
+    except Exception:  # noqa: BLE001 — telemetry must never take down a run
+        try:
+            print(message, file=sys.stderr)  # tbx: TBX009-ok — obs-unavailable fallback
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _obs_event(name: str, **attrs: Any) -> None:
+    try:
+        from taboo_brittleness_tpu import obs
+
+        obs.event(name, **attrs)
+    except Exception:  # noqa: BLE001 — fail-open
+        pass
+
+
+def _obs_last_seq() -> Optional[int]:
+    try:
+        from taboo_brittleness_tpu import obs
+
+        return obs.last_seq()
+    except Exception:  # noqa: BLE001 — fail-open
+        return None
+
+
+def _obs_count(name: str, amount: float = 1.0) -> None:
+    try:
+        from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.counter(name).inc(amount)
+    except Exception:  # noqa: BLE001 — fail-open
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -314,7 +357,7 @@ class FailureLedger:
 
     def record_quarantine(self, word: str, stage: str, exc: BaseException,
                           attempts: int) -> None:
-        self.quarantined[word] = {
+        entry = {
             "stage": stage,
             "attempts": attempts,
             **_describe(exc),
@@ -322,6 +365,13 @@ class FailureLedger:
             # math (manifest wall_seconds owns durations).
             "at": time.time(),
         }
+        # Event offset: the telemetry sequence number current at quarantine
+        # time, so a postmortem can seek straight to the surrounding span
+        # stream in <output_dir>/_events.jsonl (None when obs is inactive).
+        seq = _obs_last_seq()
+        if seq is not None:
+            entry["event_seq"] = seq
+        self.quarantined[word] = entry
         self.save()
 
     def record_success(self, word: str) -> None:
@@ -361,6 +411,9 @@ FAULT_SITES = (
     "cache.write",        # runtime.cache save_pair / save_summary (post-write)
     "prefetch.thread",    # CheckpointManager.prefetch worker
     "decode.launch",      # runtime.decode.generate
+    "obs.event_write",    # obs.trace.Tracer._emit — proves telemetry is
+    #                       fail-open: an injected sink fault drops the event,
+    #                       never the run (tests/test_obs.py)
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate")
@@ -575,9 +628,13 @@ def run_guarded(
         attempts["n"] = attempt + 1
         if ledger is not None:
             ledger.record_retry(word, stage(), exc, attempt)
-        print(f"[resilience] {word}: attempt {attempt} failed at "
-              f"{stage()} ({type(exc).__name__}: {exc}); retrying in "
-              f"{delay:.2f}s", file=sys.stderr)
+        _obs_count("sweep.retries")
+        _obs_warn(f"[resilience] {word}: attempt {attempt} failed at "
+                  f"{stage()} ({type(exc).__name__}: {exc}); retrying in "
+                  f"{delay:.2f}s",
+                  name="resilience.retry", word=word, stage=stage(),
+                  attempt=attempt, delay=round(delay, 3),
+                  error=f"{type(exc).__name__}: {exc}"[:300])
 
     try:
         value = policy.call(fn, site=f"{stage()}:{word}", sleep=sleep,
@@ -585,6 +642,10 @@ def run_guarded(
     except Exception as exc:  # noqa: BLE001 — quarantine, don't crash the sweep
         if ledger is not None:
             ledger.record_quarantine(word, stage(), exc, attempts["n"])
+        _obs_event("resilience.quarantine", word=word, stage=stage(),
+                   attempts=attempts["n"],
+                   error=f"{type(exc).__name__}: {exc}"[:300])
+        _obs_count("sweep.quarantines")
         return WordOutcome(word=word, error=exc, attempts=attempts["n"],
                            stage=stage())
     if ledger is not None:

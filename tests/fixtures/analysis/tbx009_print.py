@@ -1,0 +1,15 @@
+"""TBX009 corpus: bare print() in package code.
+
+The rule is PATH-scoped (only ``taboo_brittleness_tpu/`` outside
+``analysis/``), so tests scan this file under a package-relative ``rel``
+alias — see tests/test_analysis.py::test_tbx009_fixture_and_path_scope.
+"""
+
+
+def sweep_step(word):
+    print(f"starting {word}")
+    print("done", word)
+
+
+def cli_summary(results):
+    print(results)  # tbx: TBX009-ok — reviewed stdout contract

@@ -59,6 +59,23 @@ def test_clean_fixture_has_no_findings():
     assert active == [] and suppressed == []
 
 
+def test_tbx009_fixture_and_path_scope():
+    """TBX009 is path-scoped: the same source flags under a package rel,
+    stays silent under the analysis/ subpackage (the tbx-check CLI's own
+    stdout) and outside the package (tools/, tests/), and honors pragmas."""
+    path = os.path.join(FIXTURES, "tbx009_print.py")
+
+    in_pkg, suppressed = analyze_file(
+        path, rel="taboo_brittleness_tpu/pipelines/mod.py")
+    assert _codes_and_lines(in_pkg) == [("TBX009", 10), ("TBX009", 11)]
+    assert [f.code for f in suppressed] == ["TBX009"]       # the pragma'd one
+
+    for exempt_rel in ("taboo_brittleness_tpu/analysis/cli.py",
+                       "tools/script.py", "tests/test_x.py"):
+        active, _ = analyze_file(path, rel=exempt_rel)
+        assert [f for f in active if f.code == "TBX009"] == [], exempt_rel
+
+
 # ---------------------------------------------------------------------------
 # Pragmas.
 # ---------------------------------------------------------------------------
@@ -261,5 +278,5 @@ def test_cli_list_rules():
 def test_every_rule_has_unique_code_and_alias():
     codes = [r.code for r in RULES]
     aliases = [r.alias for r in RULES]
-    assert len(set(codes)) == len(codes) == 8
+    assert len(set(codes)) == len(codes) == 9
     assert len(set(aliases)) == len(aliases)

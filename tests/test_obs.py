@@ -1,0 +1,523 @@
+"""Tier-1 CPU tests for the telemetry subsystem (taboo_brittleness_tpu/obs).
+
+Covers the obs contract end to end: span nesting and thread-safety, JSONL
+round-trip plus fail-open behavior under a fault-injected sink write
+(resilience site ``obs.event_write``), metrics registry snapshots, the
+``_progress.json`` heartbeat and staleness detection, and
+``tools/trace_report.py`` rendered over a synthetic sweep's events.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs import memory as obs_memory
+from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.obs import progress as obs_progress
+from taboo_brittleness_tpu.obs import trace as obs_trace
+from taboo_brittleness_tpu.runtime import resilience
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import trace_report  # noqa: E402
+
+FIXTURE_EVENTS = os.path.join(
+    os.path.dirname(__file__), "fixtures", "obs", "_events.jsonl")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Each test gets a pristine injector, metrics registry, and tracer
+    stack (obs state is process-wide by design)."""
+    resilience.set_injector(FaultInjector())
+    obs_metrics.reset()
+    yield
+    while obs_trace.get_tracer() is not None:
+        obs_trace.deactivate(obs_trace.get_tracer())
+    resilience.set_injector(FaultInjector())
+    obs_metrics.reset()
+
+
+def _read_events(path):
+    return list(obs.iter_events(path))
+
+
+# ---------------------------------------------------------------------------
+# Spans: nesting, attributes, thread-safety.
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_round_trip(tmp_path):
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path, run_id="run0")
+    try:
+        with t.span("sweep", kind="run", pipeline="test") as run:
+            with t.span("word", kind="word", word="ship") as w:
+                with t.span("decode", kind="program", rows=4) as p:
+                    p.set(aot="hit")
+                t.event("aot.build", entry="decode")
+            assert w.parent_id == run.span_id
+    finally:
+        obs.deactivate(t)
+
+    events = _read_events(path)
+    starts = [e for e in events if e["ev"] == "start"]
+    ends = [e for e in events if e["ev"] == "end"]
+    points = [e for e in events if e["ev"] == "point"]
+    assert [e["name"] for e in starts] == ["sweep", "word", "decode"]
+    # Ends are innermost-first; each end carries dur + ok status.
+    assert [e["name"] for e in ends] == ["decode", "word", "sweep"]
+    assert all(e["status"] == "ok" and e["dur"] >= 0 for e in ends)
+    # Parentage chains run -> word -> program; the point event parents to
+    # the word span active on its thread.
+    by_name = {e["name"]: e for e in starts}
+    assert by_name["word"]["parent"] == by_name["sweep"]["id"]
+    assert by_name["decode"]["parent"] == by_name["word"]["id"]
+    assert points[0]["parent"] == by_name["word"]["id"]
+    # Late attributes ride the end event; seq is strictly increasing.
+    decode_end = next(e for e in ends if e["name"] == "decode")
+    assert decode_end["attrs"]["aot"] == "hit"
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # The run start carries the wall-clock anchor and run id.
+    assert by_name["sweep"]["run_id"] == "run0"
+    assert by_name["sweep"]["wall"] > 0
+
+
+def test_span_error_status_and_idempotent_end(tmp_path):
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    try:
+        with pytest.raises(ValueError):
+            with t.span("word", kind="word", word="moon"):
+                raise ValueError("boom")
+        sp = t.span("explicit", kind="phase")
+        sp.end()
+        sp.end()  # idempotent: __exit__ after end() must not double-emit
+    finally:
+        obs.deactivate(t)
+    events = _read_events(path)
+    word_end = next(e for e in events
+                    if e["ev"] == "end" and e["name"] == "word")
+    assert word_end["status"] == "error"
+    assert "ValueError: boom" in word_end["error"]
+    assert sum(1 for e in events
+               if e["ev"] == "end" and e["name"] == "explicit") == 1
+
+
+def test_tracer_thread_safety(tmp_path):
+    """Concurrent writers from many threads: every event lands as one whole
+    JSON line, seq is gap-free, and per-thread parentage never crosses
+    threads (a worker's span must not nest under another thread's)."""
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    n_threads, n_spans = 8, 25
+
+    def worker(k):
+        for i in range(n_spans):
+            with t.span(f"w{k}", kind="phase", i=i) as sp:
+                sp.event("tick", k=k)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        obs.deactivate(t)
+
+    events = _read_events(path)
+    # start+end+point per span iteration; nothing torn, nothing dropped.
+    assert len(events) == n_threads * n_spans * 3
+    assert t.dropped == 0
+    seqs = sorted(e["seq"] for e in events)
+    assert seqs == list(range(1, len(events) + 1))
+    starts = {e["id"]: e for e in events if e["ev"] == "start"}
+    for e in events:
+        if e["ev"] == "start" and e.get("parent") is not None:
+            # Parent (if any) must be a span of the same worker thread.
+            assert starts[e["parent"]]["name"] == e["name"]
+
+
+def test_module_level_api_is_noop_without_tracer(tmp_path):
+    assert obs.get_tracer() is None
+    sp = obs.span("anything")
+    assert sp is obs.NULL_SPAN
+    with sp:
+        sp.set(x=1).event("nested")
+    obs.event("orphan")  # must not raise
+    assert obs.last_seq() is None
+
+
+# ---------------------------------------------------------------------------
+# Sink: atomicity/fail-open under fault injection, buffered flush, torn tail.
+# ---------------------------------------------------------------------------
+
+def test_event_write_fault_is_fail_open(tmp_path):
+    """An injected fault at obs.event_write drops events, counts them, and
+    never raises into the instrumented code path."""
+    inj = FaultInjector()
+    inj.arm("obs.event_write", times=2, kind="permanent")
+    resilience.set_injector(inj)
+
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    try:
+        for i in range(4):
+            t.event(f"e{i}")  # first two hit the fault; never raises
+    finally:
+        obs.deactivate(t)
+
+    events = _read_events(path)
+    assert [e["name"] for e in events] == ["e2", "e3"]
+    assert t.dropped == 2
+    assert obs_metrics.counter("obs.events_dropped").value == 2
+
+
+def test_sink_open_failure_keeps_span_timing(tmp_path):
+    """An unwritable sink path degrades to a sink-less tracer: spans still
+    time and nest, nothing raises."""
+    bad = str(tmp_path / "not_a_dir_file")
+    with open(bad, "w") as f:
+        f.write("x")
+    t = obs.activate(os.path.join(bad, "_events.jsonl"))
+    try:
+        with t.span("word", kind="word", word="ship") as sp:
+            assert sp.span_id == 1
+        assert t.last_seq() == 2  # start + end, counted despite no sink
+    finally:
+        obs.deactivate(t)
+
+
+def test_buffered_events_flush_on_close_and_flush(tmp_path):
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    try:
+        t.event("buffered")
+        # Small event volume stays in the buffer until an explicit flush.
+        assert os.path.getsize(path) == 0 if os.path.exists(path) else True
+        t.flush()
+        assert [e["name"] for e in _read_events(path)] == ["buffered"]
+        t.event("second")
+    finally:
+        obs.deactivate(t)  # close() flushes the tail
+    assert [e["name"] for e in _read_events(path)] == ["buffered", "second"]
+
+
+def test_iter_events_skips_torn_tail_strict_raises(tmp_path):
+    path = str(tmp_path / "_events.jsonl")
+    lines = [json.dumps({"v": 1, "seq": 1, "t": 0.0, "ev": "point",
+                         "name": "ok"})]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.write('{"v": 1, "seq": 2, "t": 0.01, "ev": "po')  # killed mid-write
+    assert [e["name"] for e in obs.iter_events(path)] == ["ok"]
+    with pytest.raises(ValueError, match="unparseable"):
+        list(obs.iter_events(path, strict=True))
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry.
+# ---------------------------------------------------------------------------
+
+def test_metrics_snapshot_shapes():
+    obs_metrics.counter("decode.launches").inc()
+    obs_metrics.counter("decode.launches").inc(2)
+    obs_metrics.gauge("aot.decode.hits").set(7)
+    h = obs_metrics.histogram("word.seconds")
+    for v in (1.0, 2.0, 3.0, 10.0):
+        h.observe(v)
+
+    snap = obs_metrics.snapshot()
+    assert snap["counters"]["decode.launches"] == 3
+    assert snap["gauges"]["aot.decode.hits"] == 7
+    hist = snap["histograms"]["word.seconds"]
+    assert hist["count"] == 4 and hist["sum"] == 16.0
+    assert hist["min"] == 1.0 and hist["max"] == 10.0
+    assert hist["p50"] in (2.0, 3.0)
+    # JSON-serializable by construction (the manifest embeds it verbatim).
+    json.dumps(snap)
+
+
+def test_metrics_type_collision_raises_and_reset():
+    obs_metrics.counter("x")
+    with pytest.raises(TypeError):
+        obs_metrics.gauge("x")
+    obs_metrics.reset()
+    obs_metrics.gauge("x")  # fine after reset
+
+
+def test_histogram_reservoir_bounded_and_concurrent():
+    h = obs_metrics.histogram("h")
+    n = obs_metrics._RESERVOIR_CAP * 3
+
+    def worker(base):
+        for i in range(n // 4):
+            h.observe(float(base + i))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n
+    assert len(h._sample) == obs_metrics._RESERVOIR_CAP
+    assert h.quantile(0.5) is not None
+
+
+def test_manifest_snapshots_metrics_and_events_path(tmp_path):
+    from taboo_brittleness_tpu.runtime.manifest import RunManifest
+
+    obs_metrics.counter("decode.launches").inc(5)
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    try:
+        d = RunManifest(command="test").to_dict()
+    finally:
+        obs.deactivate(t)
+    assert d["obs"]["schema_version"] == obs.SCHEMA_VERSION
+    assert d["obs"]["events_path"] == path
+    assert d["obs"]["metrics"]["counters"]["decode.launches"] == 5
+    # The stamp survives observer deactivation (manifest saves post-sweep).
+    d2 = RunManifest(command="test").to_dict()
+    assert d2["obs"]["events_path"] == path
+
+
+# ---------------------------------------------------------------------------
+# Progress heartbeat + staleness.
+# ---------------------------------------------------------------------------
+
+def test_progress_reporter_lifecycle(tmp_path):
+    path = str(tmp_path / "_progress.json")
+    clock = {"t": 100.0}
+    rep = obs_progress.ProgressReporter(
+        path, total_words=4, run_id="r1", interval=3600,
+        min_write_interval=0.0, clock=lambda: clock["t"])
+    rep.write_now()
+
+    rep.word_started("ship")
+    rep.phase("decode")
+    snap = rep.snapshot()
+    assert snap["current_word"] == "ship" and snap["phase"] == "decode"
+    assert snap["eta_seconds"] is None  # no completed word yet
+
+    clock["t"] += 10.0
+    rep.word_done("ship")
+    rep.word_skipped("moon")     # resumed: counts done, not toward the EMA
+    rep.word_quarantined("lake")
+    snap = rep.snapshot()
+    assert snap["words_done"] == 2
+    assert snap["words_quarantined"] == 1
+    assert snap["word_seconds_ema"] == 10.0
+    assert snap["eta_seconds"] == 10.0   # 1 remaining x 10 s EMA
+
+    rep.finish("done")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["status"] == "done" and on_disk["current_word"] is None
+
+
+def test_progress_heartbeat_thread_rewrites_file(tmp_path):
+    path = str(tmp_path / "_progress.json")
+    rep = obs_progress.ProgressReporter(
+        path, total_words=2, interval=0.05, min_write_interval=0.0)
+    with rep:
+        rep.word_started("ship")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    if json.load(f).get("current_word") == "ship":
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.02)
+        else:
+            pytest.fail("heartbeat never wrote the current word")
+    data = obs_progress.read_progress(path)
+    assert data["status"] == "done"
+    assert data["stale"] is False      # finished runs are never stale
+
+
+def test_progress_staleness_detection(tmp_path):
+    path = str(tmp_path / "_progress.json")
+    # tbx: wallclock-ok — forging an old cross-process epoch timestamp, the
+    # one clock read_progress is specified against
+    stale_state = {"v": 1, "updated_at": time.time() - 1000.0,
+                   "heartbeat_seconds": 5.0, "status": "running"}
+    with open(path, "w") as f:
+        json.dump(stale_state, f)
+    data = obs_progress.read_progress(path)
+    assert data["stale"] is True
+    assert data["age_seconds"] >= 999.0
+    # A custom threshold larger than the age flips it back.
+    assert obs_progress.read_progress(path, stale_after=2000)["stale"] is False
+
+
+def test_progress_reports_last_event_age(tmp_path):
+    t = obs.activate(str(tmp_path / "_events.jsonl"))
+    try:
+        t.event("tick")
+        rep = obs_progress.ProgressReporter(
+            str(tmp_path / "_progress.json"), total_words=1,
+            interval=3600, tracer=t)
+        snap = rep.snapshot()
+        assert 0.0 <= snap["last_event_age_seconds"] < 60.0
+    finally:
+        obs.deactivate(t)
+
+
+# ---------------------------------------------------------------------------
+# Memory sampling.
+# ---------------------------------------------------------------------------
+
+def test_memory_sample_host_fields():
+    s = obs_memory.sample()
+    assert s["rss_bytes"] is None or s["rss_bytes"] > 0
+    assert isinstance(s["devices"], list)  # CPU backend: usually empty
+    compact = obs_memory.sample(compact=True)
+    json.dumps(compact)
+    if compact.get("rss_mb") is not None:
+        assert compact["rss_mb"] > 0
+
+
+def test_memory_sampler_disabled_at_zero_hz(tmp_path):
+    t = obs.activate(str(tmp_path / "_events.jsonl"))
+    try:
+        sampler = obs_memory.MemorySampler(t, hz=0)
+        assert sampler.start()._thread is None
+        sampler.stop()
+    finally:
+        obs.deactivate(t)
+
+
+# ---------------------------------------------------------------------------
+# sweep_observer + trace_report on a synthetic sweep.
+# ---------------------------------------------------------------------------
+
+def _synthetic_sweep(out_dir, words=("ship", "moon")):
+    with obs.sweep_observer(str(out_dir), pipeline="synthetic",
+                            words=list(words)) as ob:
+        assert ob.active
+        for word in words:
+            with ob.word(word) as wsp:
+                wsp.set(attempts=1)
+                with ob.phase("checkpoint.load"):
+                    pass
+                with ob.phase("compute:mode"):
+                    with obs.span("decode", kind="program", rows=2):
+                        pass
+                ob.event("aot.build", entry="decode")
+
+
+def test_sweep_observer_writes_events_and_progress(tmp_path):
+    _synthetic_sweep(tmp_path)
+    events_path = str(tmp_path / obs.EVENTS_FILENAME)
+    progress_path = str(tmp_path / obs.PROGRESS_FILENAME)
+    assert os.path.exists(events_path) and os.path.exists(progress_path)
+
+    events = _read_events(events_path)
+    run_starts = [e for e in events
+                  if e["ev"] == "start" and e["kind"] == "run"]
+    assert len(run_starts) == 1
+    assert run_starts[0]["attrs"]["pipeline"] == "synthetic"
+    word_spans = [e for e in events
+                  if e["ev"] == "start" and e["kind"] == "word"]
+    assert [e["attrs"]["word"] for e in word_spans] == ["ship", "moon"]
+
+    progress = obs.read_progress(progress_path)
+    assert progress["status"] == "done"
+    assert progress["words_done"] == 2 and progress["words_total"] == 2
+    # Word durations reached the metrics registry.
+    assert obs_metrics.snapshot()["histograms"]["word.seconds"]["count"] == 2
+    # The synthetic stream passes the schema gate the fixture is held to.
+    assert trace_report.check(events_path) == []
+
+
+def test_sweep_observer_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TBX_OBS", "0")
+    with obs.sweep_observer(str(tmp_path), pipeline="x", words=["w"]) as ob:
+        assert not ob.active
+        with ob.word("w") as sp:
+            assert sp is obs.NULL_SPAN
+    assert not os.path.exists(tmp_path / obs.EVENTS_FILENAME)
+
+
+def test_sweep_observer_nested_reuses_outer_tracer(tmp_path):
+    outer_dir = tmp_path / "outer"
+    inner_dir = tmp_path / "inner"
+    with obs.sweep_observer(str(outer_dir), pipeline="outer",
+                            words=["a"]) as outer:
+        _synthetic_sweep(inner_dir, words=("b",))
+        assert obs.get_tracer() is outer.tracer
+    # The nested sweep's events land in the OUTER sink; inner gets progress
+    # only.
+    outer_events = _read_events(str(outer_dir / obs.EVENTS_FILENAME))
+    assert sum(1 for e in outer_events
+               if e["ev"] == "start" and e["kind"] == "run") == 2
+    assert not os.path.exists(inner_dir / obs.EVENTS_FILENAME)
+    assert os.path.exists(inner_dir / obs.PROGRESS_FILENAME)
+
+
+def test_trace_report_renders_synthetic_sweep(tmp_path, capsys):
+    _synthetic_sweep(tmp_path)
+    events_path = str(tmp_path / obs.EVENTS_FILENAME)
+    rc = trace_report.main([events_path, "--roofline", "none"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run: synthetic" in out
+    # Per-word x per-phase table with gap column + critical-path block.
+    for token in ("ship", "moon", "checkpoint.load", "compute:mode",
+                  "gap", "critical path:", "dispatch gap"):
+        assert token in out
+    # Program summary pools the decode spans.
+    assert "decode" in out and "programs:" in out
+
+
+def test_trace_report_roofline_join(tmp_path, capsys):
+    _synthetic_sweep(tmp_path)
+    detail = tmp_path / "bench_detail.json"
+    detail.write_text(json.dumps({
+        "sweep": {"phase_roofline": {"phases": {
+            "decode": {"ceiling_seconds": 0.5}}}}}))
+    rc = trace_report.main([str(tmp_path / obs.EVENTS_FILENAME),
+                            "--roofline", str(detail)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ratio_of_ceiling" in out and "ceiling_s" in out
+
+
+def test_trace_report_check_catches_violations(tmp_path):
+    path = str(tmp_path / "_events.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "seq": 1, "t": 0.0, "ev": "start",
+                            "kind": "word", "name": "word", "id": 1}) + "\n")
+        f.write(json.dumps({"v": 1, "seq": 1, "t": 0.1, "ev": "end",
+                            "id": 2, "dur": 0.1, "status": "ok"}) + "\n")
+    errors = trace_report.check(path)
+    msgs = "\n".join(errors)
+    assert "seq 1 not increasing" in msgs
+    assert "unknown span id" in msgs
+    assert "never ended" in msgs
+    assert "no root run span" in msgs
+    assert trace_report.main([path, "--check"]) == 1
+    # And the committed fixture stays clean (the check.sh drift gate).
+    assert trace_report.main([FIXTURE_EVENTS, "--check"]) == 0
+
+
+def test_obs_warn_emits_event_and_stderr(tmp_path, capsys):
+    t = obs.activate(str(tmp_path / "_events.jsonl"))
+    try:
+        obs.warn("[study] something soft-failed", name="study.warn", word="x")
+    finally:
+        obs.deactivate(t)
+    events = _read_events(str(tmp_path / "_events.jsonl"))
+    assert events[0]["name"] == "study.warn"
+    assert events[0]["attrs"]["level"] == "warn"
+    assert "soft-failed" in capsys.readouterr().err

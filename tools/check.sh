@@ -19,6 +19,9 @@ fi
 echo "== report sync (exec-summary bench table vs BENCH_r*.json)"
 python tools/report_bench_row.py --check reports/exec_summary/executive_summary.md
 
+echo "== trace_report schema gate (committed obs fixture)"
+python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
+
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \

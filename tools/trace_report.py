@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""Render a sweep's ``_events.jsonl`` into a per-word x per-phase timeline.
+
+    python tools/trace_report.py results/token_forcing/words/_events.jsonl
+    python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
+
+Output (plain text, stdout):
+
+- the run header (pipeline, run id, wall anchor, total duration, drop count);
+- a per-word x per-phase table: seconds spent in each phase of each word,
+  the word total, and the word's *dispatch gap* — word-span time covered by
+  NO phase span, i.e. host-side glue between dispatches (collect/JSON/
+  planning tails; the loss class Kernel Looping (arXiv:2410.23668) shows
+  only fine-grained timing exposes);
+- a critical-path summary: which phase dominates the run, total gap, and
+  the slowest word;
+- a program summary (decode/checkpoint.load spans): count, total, mean;
+- with ``--roofline`` (default: results/bench_detail.json when present),
+  each program/phase whose name matches a ``sweep.phase_roofline`` phase
+  (decode/readout/nll) gets its measured mean joined against that phase's
+  ``ceiling_seconds`` — ratio-of-ceiling per phase, the PR-3 honesty check
+  applied to the live timeline instead of the bench.
+
+``--check`` validates schema + invariants (strict JSONL, known schema
+version, monotone seq, balanced span start/end, exactly one run span root)
+and exits non-zero on violation — tools/check.sh runs it over a committed
+fixture so the event schema cannot drift silently.
+
+stdlib-only on purpose: this must run on a laptop against an rsync'd
+results directory with no jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from taboo_brittleness_tpu.obs.trace import SCHEMA_VERSION, iter_events  # noqa: E402
+
+DEFAULT_ROOFLINE = os.path.join(_REPO, "results", "bench_detail.json")
+
+#: Trace span names that map onto bench roofline phases.
+_ROOFLINE_NAMES = ("decode", "readout", "nll")
+
+
+class Span:
+    __slots__ = ("id", "name", "kind", "parent", "t0", "dur", "status",
+                 "attrs", "mem")
+
+    def __init__(self, ev: Dict[str, Any]):
+        self.id = ev.get("id")
+        self.name = ev.get("name", "?")
+        self.kind = ev.get("kind", "?")
+        self.parent = ev.get("parent")
+        self.t0 = float(ev.get("t", 0.0))
+        self.dur: Optional[float] = None
+        self.status: Optional[str] = None
+        self.attrs: Dict[str, Any] = dict(ev.get("attrs") or {})
+        self.mem: Optional[Dict[str, Any]] = None
+
+    @property
+    def t1(self) -> Optional[float]:
+        return None if self.dur is None else self.t0 + self.dur
+
+
+def build_spans(events: Sequence[Dict[str, Any]]) -> Tuple[
+        Dict[int, Span], List[Dict[str, Any]]]:
+    """Match start/end events into Span objects; returns (spans by id,
+    point events).  Unfinished spans keep ``dur=None`` (a killed run)."""
+    spans: Dict[int, Span] = {}
+    points: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("ev") == "start":
+            spans[ev["id"]] = Span(ev)
+        elif ev.get("ev") == "end":
+            sp = spans.get(ev.get("id"))
+            if sp is None:            # end without start: synthesize
+                sp = Span(ev)
+                spans[ev["id"]] = sp
+            sp.dur = float(ev.get("dur", 0.0))
+            sp.status = ev.get("status")
+            sp.attrs.update(ev.get("attrs") or {})
+            sp.mem = ev.get("mem")
+        elif ev.get("ev") == "point":
+            points.append(ev)
+    return spans, points
+
+
+def _children(spans: Dict[int, Span], parent_id) -> List[Span]:
+    return sorted((s for s in spans.values() if s.parent == parent_id),
+                  key=lambda s: s.t0)
+
+
+def _fmt_s(x: Optional[float]) -> str:
+    if x is None:
+        return "-"
+    if x >= 100:
+        return f"{x:.0f}"
+    if x >= 1:
+        return f"{x:.2f}"
+    return f"{x:.3f}"
+
+
+def _table(header: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def line(cells):
+        return "  ".join(str(c).rjust(w) if i else str(c).ljust(w)
+                         for i, (c, w) in enumerate(zip(cells, widths)))
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def load_roofline(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    """``sweep.phase_roofline.phases`` from a bench_detail.json, or None."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            detail = json.load(f)
+        sweep = detail.get("sweep") or {}
+        roofline = sweep.get("phase_roofline") or {}
+        phases = roofline.get("phases")
+        return phases or None
+    except (OSError, ValueError):
+        return None
+
+
+def report(events: List[Dict[str, Any]], *,
+           roofline: Optional[Dict[str, Any]] = None) -> str:
+    spans, points = build_spans(events)
+    out: List[str] = []
+
+    runs = [s for s in spans.values() if s.kind == "run"]
+    for run in sorted(runs, key=lambda s: s.t0):
+        pipeline = run.attrs.get("pipeline", run.name)
+        out.append(f"run: {pipeline}  "
+                   f"(duration {_fmt_s(run.dur)}s, "
+                   f"{run.attrs.get('words_total', '?')} words planned)")
+
+        words = [s for s in _children(spans, run.id) if s.kind == "word"]
+        phase_names: List[str] = []
+        rows = []
+        total_gap = 0.0
+        for w in words:
+            phases = [s for s in _children(spans, w.id) if s.kind == "phase"]
+            agg: Dict[str, float] = {}
+            for p in phases:
+                agg[p.name] = agg.get(p.name, 0.0) + (p.dur or 0.0)
+                if p.name not in phase_names:
+                    phase_names.append(p.name)
+            covered = sum(agg.values())
+            gap = (max(0.0, w.dur - covered)
+                   if w.dur is not None and phases else None)
+            if gap is not None:
+                total_gap += gap
+            rows.append((w, agg, gap))
+
+        header = (["word"] + phase_names + ["gap", "total", "notes"])
+        body = []
+        for w, agg, gap in rows:
+            notes = []
+            if w.attrs.get("resumed"):
+                notes.append("resumed")
+            if w.attrs.get("quarantined"):
+                notes.append("QUARANTINED")
+            if int(w.attrs.get("attempts", 1)) > 1:
+                notes.append(f"attempts={w.attrs['attempts']}")
+            if w.status == "error":
+                notes.append("error")
+            if w.dur is None:
+                notes.append("unfinished")
+            body.append([str(w.attrs.get("word", w.name))]
+                        + [_fmt_s(agg.get(p)) for p in phase_names]
+                        + [_fmt_s(gap), _fmt_s(w.dur), ",".join(notes)])
+        if body:
+            out.append("")
+            out.append(_table(header, body))
+
+        # Critical-path summary.
+        phase_totals = {
+            p: sum(agg.get(p, 0.0) for _, agg, _ in rows)
+            for p in phase_names}
+        timed = [(w, agg, gap) for w, agg, gap in rows if w.dur is not None
+                 and not w.attrs.get("resumed")]
+        out.append("")
+        out.append("critical path:")
+        for name, tot in sorted(phase_totals.items(), key=lambda kv: -kv[1]):
+            share = (tot / run.dur * 100.0) if run.dur else 0.0
+            out.append(f"  {name:<24} {_fmt_s(tot)}s  ({share:.0f}% of run)")
+        out.append(f"  {'dispatch gap':<24} {_fmt_s(total_gap)}s  "
+                   "(word time outside any phase span)")
+        if timed:
+            slowest = max(timed, key=lambda r: r[0].dur)
+            out.append(f"  slowest word: "
+                       f"{slowest[0].attrs.get('word')} "
+                       f"({_fmt_s(slowest[0].dur)}s)")
+        out.append("")
+
+    # Program summary (all runs pooled): decode launches, checkpoint loads...
+    programs: Dict[str, List[Span]] = {}
+    for s in spans.values():
+        if s.kind == "program" and s.dur is not None:
+            programs.setdefault(s.name, []).append(s)
+    if programs:
+        header = ["program", "count", "total_s", "mean_s"]
+        if roofline:
+            header += ["ceiling_s", "ratio_of_ceiling"]
+        body = []
+        for name, sps in sorted(programs.items()):
+            tot = sum(s.dur for s in sps)
+            mean = tot / len(sps)
+            row = [name, str(len(sps)), _fmt_s(tot), _fmt_s(mean)]
+            if roofline:
+                cell = roofline.get(name) if name in _ROOFLINE_NAMES else None
+                ceiling = (cell or {}).get("ceiling_seconds")
+                row += [_fmt_s(ceiling),
+                        (f"{ceiling / mean:.3f}"
+                         if ceiling and mean > 0 else "-")]
+            body.append(row)
+        out.append("programs:")
+        out.append(_table(header, body))
+        if roofline:
+            out.append("  (ceiling_s from sweep.phase_roofline: the bench's "
+                       "per-phase roofline at ITS launch shape — comparable "
+                       "only when the sweep ran the bench shapes; "
+                       "ratio_of_ceiling = ceiling/mean, 1.0 = at the bound)")
+        out.append("")
+
+    # Notable point events.
+    notable = [p for p in points
+               if p.get("name", "").startswith(("resilience.", "aot.build",
+                                                "study.pre_dispatch_failed"))]
+    if notable:
+        out.append(f"events: {len(notable)} notable")
+        for p in notable[:50]:
+            attrs = p.get("attrs") or {}
+            brief = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items())
+                              if k in ("word", "stage", "attempt", "entry",
+                                       "source", "error"))
+            out.append(f"  t={_fmt_s(float(p.get('t', 0)))}s "
+                       f"{p.get('name')}  {brief}")
+        out.append("")
+    return "\n".join(out)
+
+
+def check(path: str) -> List[str]:
+    """Schema/invariant violations for ``--check`` (empty = clean)."""
+    errors: List[str] = []
+    events: List[Dict[str, Any]] = []
+    try:
+        events = list(iter_events(path, strict=True))
+    except ValueError as e:
+        return [str(e)]
+    if not events:
+        return ["no events"]
+    last_seq = 0
+    open_ids: Dict[int, str] = {}
+    run_roots = 0
+    for i, ev in enumerate(events, start=1):
+        where = f"{path}:{i}"
+        for key in ("v", "seq", "t", "ev"):
+            if key not in ev:
+                errors.append(f"{where}: missing required key {key!r}")
+        if ev.get("v", 0) > SCHEMA_VERSION:
+            errors.append(f"{where}: schema version {ev.get('v')} is newer "
+                          f"than this reader ({SCHEMA_VERSION})")
+        seq = ev.get("seq", 0)
+        if seq <= last_seq:
+            errors.append(f"{where}: seq {seq} not increasing (prev {last_seq})")
+        last_seq = seq
+        kind = ev.get("ev")
+        if kind == "start":
+            if "id" not in ev or "name" not in ev or "kind" not in ev:
+                errors.append(f"{where}: start event missing id/name/kind")
+                continue
+            open_ids[ev["id"]] = ev["name"]
+            if ev.get("kind") == "run" and ev.get("parent") is None:
+                run_roots += 1
+        elif kind == "end":
+            if ev.get("id") not in open_ids:
+                errors.append(f"{where}: end for unknown span id {ev.get('id')}")
+            else:
+                del open_ids[ev["id"]]
+            if "dur" not in ev or "status" not in ev:
+                errors.append(f"{where}: end event missing dur/status")
+        elif kind == "point":
+            if "name" not in ev:
+                errors.append(f"{where}: point event missing name")
+        else:
+            errors.append(f"{where}: unknown ev type {kind!r}")
+    if open_ids:
+        errors.append(f"{path}: {len(open_ids)} span(s) never ended: "
+                      f"{sorted(open_ids.values())[:5]}")
+    if run_roots == 0:
+        errors.append(f"{path}: no root run span")
+    return errors
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render _events.jsonl into a per-word x per-phase "
+                    "timeline with critical-path and dispatch-gap summary.")
+    ap.add_argument("events", help="path to an _events.jsonl file")
+    ap.add_argument("--roofline", default=None, metavar="BENCH_DETAIL_JSON",
+                    help="join sweep.phase_roofline ceilings from this "
+                         "bench_detail.json (default: results/"
+                         "bench_detail.json when present; 'none' disables)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate schema/invariants and exit non-zero on "
+                         "violation (the check.sh drift gate)")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.events):
+        print(f"trace_report: {args.events} not found", file=sys.stderr)
+        return 2
+
+    if args.check:
+        errors = check(args.events)
+        if errors:
+            for e in errors:
+                print(f"trace_report: {e}", file=sys.stderr)
+            print(f"trace_report: FAIL ({len(errors)} violation(s))")
+            return 1
+        n = sum(1 for _ in iter_events(args.events))
+        print(f"trace_report: OK ({n} events, schema v{SCHEMA_VERSION})")
+        return 0
+
+    roofline_path = args.roofline
+    if roofline_path == "none":
+        roofline = None
+    else:
+        roofline = load_roofline(roofline_path or DEFAULT_ROOFLINE)
+    events = list(iter_events(args.events))
+    if not events:
+        print("trace_report: no parseable events", file=sys.stderr)
+        return 1
+    print(report(events, roofline=roofline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
