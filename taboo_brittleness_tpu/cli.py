@@ -11,9 +11,20 @@ reference bug noted in SURVEY.md anti-goals):
     python -m taboo_brittleness_tpu interventions [-c CFG] --word W [--sae-npz PATH]
     python -m taboo_brittleness_tpu token-forcing [-c CFG] [--modes pregame postgame]
     python -m taboo_brittleness_tpu prompting     [-c CFG] [--modes naive adversarial]
+    python -m taboo_brittleness_tpu supervise --output-dir DIR -- <subcommand ...>
 
 Every subcommand accepts the reference's ``configs/default.yaml`` schema
 unchanged (config.load_config).
+
+Exit codes (the restart-vs-fail contract outer orchestration keys off):
+
+- 0 — the run completed.
+- 1 — the sweep completed but words were QUARANTINED (in-process retries
+  exhausted; rerunning replays the failure — inspect ``_failures.json``).
+- 75 — ``EX_TEMPFAIL``: the run DRAINED on a preemption notice
+  (SIGTERM/SIGINT) at a word boundary; partial results on disk are valid
+  and a relaunch resumes them (``runtime.supervise`` restarts on exactly
+  this code).
 """
 
 from __future__ import annotations
@@ -89,6 +100,22 @@ def _report_failures(manifest, ledger_or_failures) -> int:
           f"{sorted(quarantined)} (see _failures.json next to the results)",
           file=sys.stderr)
     return 1
+
+
+def _exit_code(rc: int) -> int:
+    """Map a pipeline exit through the drain contract: a run that stopped
+    at a preemption drain exits 75 (``EX_TEMPFAIL`` — resumable) REGARDLESS
+    of quarantine state, because the sweep did not finish and the missing
+    words are recoverable by relaunch, not lost."""
+    from taboo_brittleness_tpu.runtime import supervise
+
+    if supervise.drain_requested():
+        # tbx: TBX009-ok — CLI stderr contract (drain notice)
+        print("[supervise] run drained on a preemption notice; partial "
+              "results are valid — relaunch (or `supervise`) resumes them",
+              file=sys.stderr)
+        return supervise.EXIT_DRAINED
+    return rc
 
 
 def _mesh(config: Config):
@@ -176,7 +203,7 @@ def cmd_generate(args) -> int:
     print(json.dumps({w: len(v) for w, v in done.items()}))  # tbx: TBX009-ok — CLI stdout contract (results JSON)
     rc = _report_failures(manifest, ledger)
     _finish(args, manifest, processed)
-    return rc
+    return _exit_code(rc)
 
 
 def cmd_logit_lens(args) -> int:
@@ -356,7 +383,7 @@ def cmd_interventions(args) -> int:
         print(f"studies ({len(results)} words) -> {out_dir}")  # tbx: TBX009-ok — CLI stdout contract (results path)
         rc = _report_failures(manifest, ledger)
         _finish(args, manifest, out_dir)
-        return rc
+        return _exit_code(rc)
     _finish(args, manifest, out_dir)
     return 0
 
@@ -383,7 +410,7 @@ def cmd_token_forcing(args) -> int:
     print(f"results -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
-    return rc
+    return _exit_code(rc)
 
 
 def cmd_prompting(args) -> int:
@@ -406,7 +433,35 @@ def cmd_prompting(args) -> int:
     print(f"results -> {out}")  # tbx: TBX009-ok — CLI stdout contract (results path)
     rc = _report_failures(manifest, results.get("failures"))
     _finish(args, manifest, os.path.dirname(out))
-    return rc
+    return _exit_code(rc)
+
+
+def cmd_supervise(args) -> int:
+    """Run a pipeline subcommand under the preemption-safe supervisor
+    (``runtime.supervise``): launch as a child process, restart on crash or
+    wedge within the incarnation budget, resume on drain, merge artifacts."""
+    from taboo_brittleness_tpu.runtime import supervise
+
+    child = list(args.child or [])
+    while child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        raise SystemExit(
+            "supervise: missing child subcommand — usage: "
+            "supervise --output-dir DIR -- token-forcing [args...]")
+    argv = [sys.executable, "-m", "taboo_brittleness_tpu", *child]
+    res = supervise.supervise(
+        argv, args.output_dir,
+        max_incarnations=args.max_incarnations,
+        poll_interval=args.poll, grace=args.grace,
+        wedge_after=args.wedge_after)
+    # tbx: TBX009-ok — CLI stdout contract (supervision summary JSON)
+    print(json.dumps({"status": res.status, "exit_code": res.exit_code,
+                      "incarnations": [
+                          {k: r.get(k) for k in ("incarnation", "outcome",
+                                                 "exit_code")}
+                          for r in res.incarnations]}, indent=2))
+    return res.exit_code
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -464,6 +519,42 @@ def build_parser() -> argparse.ArgumentParser:
                     help="re-measure words whose per-word results already "
                          "exist (default: resume by skipping them)")
     pr.set_defaults(fn=cmd_prompting)
+
+    sv = sub.add_parser(
+        "supervise",
+        help="run a subcommand under the preemption-safe supervisor",
+        description="Launch any pipeline subcommand as a supervised child "
+                    "process: restart on crash or wedged heartbeat within a "
+                    "bounded incarnation budget (seeded-jitter backoff), "
+                    "relaunch immediately on a drained exit (75), pass "
+                    "through 0 (done) and 1 (quarantined words). Ledgers, "
+                    "events, and manifests merge across incarnations so the "
+                    "output directory reads as one run. Env knobs: "
+                    "TBX_SUPERVISE_MAX_INCARNATIONS, TBX_SUPERVISE_POLL_S, "
+                    "TBX_SUPERVISE_GRACE_S, TBX_SUPERVISE_WEDGE_S, "
+                    "TBX_SUPERVISE_BACKOFF_S.")
+    sv.add_argument("--output-dir", required=True,
+                    help="directory the child heartbeats _progress.json "
+                         "into (the pipelines' per-word results directory); "
+                         "_supervise.json and merged blocks land here too")
+    sv.add_argument("--max-incarnations", type=int, default=None,
+                    help="total launch budget (default: "
+                         "TBX_SUPERVISE_MAX_INCARNATIONS or 5)")
+    sv.add_argument("--poll", type=float, default=None,
+                    help="progress poll interval seconds (default: "
+                         "TBX_SUPERVISE_POLL_S or 1.0)")
+    sv.add_argument("--grace", type=float, default=None,
+                    help="SIGTERM->SIGKILL grace window seconds (default: "
+                         "TBX_SUPERVISE_GRACE_S or 15)")
+    sv.add_argument("--wedge-after", type=float, default=None,
+                    help="kill a child whose pipeline emitted no event for "
+                         "this long while its heartbeat stays fresh "
+                         "(default: TBX_SUPERVISE_WEDGE_S or 300)")
+    sv.add_argument("child", nargs=argparse.REMAINDER,
+                    metavar="-- subcommand ...",
+                    help="the pipeline subcommand (and its args) to run "
+                         "supervised, after a literal --")
+    sv.set_defaults(fn=cmd_supervise)
     return p
 
 
@@ -480,6 +571,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (TBX_COMPILE_CACHE=0 opts out).
     jax_cache.enable()
     args = build_parser().parse_args(argv)
+    # Latch SIGTERM/SIGINT into the graceful drain: pipelines stop at the
+    # next word boundary and exit 75 (see module docstring).  The supervise
+    # subcommand polls the same latch to forward the notice to its child.
+    from taboo_brittleness_tpu.runtime import supervise
+
+    supervise.install_drain_handlers()
     return args.fn(args)
 
 

@@ -79,6 +79,7 @@ class SweepObserver:
         self.reporter = reporter
         self._owns_tracer = owns_tracer
         self._mem_sampler = mem_sampler
+        self._final_status: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -139,6 +140,17 @@ class SweepObserver:
             except Exception:  # noqa: BLE001 — fail-open
                 pass
 
+    def mark_drained(self) -> None:
+        """The sweep is stopping BETWEEN words for a preemption drain
+        (``runtime.supervise``): the progress file's final status becomes
+        ``"preempted"`` (the supervisor's safe-to-resume marker) and the run
+        span carries ``drained=True`` so the timeline shows the incarnation
+        boundary."""
+        self._final_status = "preempted"
+        if self.run_span is not None:
+            self.run_span.set(drained=True)
+        self.event("sweep.drained")
+
     def close(self, error: Optional[BaseException] = None) -> None:
         if not self.active:
             return
@@ -151,7 +163,9 @@ class SweepObserver:
         if self.run_span is not None:
             self.run_span.end(error=error)
         if self.reporter is not None:
-            self.reporter.stop(status="error" if error is not None else "done")
+            status = self._final_status or (
+                "error" if error is not None else "done")
+            self.reporter.stop(status=status)
         if self._owns_tracer and self.tracer is not None:
             deactivate(self.tracer)
 
@@ -200,8 +214,13 @@ def sweep_observer(output_dir: Optional[str], *, pipeline: str,
                 run_id=run_id or uuid.uuid4().hex[:12])
         else:
             tracer = outer
+        from taboo_brittleness_tpu.runtime.resilience import (
+            current_incarnation)
+
+        inc = current_incarnation()
         run_span = tracer.span(
-            "sweep", kind="run", pipeline=pipeline, words_total=len(words))
+            "sweep", kind="run", pipeline=pipeline, words_total=len(words),
+            **({"incarnation": inc} if inc else {}))
         reporter = ProgressReporter(
             os.path.join(output_dir, PROGRESS_FILENAME),
             total_words=len(words), run_id=tracer.run_id,

@@ -28,7 +28,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+from taboo_brittleness_tpu.runtime.resilience import (
+    atomic_json_dump, current_incarnation)
 
 PROGRESS_FILENAME = "_progress.json"
 
@@ -149,6 +150,10 @@ class ProgressReporter:
             "v": 1,
             "run_id": self.run_id,
             "pid": os.getpid(),
+            # Supervised-run ordinal (0 standalone): the supervisor matches
+            # this + pid so a predecessor's stale file never reads as the
+            # fresh child being wedged.
+            "incarnation": current_incarnation(),
             # Epoch timestamp: the reader computes staleness as now - this.
             # tbx: wallclock-ok — heartbeat freshness mark, not duration math
             "updated_at": time.time(),
@@ -215,18 +220,30 @@ class ProgressReporter:
 
 
 def read_progress(path: str, *,
-                  stale_after: Optional[float] = None) -> Dict[str, Any]:
+                  stale_after: Optional[float] = None,
+                  missing_ok: bool = False) -> Dict[str, Any]:
     """Load a progress file and derive liveness:
 
     - ``age_seconds``: now - updated_at (wall clock; the writer may be
       another host, so monotonic cannot apply here).
     - ``stale``: age > ``stale_after`` (default: 3x the file's own heartbeat
       interval) — the process is presumed dead or wedged.
+
+    ``missing_ok=True`` turns a missing/unreadable file into
+    ``{"status": "absent", "stale": False}`` instead of raising: before the
+    first heartbeat lands there is nothing to read, and a watcher (the
+    supervisor, a remote poll loop) must not need a try/except racing the
+    child's startup.
     """
     import json
 
-    with open(path) as f:
-        data = json.load(f)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        if missing_ok:
+            return {"status": "absent", "stale": False}
+        raise
     # tbx: wallclock-ok — cross-process freshness check needs the epoch clock
     age = max(0.0, time.time() - float(data.get("updated_at", 0)))
     threshold = (stale_after if stale_after is not None

@@ -151,10 +151,55 @@ _FLUSH_BYTES = 32 * 1024
 _FLUSH_INTERVAL_S = 1.0
 
 
+def _resume_marks(path: str) -> "tuple[int, int]":
+    """(last seq, max span id) parsed from an existing sink's tail window.
+
+    A supervised run appends several processes' event streams to ONE
+    ``_events.jsonl`` (each incarnation, plus the supervisor's own point
+    events between launches).  Resuming both counters from the file keeps
+    the merged stream's ``seq`` strictly monotone and its span ids unique —
+    the invariants ``trace_report --check`` holds the schema to — without
+    any cross-process coordination beyond O_APPEND.  Torn tail lines (a
+    killed incarnation) are skipped, matching ``iter_events``.  Spans older
+    than the 64 KiB tail window can in principle alias an id; that degrades
+    a rendered report, never a run.
+    """
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0, 0
+    if not size:
+        return 0, 0
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode("utf-8", "replace")
+    except OSError:
+        return 0, 0
+    seq = max_id = 0
+    for line in tail.splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(ev, dict):
+            continue
+        try:
+            seq = max(seq, int(ev.get("seq", 0) or 0))
+            max_id = max(max_id, int(ev.get("id", 0) or 0))
+        except (TypeError, ValueError):
+            continue
+    return seq, max_id
+
+
 class Tracer:
     """One run's event sink.  All methods are thread-safe; parentage is
     tracked per-thread (a span opened on a worker thread without an explicit
-    ``parent=`` nests under nothing, not under another thread's span)."""
+    ``parent=`` nests under nothing, not under another thread's span).
+
+    Opening a sink that already has events RESUMES its seq/span-id counters
+    from the file tail (:func:`_resume_marks`) — the incarnation-aware
+    append contract of ``runtime.supervise``."""
 
     def __init__(self, path: Optional[str], *, run_id: Optional[str] = None):
         self.path = path
@@ -174,6 +219,8 @@ class Tracer:
         if path is not None:
             try:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                seq0, id0 = _resume_marks(path)
+                self._seq, self._next_id = seq0, id0 + 1
                 self._fd = os.open(
                     path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
             except OSError:

@@ -175,7 +175,7 @@ def run_generation(
     are absent from the returned dict.  ``fail_fast=True`` restores
     raise-on-first-failure (the pre-resilience contract)."""
     from taboo_brittleness_tpu import obs
-    from taboo_brittleness_tpu.runtime import resilience
+    from taboo_brittleness_tpu.runtime import resilience, supervise
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
     processed = processed_dir or config.output.processed_dir
@@ -188,6 +188,11 @@ def run_generation(
     with obs.sweep_observer(processed, pipeline="generation",
                             words=word_list) as ob:
         for i, word in enumerate(word_list):
+            if supervise.drain_requested():
+                # Preemption drain between words: the cache cells written so
+                # far are atomic, the next incarnation resumes them.
+                ob.mark_drained()
+                break
             stage = {"name": "checkpoint.load"}
 
             def run_one() -> List[int]:

@@ -1617,7 +1617,7 @@ def run_intervention_studies(
     """
     import time as _time
 
-    from taboo_brittleness_tpu.runtime import resilience
+    from taboo_brittleness_tpu.runtime import resilience, supervise
     from taboo_brittleness_tpu.runtime.checkpoints import prefetch_next
 
     words = list(words if words is not None else config.words)
@@ -1678,6 +1678,13 @@ def run_intervention_studies(
                                   words=words)
     with observer as ob:
         for i, word in enumerate(words):
+            if supervise.drain_requested():
+                # Preemption drain between words (runtime.supervise): the
+                # previous word's JSON is already atomically on disk, so the
+                # next incarnation resumes exactly here; progress ends
+                # status="preempted" and the CLI exits 75.
+                ob.mark_drained()
+                break
             path = os.path.join(output_dir, f"{word}.json")
             saved = done_entry(word)
             if saved is not None:
@@ -1725,6 +1732,10 @@ def run_intervention_studies(
                 def dispatch_next_baseline(nxt=todo[0] if todo else None):
                     nonlocal prepared_next
                     if nxt is None or prepared_next is not None:
+                        return
+                    if supervise.drain_requested():
+                        # Draining: the next word will not run in this
+                        # incarnation — don't waste its baseline dispatch.
                         return
                     try:
                         p2, c2, t2 = model_loader(nxt)

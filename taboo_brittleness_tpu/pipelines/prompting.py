@@ -146,6 +146,9 @@ def run_prompting_attacks(
         "prompt_provenance": {m: prompt_provenance(config, m) for m in modes},
         "words": results,
     }
+    if outcome.drained:
+        # Preemption drain (see run_token_forcing): exit-75 marker.
+        out["drained"] = True
     if not outcome.ok or outcome.ledger.retried:
         # Same contract as run_token_forcing: quarantines drive the exit
         # code, retried-to-success counts ride along for the manifest.

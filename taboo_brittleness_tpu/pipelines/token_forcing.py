@@ -339,6 +339,10 @@ def run_token_forcing(
         for mode in modes
     }
     out = {"overall": overall, "words": results}
+    if outcome.drained:
+        # Preemption drain: the aggregate covers only the words that ran —
+        # the CLI maps this to exit 75 (safe to resume).
+        out["drained"] = True
     if not outcome.ok or outcome.ledger.retried:
         # Quarantines drive the CLI's non-zero exit; retried-to-success
         # counts ride along so the manifest records the transient-noise

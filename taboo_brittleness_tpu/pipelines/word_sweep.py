@@ -23,6 +23,12 @@ ONE place so the resume, memoization, and FAILURE rules cannot drift apart:
   partial results return together with a :class:`~.resilience.FailureLedger`
   (``<output_dir>/_failures.json``) recording stage, attempts, and the final
   exception per word.  ``fail_fast=True`` restores raise-on-first-failure.
+- **Drain:** (``runtime.supervise``) a SIGTERM/SIGINT latched by the drain
+  controller stops the sweep BETWEEN words — the in-flight word's atomic
+  write and obs flush complete first, progress is stamped
+  ``status="preempted"``, and the outcome returns ``drained=True`` so the
+  CLI exits 75 (``EX_TEMPFAIL``): a preemption notice is a clean checkpoint
+  boundary, and the next incarnation resumes at the first unwritten word.
 """
 
 from __future__ import annotations
@@ -35,7 +41,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 from taboo_brittleness_tpu import obs
 from taboo_brittleness_tpu.config import Config
-from taboo_brittleness_tpu.runtime import resilience
+from taboo_brittleness_tpu.runtime import resilience, supervise
 from taboo_brittleness_tpu.runtime.resilience import (
     FailureLedger, RetryPolicy, atomic_json_dump)
 
@@ -43,10 +49,13 @@ from taboo_brittleness_tpu.runtime.resilience import (
 @dataclasses.dataclass
 class SweepOutcome:
     """Partial-results contract of :func:`run_word_sweep`: everything that
-    finished, plus the ledger describing everything that did not."""
+    finished, plus the ledger describing everything that did not.
+    ``drained=True`` means the sweep stopped early at a preemption drain —
+    the missing words are RESUMABLE, not failed."""
 
     results: Dict[str, Any]
     ledger: FailureLedger
+    drained: bool = False
 
     @property
     def quarantined(self) -> Dict[str, Any]:
@@ -122,8 +131,16 @@ def run_word_sweep(
     results: Dict[str, Any] = {}
     memo_key: Any = None
     memo: Dict[str, Any] = {}
+    drained = False
     with obs.sweep_observer(output_dir, pipeline=pipeline, words=words) as ob:
         for i, word in enumerate(words):
+            if supervise.drain_requested():
+                # Preemption drain: stop BETWEEN words — the previous word's
+                # atomic write is complete, so the next incarnation resumes
+                # exactly here.
+                ob.mark_drained()
+                drained = True
+                break
             saved = load_done(word)
             if saved is not None:
                 results[word] = saved
@@ -157,6 +174,16 @@ def run_word_sweep(
                             memo[mode] = compute_mode(
                                 params, cfg, tok, config, mode)
                         entry[mode] = score_word(config, word, mode, memo[mode])
+                if output_dir:
+                    # Inside the guarded scope so an injected/real write
+                    # fault retries then quarantines the word (and the
+                    # ``die`` crash-consistency fault kills mid-word, before
+                    # the atomic rename — the resume harness's armed site).
+                    stage["name"] = "write"
+                    with ob.phase("write"):
+                        resilience.fire("cache.write", word=word,
+                                        path=word_path(word))
+                        atomic_json_dump(entry, word_path(word))
                 return entry
 
             with ob.word(word) as wsp:
@@ -176,7 +203,4 @@ def run_word_sweep(
                         drop(word)
                     continue
                 results[word] = outcome.value
-                if output_dir:
-                    with ob.phase("write"):
-                        atomic_json_dump(outcome.value, word_path(word))
-    return SweepOutcome(results=results, ledger=ledger)
+    return SweepOutcome(results=results, ledger=ledger, drained=drained)

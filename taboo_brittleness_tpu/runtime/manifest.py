@@ -125,6 +125,24 @@ class RunManifest:
             pass
         return block
 
+    def _incarnation_block(self) -> Dict[str, Any]:
+        """Supervised-run stamp (``runtime.supervise``): which incarnation
+        of a supervised run wrote this manifest, and whether it exited on a
+        preemption drain.  Empty (omitted) for a plain standalone run, so
+        unsupervised manifests are byte-identical to before.  Fail-open."""
+        try:
+            from taboo_brittleness_tpu.runtime import supervise
+            from taboo_brittleness_tpu.runtime.resilience import (
+                current_incarnation)
+
+            inc = current_incarnation()
+            drained = supervise.drain_requested()
+            if not inc and not drained:
+                return {}
+            return {"incarnation": {"id": inc, "drained": drained}}
+        except Exception:  # noqa: BLE001 — manifest must never fail a run
+            return {}
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "run_id": self.run_id,
@@ -136,6 +154,7 @@ class RunManifest:
             "stages": self.stages,
             "artifacts": self.artifacts,
             "obs": self._obs_block(),
+            **self._incarnation_block(),
             **({"failures": self.failures} if self.failures else {}),
             **({"retries": self.retries} if self.retries else {}),
             **({"extra": self.extra} if self.extra else {}),

@@ -23,7 +23,16 @@ whichever frame raised first:
   (``checkpoint.read``, ``cache.write``, ``prefetch.thread``,
   ``decode.launch``) that tests and the ``TABOO_FAULT_PLAN`` env hook can
   arm with schedules (fail-N-then-succeed, always-fail, delay,
-  truncate-write).  Sites are no-ops when nothing is armed.
+  truncate-write, die-at-site).  Sites are no-ops when nothing is armed.
+
+Incarnations (``runtime.supervise``): a supervised run relaunches the same
+pipeline as a sequence of child processes.  Each child carries its ordinal in
+``TBX_INCARNATION`` (:func:`current_incarnation`); the ledger stamps every
+retry/quarantine entry with the incarnation that recorded it and PRESERVES
+prior incarnations' retry entries on resume, so the merged
+``_failures.json`` of a multi-incarnation run attributes each event to the
+process that saw it.  Fault specs accept an ``incarnation`` scope so crash
+tests can arm "die in incarnation 0, wedge in incarnation 1" from one plan.
 
 Everything here is host-side control flow — none of it runs under trace
 (backoff sleeps and clocks would otherwise be baked into compiled programs).
@@ -39,6 +48,23 @@ import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
+
+# ---------------------------------------------------------------------------
+# Incarnations.
+# ---------------------------------------------------------------------------
+
+#: Set by the supervisor (``runtime.supervise``) on every child it launches:
+#: the 0-based ordinal of this process in the supervised run.
+INCARNATION_ENV = "TBX_INCARNATION"
+
+
+def current_incarnation() -> int:
+    """This process's incarnation ordinal (0 for an unsupervised run)."""
+    try:
+        return int(os.environ.get(INCARNATION_ENV, "0"))
+    except ValueError:
+        return 0
+
 
 # ---------------------------------------------------------------------------
 # Error taxonomy.
@@ -320,20 +346,32 @@ class FailureLedger:
       count, and the final exception.  The sweep *continued* past them; the
       CLI exits non-zero iff this block is non-empty.
     - ``retried``: words that eventually succeeded but needed retries
-      (attempt counts) — the sweep's transient-noise floor, kept for the run
-      manifest.
+      (``{"attempts": n, "incarnation": k}``) — the sweep's transient-noise
+      floor, kept for the run manifest.
 
     A rerun loads the existing ledger and CLEARS a word's quarantine entry
     when it finally succeeds, so the ledger always describes the current
     state of the output directory, not the union of every past run.
+
+    Incarnations: every entry is stamped with the ``incarnation`` that
+    recorded it (:func:`current_incarnation` unless overridden).  A RESUME
+    incarnation (``incarnation > 0``) additionally preserves prior
+    incarnations' ``retried`` entries instead of resetting them, so the
+    ledger a supervised run leaves behind is the MERGED account of the whole
+    run — each retry and quarantine attributed to the process that saw it.
+    A fresh unsupervised rerun (incarnation 0) still resets ``retried``
+    (per-run noise, the pre-supervision contract).
     """
 
     def __init__(self, output_dir: Optional[str] = None, *,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None,
+                 incarnation: Optional[int] = None):
         self.path = path or (os.path.join(output_dir, LEDGER_FILENAME)
                              if output_dir else None)
+        self.incarnation = (current_incarnation() if incarnation is None
+                            else int(incarnation))
         self.quarantined: Dict[str, Dict[str, Any]] = {}
-        self.retried: Dict[str, int] = {}
+        self.retried: Dict[str, Dict[str, Any]] = {}
         if self.path and os.path.exists(self.path):
             self._load_existing(self.path)
 
@@ -347,12 +385,25 @@ class FailureLedger:
             # file and start clean, never trust or crash.
             quarantine_file(path, reason=f"unreadable ledger: {exc}")
             self.quarantined = {}
-        # `retried` is per-run noise, not cross-run state: always reset.
-        self.retried = {}
+            self.retried = {}
+            return
+        if self.incarnation > 0:
+            # Supervised resume: keep prior incarnations' retry entries so
+            # the merged ledger attributes every event (v1 int entries are
+            # normalized to the writing run's incarnation).
+            prior_inc = int(prior.get("incarnation", 0) or 0)
+            self.retried = {
+                w: (dict(v) if isinstance(v, dict)
+                    else {"attempts": int(v), "incarnation": prior_inc})
+                for w, v in dict(prior.get("retried", {})).items()}
+        else:
+            # `retried` is per-run noise on an unsupervised rerun: reset.
+            self.retried = {}
 
     def record_retry(self, word: str, stage: str, exc: BaseException,
                      attempt: int) -> None:
-        self.retried[word] = attempt
+        self.retried[word] = {"attempts": attempt,
+                              "incarnation": self.incarnation}
         self.save()
 
     def record_quarantine(self, word: str, stage: str, exc: BaseException,
@@ -360,9 +411,11 @@ class FailureLedger:
         entry = {
             "stage": stage,
             "attempts": attempts,
+            "incarnation": self.incarnation,
             **_describe(exc),
             # Epoch timestamp: serialized metadata for humans, not duration
             # math (manifest wall_seconds owns durations).
+            # tbx: wallclock-ok — serialized metadata, not duration math
             "at": time.time(),
         }
         # Event offset: the telemetry sequence number current at quarantine
@@ -390,7 +443,8 @@ class FailureLedger:
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 1,
+            "version": 2,
+            "incarnation": self.incarnation,
             "quarantined": self.quarantined,
             "retried": self.retried,
         }
@@ -416,7 +470,12 @@ FAULT_SITES = (
     #                       never the run (tests/test_obs.py)
 )
 
-_FAULT_MODES = ("fail", "delay", "truncate")
+_FAULT_MODES = ("fail", "delay", "truncate", "die")
+
+#: ``die`` default exit status: what the shell reports for SIGKILL (128+9),
+#: so a died child is indistinguishable from a kernel OOM-kill to the
+#: supervisor — exactly the failure the mode simulates.
+DIE_EXIT_CODE = 137
 
 
 @dataclasses.dataclass
@@ -427,10 +486,20 @@ class FaultSpec:
     - ``mode="delay"``: sleep ``delay`` seconds (watchdog exercise).
     - ``mode="truncate"``: truncate the file at the context's ``path`` to
       half its size — a torn write, as seen by a later resume.
+    - ``mode="die"``: ``os._exit(exit_code)`` on the spot — SIGKILL/OOM
+      equivalent (no atexit, no finally, no buffered-sink flush), the
+      crash-consistency harness for ``runtime.supervise``.  Never fires
+      under pytest-style in-process drivers by accident: arm it only in a
+      child you mean to kill.
     - ``times``: fire only on the first N *matching* calls
       (fail-N-then-succeed); ``None`` fires every time (always-fail).
+      Counted per process — a restarted incarnation re-reads the plan with a
+      fresh counter, so scope cross-incarnation schedules with
+      ``incarnation``.
     - ``match``: only fire when some context value (word, path, ...)
       contains this substring; ``None`` matches every call.
+    - ``incarnation``: only fire in this supervised incarnation
+      (:func:`current_incarnation`); ``None`` fires in every process.
     """
 
     mode: str = "fail"
@@ -438,6 +507,8 @@ class FaultSpec:
     kind: str = "transient"          # "transient" | "permanent"
     delay: float = 0.0
     match: Optional[str] = None
+    incarnation: Optional[int] = None
+    exit_code: int = DIE_EXIT_CODE   # die mode's os._exit status
     fired: int = 0                   # mutable call counter (determinism: the
     #                                  schedule depends only on call order)
 
@@ -451,6 +522,9 @@ class FaultSpec:
                 "expected 'transient' or 'permanent'")
 
     def matches(self, context: Dict[str, Any]) -> bool:
+        if (self.incarnation is not None
+                and self.incarnation != current_incarnation()):
+            return False
         if self.match is None:
             return True
         return any(self.match in str(v) for v in context.values())
@@ -538,6 +612,11 @@ class FaultInjector:
             return
         detail = ", ".join(f"{k}={v}" for k, v in sorted(context.items()))
         label = f"{site}" + (f" [{detail}]" if detail else "")
+        if spec.mode == "die":
+            # SIGKILL-equivalent: no cleanup, no flush — the supervised-run
+            # crash-consistency tests assert resume from exactly this state.
+            os._exit(spec.exit_code)
+            return  # only reachable with os._exit stubbed out (unit tests)
         if spec.mode == "delay":
             time.sleep(spec.delay)
             return

@@ -176,12 +176,13 @@ def test_ledger_records_and_persists_atomically(tmp_path):
     assert not os.path.exists(path + ".tmp")  # atomic: no tmp left behind
     with open(path) as f:
         data = json.load(f)
-    assert data["retried"] == {"ship": 1}
+    assert data["retried"] == {"ship": {"attempts": 1, "incarnation": 0}}
     q = data["quarantined"]["moon"]
     assert q["stage"] == "compute:pregame"
     assert q["attempts"] == 3
     assert q["error_type"] == "ValueError"
     assert q["transient"] is False
+    assert q["incarnation"] == 0
     assert bool(ledger)
     assert ledger.words == ["moon"]
 
@@ -207,6 +208,54 @@ def test_ledger_quarantines_its_own_corrupt_file(tmp_path):
     ledger = FailureLedger(str(tmp_path))
     assert not ledger  # starts clean
     assert os.path.exists(path + ".corrupt")
+
+
+def test_ledger_merges_retries_across_incarnations(tmp_path):
+    """Satellite (ISSUE 5): a resume incarnation preserves prior
+    incarnations' retry entries (attributed to the process that saw them)
+    while a plain incarnation-0 rerun still resets them."""
+    out = str(tmp_path)
+    led0 = FailureLedger(out, incarnation=0)
+    led0.record_retry("ship", "checkpoint.load", OSError("flaky"), 1)
+    led0.record_quarantine("moon", "study", OSError("dead"), attempts=3)
+
+    # Incarnation 1 resumes: prior retry preserved AND attributed; its own
+    # events stamp incarnation 1; the prior quarantine clears on success.
+    led1 = FailureLedger(out, incarnation=1)
+    assert led1.retried == {"ship": {"attempts": 1, "incarnation": 0}}
+    assert led1.quarantined["moon"]["incarnation"] == 0
+    led1.record_retry("flag", "compute:pregame", OSError("x"), 2)
+    led1.record_success("moon")
+    data = json.loads(open(os.path.join(out, resilience.LEDGER_FILENAME)).read())
+    assert data["incarnation"] == 1
+    assert data["retried"] == {
+        "ship": {"attempts": 1, "incarnation": 0},
+        "flag": {"attempts": 2, "incarnation": 1},
+    }
+    assert data["quarantined"] == {}
+
+    # A fresh unsupervised rerun (incarnation 0) resets per-run noise.
+    led2 = FailureLedger(out, incarnation=0)
+    assert led2.retried == {}
+
+
+def test_ledger_normalizes_v1_int_retry_entries(tmp_path):
+    """A v1 ledger (retried: {word: int}) read by a resume incarnation is
+    normalized to the stamped form, attributed to the writing run."""
+    path = os.path.join(str(tmp_path), resilience.LEDGER_FILENAME)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "quarantined": {}, "retried": {"ship": 2}}, f)
+    led = FailureLedger(str(tmp_path), incarnation=1)
+    assert led.retried == {"ship": {"attempts": 2, "incarnation": 0}}
+
+
+def test_current_incarnation_reads_env(monkeypatch):
+    monkeypatch.delenv(resilience.INCARNATION_ENV, raising=False)
+    assert resilience.current_incarnation() == 0
+    monkeypatch.setenv(resilience.INCARNATION_ENV, "3")
+    assert resilience.current_incarnation() == 3
+    monkeypatch.setenv(resilience.INCARNATION_ENV, "junk")
+    assert resilience.current_incarnation() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +290,67 @@ def test_injector_truncate_write(tmp_path):
     assert os.path.getsize(path) == 50
     inj.fire("cache.write", path=path)  # exhausted: untouched
     assert os.path.getsize(path) == 50
+
+
+def test_injector_die_mode_exits_hard(monkeypatch):
+    """``die`` calls os._exit (SIGKILL-equivalent) at the matched site —
+    monkeypatched here so the test process survives to assert on it."""
+    exits = []
+    monkeypatch.setattr(resilience.os, "_exit",
+                        lambda code: exits.append(code))
+    inj = FaultInjector()
+    inj.arm("cache.write", mode="die", times=1, match="ship")
+    inj.fire("cache.write", word="moon", path="/x/moon.json")   # no match
+    assert exits == []
+    inj.fire("cache.write", word="ship", path="/x/ship.json")
+    assert exits == [resilience.DIE_EXIT_CODE]
+    inj.fire("cache.write", word="ship", path="/x/ship.json")   # exhausted
+    assert exits == [resilience.DIE_EXIT_CODE]
+
+
+def test_injector_die_mode_custom_exit_code_via_env_plan(monkeypatch):
+    """die is armable via TABOO_FAULT_PLAN like every other mode, with a
+    configurable exit status."""
+    exits = []
+    monkeypatch.setattr(resilience.os, "_exit",
+                        lambda code: exits.append(code))
+    monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps(
+        {"decode.launch": {"mode": "die", "exit_code": 86}}))
+    inj = FaultInjector.from_env()
+    inj.fire("decode.launch", rows=4)
+    assert exits == [86]
+
+
+def test_injector_die_mode_kills_a_real_child():
+    """End to end in a real subprocess: the armed die site takes the process
+    down with the SIGKILL-style status, no cleanup, no traceback."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["TABOO_FAULT_PLAN"] = json.dumps(
+        {"cache.write": {"mode": "die", "times": 1}})
+    code = ("from taboo_brittleness_tpu.runtime import resilience\n"
+            "resilience.fire('cache.write', path='x')\n"
+            "print('unreachable')\n")
+    proc = subprocess.run([_sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60,
+                          cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == resilience.DIE_EXIT_CODE
+    assert "unreachable" not in proc.stdout
+
+
+def test_fault_spec_incarnation_scope(monkeypatch):
+    """A spec scoped to one incarnation is inert in every other process —
+    the cross-incarnation crash-plan mechanism (counters are per-process, so
+    'die in incarnation 0, delay in incarnation 1' needs the scope)."""
+    inj = FaultInjector()
+    inj.arm("checkpoint.read", mode="fail", times=None, incarnation=1)
+    monkeypatch.setenv(resilience.INCARNATION_ENV, "0")
+    inj.fire("checkpoint.read", word="ship")          # wrong incarnation
+    monkeypatch.setenv(resilience.INCARNATION_ENV, "1")
+    with pytest.raises(InjectedFault):
+        inj.fire("checkpoint.read", word="ship")
 
 
 def test_injector_rejects_unknown_site_and_mode():

@@ -1,0 +1,452 @@
+"""Preemption-safe supervised execution (runtime/supervise.py, ISSUE 5).
+
+Three layers:
+
+- drain-controller unit tests (latch semantics, install/uninstall);
+- supervisor state-machine tests against FAKE children (tiny stdlib-only
+  python scripts that heartbeat ``_progress.json`` and exit/crash/wedge on
+  cue — no jax import, so the whole matrix runs in seconds);
+- the acceptance e2e on the real tiny-model pipeline: a supervised 6-word
+  token-forcing sweep with a ``die`` fault mid-word in incarnation 0 and a
+  wedged pipeline in incarnation 1 finishes every word by incarnation 2,
+  leaves zero ``*.corrupt`` files, and merges the ledger per incarnation;
+  plus a drained-SIGTERM run that exits 75 and resumes cleanly.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
+from taboo_brittleness_tpu.runtime.supervise import (
+    EXIT_DRAINED, DrainController, SuperviseResult)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+#: No-sleep restart policy: schedules are still real, tests never wait.
+FAST = RetryPolicy(max_retries=8, base_delay=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# Drain controller.
+# ---------------------------------------------------------------------------
+
+def test_drain_latch_request_and_reset():
+    supervise.request_drain()
+    assert supervise.drain_requested()
+    supervise.reset_drain()
+    assert not supervise.drain_requested()
+
+
+def test_drain_controller_installs_and_restores_handlers():
+    ctl = DrainController()
+    assert ctl.install(signums=(signal.SIGUSR1,))
+    assert ctl.install(signums=(signal.SIGUSR1,))   # idempotent
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not ctl.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ctl.requested()
+    finally:
+        ctl.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is not ctl._handle
+
+
+def test_drain_controller_install_off_main_thread_is_polling_only():
+    got = {}
+
+    def worker():
+        got["installed"] = DrainController().install()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert got["installed"] is False
+
+
+# ---------------------------------------------------------------------------
+# read_progress missing_ok (the supervisor's startup-race contract).
+# ---------------------------------------------------------------------------
+
+def test_read_progress_missing_ok(tmp_path):
+    from taboo_brittleness_tpu.obs.progress import read_progress
+
+    path = str(tmp_path / "_progress.json")
+    assert read_progress(path, missing_ok=True) == {
+        "status": "absent", "stale": False}
+    with open(path, "w") as f:
+        f.write('{"torn')
+    assert read_progress(path, missing_ok=True)["status"] == "absent"
+    with pytest.raises(FileNotFoundError):
+        read_progress(str(tmp_path / "gone.json"))
+
+
+# ---------------------------------------------------------------------------
+# Supervisor state machine against fake children.
+# ---------------------------------------------------------------------------
+
+_FAKE_CHILD = r"""
+import json, os, signal, sys, time
+
+out = sys.argv[1]
+modes = json.loads(sys.argv[2])       # {incarnation(str): behavior}
+inc = os.environ.get("TBX_INCARNATION", "0")
+mode = modes.get(inc, "ok")
+
+
+def beat(status="running", hb=0.05, event_age=0.0):
+    tmp = os.path.join(out, "_progress.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"v": 1, "pid": os.getpid(), "updated_at": time.time(),
+                   "heartbeat_seconds": hb, "status": status,
+                   "incarnation": int(inc),
+                   "last_event_age_seconds": event_age}, f)
+    os.replace(tmp, os.path.join(out, "_progress.json"))
+
+
+if mode == "ok":
+    beat()
+    time.sleep(0.05)
+    beat(status="done")
+    sys.exit(0)
+elif mode == "die":
+    beat()
+    os._exit(137)
+elif mode == "drain":
+    beat(status="preempted")
+    sys.exit(75)
+elif mode == "quarantine":
+    beat(status="done")
+    sys.exit(1)
+elif mode == "wedge-heartbeat":
+    beat(hb=0.05)                 # one beat, then silence while alive
+    time.sleep(60)
+elif mode == "wedge-events":
+    end = time.time() + 60        # heartbeat fresh, pipeline event-dead
+    while time.time() < end:
+        beat(hb=0.5, event_age=999.0)
+        time.sleep(0.02)
+elif mode == "drain-on-term":
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(75))
+    end = time.time() + 60
+    while time.time() < end:
+        beat(hb=0.5)
+        time.sleep(0.02)
+"""
+
+
+def _run_fake(tmp_path, modes, **kw):
+    out = str(tmp_path / "out")
+    os.makedirs(out, exist_ok=True)
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_FAKE_CHILD)
+    argv = [sys.executable, child, out, json.dumps(modes)]
+    kw.setdefault("max_incarnations", 4)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("grace", 0.5)
+    kw.setdefault("wedge_after", 1.0)
+    kw.setdefault("policy", FAST)
+    return out, supervise.supervise(argv, out, **kw)
+
+
+def _outcomes(res: SuperviseResult):
+    return [r["outcome"] for r in res.incarnations]
+
+
+def test_supervise_clean_child_exits_zero(tmp_path):
+    out, res = _run_fake(tmp_path, {"0": "ok"})
+    assert res.ok and res.status == "done"
+    assert _outcomes(res) == ["done"]
+    with open(os.path.join(out, supervise.SUPERVISE_FILENAME)) as f:
+        on_disk = json.load(f)
+    assert on_disk["status"] == "done"
+    assert len(on_disk["incarnations"]) == 1
+    assert on_disk["incarnations"][0]["exit_code"] == 0
+
+
+def test_supervise_restarts_after_crash(tmp_path):
+    out, res = _run_fake(tmp_path, {"0": "die", "1": "ok"})
+    assert res.ok
+    assert _outcomes(res) == ["crashed", "done"]
+    assert res.incarnations[0]["exit_code"] == 137
+    # Supervisor events landed in the merged sink.
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "_events.jsonl"))]
+    names = [e.get("name") for e in events]
+    assert names.count("supervise.launch") == 2
+    assert "supervise.exit" in names
+    # seq stays strictly monotone across the supervisor's append bursts.
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_supervise_resumes_after_child_drain_without_burning_backoff(tmp_path):
+    _, res = _run_fake(tmp_path, {"0": "drain", "1": "ok"})
+    assert res.ok
+    assert _outcomes(res) == ["drained", "done"]
+
+
+def test_supervise_passes_quarantine_exit_through(tmp_path):
+    _, res = _run_fake(tmp_path, {"0": "quarantine"})
+    assert res.exit_code == 1
+    assert res.status == "quarantined"
+    assert _outcomes(res) == ["quarantined"]
+
+
+def test_supervise_kills_wedged_heartbeat_and_restarts(tmp_path):
+    _, res = _run_fake(tmp_path, {"0": "wedge-heartbeat", "1": "ok"})
+    assert res.ok
+    assert _outcomes(res) == ["wedged", "done"]
+    assert res.incarnations[0]["reason"] == "heartbeat-stale"
+
+
+def test_supervise_kills_event_quiet_pipeline_and_restarts(tmp_path):
+    _, res = _run_fake(tmp_path, {"0": "wedge-events", "1": "ok"})
+    assert res.ok
+    assert _outcomes(res) == ["wedged", "done"]
+    assert res.incarnations[0]["reason"] == "pipeline-wedged"
+
+
+def test_supervise_drain_on_last_budgeted_incarnation_is_resumable(tmp_path):
+    """A drain on the budget's final incarnation is still 'safe to resume':
+    exit 75 with status drained, never budget-exhausted."""
+    _, res = _run_fake(tmp_path, {"0": "drain", "1": "drain"},
+                       max_incarnations=2)
+    assert res.exit_code == EXIT_DRAINED
+    assert res.status == "drained"
+    assert _outcomes(res) == ["drained", "drained"]
+
+
+def test_supervise_budget_exhausted_propagates_exit(tmp_path):
+    _, res = _run_fake(tmp_path, {"0": "die", "1": "die"},
+                       max_incarnations=2)
+    assert res.exit_code == 137
+    assert res.status == "budget-exhausted"
+    assert _outcomes(res) == ["crashed", "crashed"]
+
+
+def test_supervise_forwards_own_drain_signal_and_exits_75(tmp_path):
+    timer = threading.Timer(0.4, supervise.request_drain)
+    timer.start()
+    try:
+        _, res = _run_fake(tmp_path, {"0": "drain-on-term"})
+    finally:
+        timer.cancel()
+        supervise.reset_drain()
+    assert res.exit_code == EXIT_DRAINED
+    assert res.status == "drained"
+    assert _outcomes(res) == ["drained"]
+
+
+def test_supervise_stale_predecessor_progress_is_not_a_wedge(tmp_path):
+    """Right after a relaunch the progress file still holds the DEAD
+    incarnation's heartbeat; the pid guard must read it as 'starting up',
+    never as 'fresh child wedged'."""
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    with open(os.path.join(out, "_progress.json"), "w") as f:
+        json.dump({"v": 1, "pid": 999999,
+                   "updated_at": time.time() - 500,  # tbx: wallclock-ok — forged stale heartbeat
+                   "heartbeat_seconds": 0.05, "status": "running",
+                   "incarnation": 0}, f)
+    from taboo_brittleness_tpu.obs.progress import read_progress
+
+    progress = read_progress(os.path.join(out, "_progress.json"),
+                             missing_ok=True)
+    assert progress["stale"] is True          # it IS stale...
+    assert supervise._wedge_reason(progress, pid=12345,
+                                   wedge_after=1.0) is None  # ...not a wedge
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e on the real tiny-model pipeline (subprocess children).
+# ---------------------------------------------------------------------------
+
+_DRIVER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+
+from taboo_brittleness_tpu.config import Config, ExperimentConfig, ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.pipelines import token_forcing as tf
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+supervise.install_drain_handlers()
+WORDS = [f"w{{i:02d}}" for i in range(6)]
+cfg = gemma2.PRESETS["gemma2_tiny"]
+params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+tok = WordTokenizer(WORDS + ["secret", "word", "is", "My", "hint"],
+                    vocab_size=cfg.vocab_size)
+config = Config(
+    model=ModelConfig(layer_idx=1, top_k=2, arch="gemma2_tiny",
+                      dtype="float32", param_dtype="float32"),
+    experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+    word_plurals={{w: [w] for w in WORDS}},
+    prompts=["Give me a hint"],
+)
+
+
+def loader(word):
+    resilience.fire("checkpoint.read", word=word)
+    return params, cfg, tok
+
+
+res = tf.run_token_forcing(
+    config, model_loader=loader, words=WORDS, modes=("pregame",),
+    output_dir=sys.argv[1], retry_policy=RetryPolicy(max_retries=2,
+                                                     base_delay=0.0))
+rc = 1 if res.get("failures", {{}}).get("quarantined") else 0
+if supervise.drain_requested():
+    rc = supervise.EXIT_DRAINED
+sys.exit(rc)
+"""
+
+
+def _write_driver(tmp_path):
+    path = str(tmp_path / "driver.py")
+    with open(path, "w") as f:
+        f.write(_DRIVER.format(repo=REPO))
+    return path
+
+
+def _child_env(fault_plan=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TBX_OBS_PROGRESS_S"] = "0.1"
+    env.pop("TABOO_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["TABOO_FAULT_PLAN"] = json.dumps(fault_plan)
+    return env
+
+
+def _no_corrupt_files(root):
+    return [os.path.join(r, n) for r, _, names in os.walk(root)
+            for n in names if n.endswith(".corrupt")]
+
+
+def test_supervised_sweep_survives_die_and_wedge(tmp_path):
+    """ISSUE 5 acceptance: die mid-word (incarnation 0) + wedged pipeline
+    (incarnation 1) -> all 6 words complete by incarnation 2, no .corrupt
+    leakage, merged per-incarnation ledger, progress done, supervisor 0."""
+    driver = _write_driver(tmp_path)
+    out = str(tmp_path / "words")
+    plan = {
+        # SIGKILL-equivalent mid-word: w03's artifact write never happens.
+        "cache.write": [{"mode": "die", "incarnation": 0, "match": "w03"}],
+        "checkpoint.read": [
+            # Incarnation 1 wedges at w03's resume point: heartbeat stays
+            # fresh while the pipeline goes event-quiet — the two-signal
+            # wedge the supervisor kills on.
+            {"mode": "delay", "delay": 60, "incarnation": 1},
+            # Incarnation 2 sees one transient checkpoint hiccup on w05, so
+            # the merged ledger has a retry attributed to incarnation 2.
+            {"mode": "fail", "times": 1, "incarnation": 2, "match": "w05"},
+        ],
+    }
+    res = supervise.supervise(
+        [sys.executable, driver, out], out,
+        max_incarnations=4, poll_interval=0.1, grace=1.0, wedge_after=1.5,
+        policy=FAST, env=_child_env(plan))
+
+    assert res.exit_code == 0, res.incarnations
+    assert res.status == "done"
+    assert len(res.incarnations) == 3          # budget says <= 4; used 3
+    assert [r["outcome"] for r in res.incarnations] == [
+        "crashed", "wedged", "done"]
+    assert res.incarnations[0]["exit_code"] == resilience.DIE_EXIT_CODE
+
+    for i in range(6):
+        assert os.path.exists(os.path.join(out, f"w{i:02d}.json"))
+    assert _no_corrupt_files(str(tmp_path)) == []
+
+    with open(os.path.join(out, resilience.LEDGER_FILENAME)) as f:
+        ledger = json.load(f)
+    assert ledger["quarantined"] == {}
+    # record_retry logs the FAILED attempt ordinal (w05's attempt 1 failed,
+    # attempt 2 succeeded), attributed to the incarnation that saw it.
+    assert ledger["retried"] == {
+        "w05": {"attempts": 1, "incarnation": 2}}
+
+    from taboo_brittleness_tpu.obs.progress import read_progress
+
+    progress = read_progress(os.path.join(out, "_progress.json"))
+    assert progress["status"] == "done"
+    assert progress["incarnation"] == 2
+
+    with open(os.path.join(out, supervise.SUPERVISE_FILENAME)) as f:
+        assert json.load(f)["status"] == "done"
+    # The merged event stream carries every incarnation boundary.
+    events = [json.loads(line)
+              for line in open(os.path.join(out, "_events.jsonl"))]
+    assert [e["attrs"]["incarnation"] for e in events
+            if e.get("name") == "supervise.launch"] == [0, 1, 2]
+    assert any(e.get("name") == "supervise.wedged" for e in events)
+
+
+def test_drained_sigterm_run_exits_75_and_resumes(tmp_path):
+    """A SIGTERM mid-sweep drains at the word boundary (exit 75, progress
+    'preempted'); the relaunch resumes the finished words and exits 0."""
+    from taboo_brittleness_tpu.obs.progress import read_progress
+
+    driver = _write_driver(tmp_path)
+    out = str(tmp_path / "words")
+    # Slow each word's write so the TERM window is wide and deterministic.
+    plan = {"cache.write": [{"mode": "delay", "delay": 0.5, "times": None}]}
+    proc = subprocess.Popen([sys.executable, driver, out],
+                            env=_child_env(plan))
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            progress = read_progress(os.path.join(out, "_progress.json"),
+                                     missing_ok=True)
+            if progress.get("words_done", 0) >= 1:
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"driver exited early: {proc.returncode}")
+            time.sleep(0.05)
+        else:
+            pytest.fail("driver never finished a word")
+        proc.terminate()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == EXIT_DRAINED
+
+    progress = read_progress(os.path.join(out, "_progress.json"))
+    assert progress["status"] == "preempted"
+    done_files = [n for n in os.listdir(out)
+                  if n.endswith(".json") and n.startswith("w")]
+    assert 1 <= len(done_files) < 6            # partial, at a word boundary
+
+    # Relaunch (no faults): resumes the finished words, completes, exits 0.
+    rc2 = subprocess.run([sys.executable, driver, out],
+                         env=_child_env(), timeout=300).returncode
+    assert rc2 == 0
+    for i in range(6):
+        assert os.path.exists(os.path.join(out, f"w{i:02d}.json"))
+    # Neither incarnation retried or quarantined anything, so no ledger is
+    # ever written — a drained+resumed run leaves the same (absent) ledger a
+    # single clean run would.
+    assert not os.path.exists(os.path.join(out, resilience.LEDGER_FILENAME))

@@ -14,6 +14,10 @@ Output (plain text, stdout):
   only fine-grained timing exposes);
 - a critical-path summary: which phase dominates the run, total gap, and
   the slowest word;
+- incarnation boundaries for supervised runs (``runtime.supervise``): one
+  run span per incarnation (ordered by their wall anchors — each child's
+  monotonic t restarts at 0) with drain markers, plus the supervisor's own
+  ``supervise.launch``/``supervise.wedged``/``supervise.drain`` events;
 - a program summary (decode/checkpoint.load spans): count, total, mean;
 - with ``--roofline`` (default: results/bench_detail.json when present),
   each program/phase whose name matches a ``sweep.phase_roofline`` phase
@@ -52,7 +56,7 @@ _ROOFLINE_NAMES = ("decode", "readout", "nll")
 
 class Span:
     __slots__ = ("id", "name", "kind", "parent", "t0", "dur", "status",
-                 "attrs", "mem")
+                 "attrs", "mem", "wall")
 
     def __init__(self, ev: Dict[str, Any]):
         self.id = ev.get("id")
@@ -64,6 +68,9 @@ class Span:
         self.status: Optional[str] = None
         self.attrs: Dict[str, Any] = dict(ev.get("attrs") or {})
         self.mem: Optional[Dict[str, Any]] = None
+        # Run spans carry a wall-clock anchor: the only cross-incarnation
+        # ordering signal (each incarnation's monotonic t restarts at 0).
+        self.wall: Optional[float] = ev.get("wall")
 
     @property
     def t1(self) -> Optional[float]:
@@ -139,11 +146,45 @@ def report(events: List[Dict[str, Any]], *,
     out: List[str] = []
 
     runs = [s for s in spans.values() if s.kind == "run"]
-    for run in sorted(runs, key=lambda s: s.t0):
+    # Sort by the wall anchor when present: a supervised run appends one run
+    # span per incarnation, each with its own monotonic-zero t.
+    runs = sorted(runs, key=lambda s: (s.wall if s.wall is not None else 0.0,
+                                       s.t0))
+
+    # Incarnation boundaries: supervisor restart/drain/wedge events plus a
+    # one-line summary per incarnation's run span.
+    sup_points = [p for p in points
+                  if str(p.get("name", "")).startswith("supervise.")]
+    multi_inc = (len(runs) > 1 or sup_points
+                 or any(r.attrs.get("incarnation") for r in runs))
+    if multi_inc and runs:
+        out.append("incarnations:")
+        for r in runs:
+            inc = r.attrs.get("incarnation", 0)
+            notes = []
+            if r.attrs.get("drained"):
+                notes.append("drained")
+            if r.status == "error":
+                notes.append("error")
+            if r.dur is None:
+                notes.append("unfinished (killed?)")
+            out.append(f"  #{inc}  {r.attrs.get('pipeline', r.name):<16} "
+                       f"{_fmt_s(r.dur)}s  {','.join(notes) or 'ok'}")
+        for p in sup_points:
+            attrs = p.get("attrs") or {}
+            brief = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            out.append(f"  {p.get('name')}  {brief}")
+        out.append("")
+
+    for run in runs:
         pipeline = run.attrs.get("pipeline", run.name)
+        inc = run.attrs.get("incarnation")
+        inc_label = f", incarnation {inc}" if inc is not None else ""
+        drained = ", DRAINED" if run.attrs.get("drained") else ""
         out.append(f"run: {pipeline}  "
                    f"(duration {_fmt_s(run.dur)}s, "
-                   f"{run.attrs.get('words_total', '?')} words planned)")
+                   f"{run.attrs.get('words_total', '?')} words planned"
+                   f"{inc_label}{drained})")
 
         words = [s for s in _children(spans, run.id) if s.kind == "word"]
         phase_names: List[str] = []
@@ -237,7 +278,9 @@ def report(events: List[Dict[str, Any]], *,
     # Notable point events.
     notable = [p for p in points
                if p.get("name", "").startswith(("resilience.", "aot.build",
-                                                "study.pre_dispatch_failed"))]
+                                                "study.pre_dispatch_failed",
+                                                "supervise.",
+                                                "sweep.drained"))]
     if notable:
         out.append(f"events: {len(notable)} notable")
         for p in notable[:50]:
