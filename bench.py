@@ -42,6 +42,11 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
   execute split — the cold-start profile), so ``word_seconds`` measure the
   warmed driver, as production runs it (the driver builds programs behind
   word 0's checkpoint load).
+- "serve_latency": the serving subsystem's closed-loop SLO stage (ISSUE 6)
+  — seeded scenario mix over the resident engine via the real
+  engine→scheduler→loadgen stack; per-scenario p50/p99 + goodput, with the
+  AOT step-program hit/miss stats (misses > 0 = a scenario stopped being an
+  in-graph switch and forced a recompile — a regression).
 - "sweep.phase_roofline": each phase against ITS OWN ceiling
   (perf/roofline.py — decode vs the HBM stream bound, readout/NLL vs bf16
   matmul peak), with achieved/ceiling ratios; "sweep.readout_ab" is the
@@ -794,6 +799,53 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
     }
 
 
+def _serve_bench(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
+    """``serve_latency`` stage: the serving subsystem's closed-loop SLO bench
+    (ISSUE 6) — per-scenario p50/p99 and goodput become tracked numbers like
+    prompts/sec/chip.
+
+    Runs the REAL stack (engine → scheduler → loadgen, the same path ``tbx
+    loadgen`` drives): a seeded scenario mix over one resident engine, every
+    scenario through the ONE compiled step program.  The report also carries
+    the AOT step-program stats so a recompile regression (a scenario that
+    stopped being an in-graph switch) shows up as ``misses > 0``."""
+    from taboo_brittleness_tpu.runtime import aot
+    from taboo_brittleness_tpu.runtime.tokenizer import (
+        WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve import loadgen
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+    from taboo_brittleness_tpu.serve.scheduler import default_scenarios
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8" if on_accel else "4"))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    "64" if on_accel else "24"))
+    max_new = 16 if on_accel else 6
+    words = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+             "Give", "me", "a", "the", "about"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    engine = ServeEngine(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=slots, max_context=48, prompt_cols=24,
+            latent_slots=4, proj_rank=2,
+            sae_layer=tap_layer, proj_layer=tap_layer, tap_layer=tap_layer,
+            # Fixed-length sessions (no early stop): uniform work per
+            # request, the dedup-proof bench idiom.
+            stop_ids=(-1,)),
+        sae=sae)
+    report = loadgen.run_inprocess(
+        engine, n_requests=n_requests, seed=17,
+        rate=float(os.environ.get("BENCH_SERVE_RATE", "200")),
+        concurrency=2 * slots,
+        scenarios=default_scenarios(max_new_tokens=max_new,
+                                    ablate_latents=(0, 1, 2, 3), proj_rank=2),
+        lens_target_id=target_token_id(tok, "ship"),
+        prompts=("Give me a hint", "Give me a clue about the word"))
+    report["aot"] = dict(aot.stats().get("serve.step", {}))
+    report["config"].update({"slots": slots, "max_new_tokens": max_new})
+    return report
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -906,6 +958,10 @@ def main() -> int:
             reps=int(os.environ.get("BENCH_OBS_AB_REPS", "5")),
             on_accel=on_accel)
 
+    serve_stage = None
+    if os.environ.get("BENCH_SERVE", "1") == "1":
+        serve_stage = _serve_bench(params, cfg, sae, tap_layer, on_accel)
+
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "results", "bench_detail.json")
     headline = {
@@ -946,6 +1002,17 @@ def main() -> int:
         # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;
         # the contract is <2% wall overhead (detail block "obs_overhead").
         "obs_overhead_pct": (obs_ab and obs_ab.get("overhead_pct")),
+        # Serving SLO (serve subsystem): closed-loop loadgen over the
+        # resident engine — pooled p50/p99 + goodput; per-scenario table in
+        # the detail block "serve_latency".
+        "serve_latency": (serve_stage and {
+            "p50_s": serve_stage["overall"]["p50_s"],
+            "p99_s": serve_stage["overall"]["p99_s"],
+            "completed_per_second":
+                serve_stage["goodput"]["completed_per_second"],
+            "goodput": (serve_stage["goodput"]["completed"],
+                        serve_stage["goodput"]["admitted"]),
+        }),
         "detail": detail_path,
     }
 
@@ -964,7 +1031,7 @@ def main() -> int:
         os.makedirs(os.path.dirname(detail_path), exist_ok=True)
         _atomic_json_dump(
             {"headline": headline, "sweep": sweep, "study": study,
-             "obs_overhead": obs_ab},
+             "obs_overhead": obs_ab, "serve_latency": serve_stage},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract
         print(f"bench_detail.json write failed (headline unaffected): {e}",

@@ -166,12 +166,65 @@ def _entry_nll_cached():
     return fn, (params, kv, kv, cache_valid, seqs, valid, pos, nmask)
 
 
+def _entry_serve_step():
+    # The serving subsystem's resident step program (one compiled step for
+    # every scenario; serve/engine.py).  Its per-step unembed + optional
+    # lens readout each materialize a transient [S, 1, V] f32 row — reviewed
+    # and baselined like the decode/NLL readouts.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.serve import engine as serve_engine
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    S, C, P, m, r = 2, 8, 4, 2, 2
+    D = cfg.hidden_size
+    sds = jax.ShapeDtypeStruct
+    sae = sae_ops.SAEParams(
+        w_enc=sds((D, 16), jnp.float32),
+        b_enc=sds((16,), jnp.float32),
+        w_dec=sds((16, D), jnp.float32),
+        b_dec=sds((D,), jnp.float32),
+        threshold=sds((16,), jnp.float32),
+    )
+    cache = serve_engine.KVCache(
+        k=sds((cfg.num_layers, S, C, cfg.num_kv_heads, cfg.head_dim),
+              jnp.bfloat16),
+        v=sds((cfg.num_layers, S, C, cfg.num_kv_heads, cfg.head_dim),
+              jnp.bfloat16),
+        valid=sds((S, C), jnp.bool_),
+        length=sds((), jnp.int32),
+    )
+    state = serve_engine.SlotState(
+        input_tok=sds((S,), jnp.int32),
+        pos=sds((S,), jnp.int32),
+        active=sds((S,), jnp.bool_),
+        done=sds((S,), jnp.bool_),
+        prompt_buf=sds((S, P), jnp.int32),
+        prompt_len=sds((S,), jnp.int32),
+        gen_count=sds((S,), jnp.int32),
+        max_gen=sds((S,), jnp.int32),
+        latent_ids=sds((S, m), jnp.int32),
+        basis=sds((S, D, r), jnp.float32),
+        lens_target=sds((S,), jnp.int32),
+    )
+
+    def fn(p, s, c, st):
+        return serve_engine.serve_step(p, cfg, s, c, st, sae_layer=1,
+                                       proj_layer=1, tap_layer=2)
+
+    return fn, (params, sae, cache, state)
+
+
 ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
     ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
     ("runtime.decode.greedy_decode", _entry_greedy_decode),
     ("pipelines.interventions._residual_measure", _entry_residual_measure),
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
+    ("serve.engine.serve_step", _entry_serve_step),
 ]
 
 

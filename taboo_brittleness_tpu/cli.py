@@ -12,6 +12,8 @@ reference bug noted in SURVEY.md anti-goals):
     python -m taboo_brittleness_tpu token-forcing [-c CFG] [--modes pregame postgame]
     python -m taboo_brittleness_tpu prompting     [-c CFG] [--modes naive adversarial]
     python -m taboo_brittleness_tpu supervise --output-dir DIR -- <subcommand ...>
+    python -m taboo_brittleness_tpu serve   --output-dir DIR [--synthetic] [--slots N]
+    python -m taboo_brittleness_tpu loadgen [--spool DIR | --synthetic] [-n N] [--selfcheck]
 
 Every subcommand accepts the reference's ``configs/default.yaml`` schema
 unchanged (config.load_config).
@@ -21,10 +23,17 @@ Exit codes (the restart-vs-fail contract outer orchestration keys off):
 - 0 — the run completed.
 - 1 — the sweep completed but words were QUARANTINED (in-process retries
   exhausted; rerunning replays the failure — inspect ``_failures.json``).
+  For ``serve`` there is no quarantine-completed state: exit 1 from a
+  serving child is a CRASH, and ``supervise`` burns an incarnation on it
+  instead of passing it through (it reads the child's declared ``workload``
+  from ``_progress.json``).
 - 75 — ``EX_TEMPFAIL``: the run DRAINED on a preemption notice
-  (SIGTERM/SIGINT) at a word boundary; partial results on disk are valid
-  and a relaunch resumes them (``runtime.supervise`` restarts on exactly
-  this code).
+  (SIGTERM/SIGINT).  Sweeps drain at a word boundary; ``serve`` drains at a
+  SESSION boundary — the current decode step finishes, new admissions are
+  rejected, every in-flight session runs to completion and gets its
+  response, then the process exits.  Partial results on disk are valid and
+  a relaunch resumes them (``runtime.supervise`` restarts on exactly this
+  code; a relaunched server re-queues claimed-but-unanswered requests).
 """
 
 from __future__ import annotations
@@ -436,6 +445,113 @@ def cmd_prompting(args) -> int:
     return _exit_code(rc)
 
 
+def _serve_engine(args, config: Config):
+    """Build the resident engine: ``--synthetic`` is the hermetic tiny-model
+    stack (tests, smokes); otherwise the requested taboo checkpoint loads
+    through the normal CheckpointManager path and the SAE through ``_sae``.
+    Returns (engine, scenarios, lens_target_id)."""
+    from taboo_brittleness_tpu.serve import loadgen as loadgen_mod
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+    from taboo_brittleness_tpu.serve.scheduler import default_scenarios
+
+    if args.synthetic:
+        return loadgen_mod.build_synthetic_engine(
+            slots=args.slots, max_new_tokens=args.max_new_tokens)
+
+    from taboo_brittleness_tpu.runtime.tokenizer import target_token_id
+
+    word = args.word or config.words[0]
+    params, cfg, tok = _loader(config, args)(word)
+    sae = None
+    if args.sae_npz or os.environ.get("TABOO_GEMMA_SCOPE_ROOT"):
+        sae = _sae(config, args.sae_npz)
+    layer = config.model.layer_idx
+    engine = ServeEngine(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=args.slots, max_context=args.max_context,
+            prompt_cols=args.prompt_cols,
+            sae_layer=layer, proj_layer=layer, tap_layer=layer),
+        sae=sae)
+    scenarios = default_scenarios(max_new_tokens=args.max_new_tokens)
+    if sae is None:
+        scenarios.pop("sae_ablate", None)
+    return engine, scenarios, target_token_id(tok, word)
+
+
+def _serve_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-c", "--config", default="configs/default.yaml")
+    p.add_argument("--synthetic", action="store_true",
+                   help="tiny random model + word tokenizer (hermetic smoke "
+                        "path; no checkpoint IO)")
+    p.add_argument("--word", default=None,
+                   help="taboo checkpoint to serve (default: first config word)")
+    p.add_argument("--checkpoint-root", default=None)
+    p.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
+    p.add_argument("--slots", type=int, default=8,
+                   help="decode-batch width (concurrent sessions)")
+    p.add_argument("--max-context", type=int, default=160)
+    p.add_argument("--prompt-cols", type=int, default=96)
+    p.add_argument("--max-new-tokens", type=int, default=24,
+                   help="per-session generation budget (scenario default)")
+
+
+def cmd_serve(args) -> int:
+    """Long-lived continuous-batching server over one resident checkpoint
+    (``serve.server``): file-spool intake under --output-dir, serving-mode
+    heartbeat, SIGTERM drain → exit 75, supervised-relaunch resume."""
+    from taboo_brittleness_tpu.serve import server as server_mod
+
+    config = _load(args)
+    engine, scenarios, lens_tgt = _serve_engine(args, config)
+    res = server_mod.serve_forever(
+        engine, scenarios, args.output_dir,
+        lens_target_id=lens_tgt,
+        queue_limit=args.queue_limit,
+        max_requests=args.max_requests,
+        poll_s=args.poll)
+    # tbx: TBX009-ok — CLI stdout contract (serve summary JSON)
+    print(json.dumps({"status": res.status, "completed": res.completed,
+                      "steps": res.steps}))
+    return res.exit_code
+
+
+def cmd_loadgen(args) -> int:
+    """Closed-loop load generator (``serve.loadgen``): seeded scenario mix +
+    arrival process; reports per-scenario p50/p99 + goodput as a
+    ``serve_latency`` stage JSON (stdout, and --report FILE)."""
+    from taboo_brittleness_tpu.serve import loadgen as loadgen_mod
+
+    if args.selfcheck:
+        return loadgen_mod.main_selfcheck()
+    mix = None
+    if args.mix:
+        mix = {}
+        for part in args.mix.split(","):
+            name, _, w = part.partition("=")
+            mix[name.strip()] = float(w) if w else 1.0
+    if args.spool:
+        report = loadgen_mod.run_spool(
+            args.spool, n_requests=args.n, seed=args.seed, rate=args.rate,
+            concurrency=args.concurrency, mix=mix,
+            timeout_s=args.timeout)
+    else:
+        config = _load(args)
+        engine, scenarios, lens_tgt = _serve_engine(args, config)
+        report = loadgen_mod.run_inprocess(
+            engine, n_requests=args.n, seed=args.seed, rate=args.rate,
+            concurrency=args.concurrency, mix=mix, scenarios=scenarios,
+            lens_target_id=lens_tgt)
+    if args.report:
+        from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+        atomic_json_dump(report, args.report)
+    # tbx: TBX009-ok — CLI stdout contract (serve_latency stage JSON)
+    print(json.dumps(report))
+    dropped = report["goodput"]["admitted"] - report["goodput"]["completed"]
+    return 0 if dropped == 0 else 1
+
+
 def cmd_supervise(args) -> int:
     """Run a pipeline subcommand under the preemption-safe supervisor
     (``runtime.supervise``): launch as a child process, restart on crash or
@@ -519,6 +635,62 @@ def build_parser() -> argparse.ArgumentParser:
                     help="re-measure words whose per-word results already "
                          "exist (default: resume by skipping them)")
     pr.set_defaults(fn=cmd_prompting)
+
+    se = sub.add_parser(
+        "serve",
+        help="continuous-batching brittleness-probe server (one resident "
+             "model, per-request scenario switches)",
+        description="Serve concurrent chat / SAE-ablated / projection / "
+                    "token-forcing / lens-readout sessions from ONE "
+                    "compiled decode step over one resident checkpoint. "
+                    "Requests arrive as JSON files under "
+                    "<output-dir>/requests/ (see serve.server); responses "
+                    "land in <output-dir>/responses/. SIGTERM drains: "
+                    "in-flight sessions finish, admissions stop, exit 75 — "
+                    "run under `supervise` for restart + resume.")
+    _serve_common(se)
+    se.add_argument("--output-dir", required=True,
+                    help="spool + telemetry directory (requests/, "
+                         "responses/, _progress.json, _events.jsonl)")
+    se.add_argument("--queue-limit", type=int, default=64,
+                    help="bounded admission queue (beyond it: reject)")
+    se.add_argument("--max-requests", type=int, default=None,
+                    help="exit 0 once this many responses exist on disk "
+                         "(counts prior incarnations'; default: run forever)")
+    se.add_argument("--poll", type=float, default=0.05,
+                    help="idle spool poll interval seconds")
+    se.set_defaults(fn=cmd_serve)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="closed-loop load generator + SLO report (serve_latency stage)",
+        description="Drive the serving subsystem with a seeded scenario mix "
+                    "and arrival process; report per-scenario p50/p99 "
+                    "latency and goodput as a serve_latency stage JSON. "
+                    "Default: in-process over a fresh engine; --spool drives "
+                    "a running `serve`; --selfcheck is the CI smoke.")
+    _serve_common(lg)
+    lg.add_argument("--spool", default=None,
+                    help="drive a RUNNING serve via its output dir instead "
+                         "of in-process")
+    lg.add_argument("-n", type=int, default=32, help="requests to send")
+    lg.add_argument("--seed", type=int, default=0)
+    lg.add_argument("--rate", type=float, default=50.0,
+                    help="Poisson arrival rate, requests/second")
+    lg.add_argument("--concurrency", type=int, default=16,
+                    help="closed-loop cap on outstanding requests")
+    lg.add_argument("--mix", default=None,
+                    help="scenario mix, e.g. 'chat=2,sae_ablate=1,forcing=1' "
+                         "(default: uniform over available scenarios)")
+    lg.add_argument("--timeout", type=float, default=300.0,
+                    help="spool mode: give up on unanswered requests after "
+                         "this many seconds")
+    lg.add_argument("--report", default=None,
+                    help="also write the stage JSON here (atomic)")
+    lg.add_argument("--selfcheck", action="store_true",
+                    help="CPU-sized CI smoke: tiny model, 32 requests, "
+                         "asserts goodput == admitted + histogram schema")
+    lg.set_defaults(fn=cmd_loadgen)
 
     sv = sub.add_parser(
         "supervise",

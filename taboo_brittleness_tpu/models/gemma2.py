@@ -243,6 +243,7 @@ def _layer(
     cache_v: Optional[jax.Array],
     cache_index: Optional[jax.Array],  # [] int32 position at which to write
     attend_fn: Optional[Callable] = None,  # (q, k, v, layer_idx) -> [B, T, H*Dh]
+    cache_positions: Optional[jax.Array] = None,  # [B] per-row write column
 ) -> Tuple[jax.Array, Tuple[Optional[jax.Array], Optional[jax.Array]]]:
     B, T, D = h.shape
     H, K, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -257,7 +258,13 @@ def _layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if cache_k is not None:
+    if cache_k is not None and cache_positions is not None:
+        # Serve mode (T=1): each row writes its own column — continuous-
+        # batching slots at different sequence lengths share one program.
+        rows = jnp.arange(B)
+        k_all = cache_k.at[rows, cache_positions].set(k[:, 0])
+        v_all = cache_v.at[rows, cache_positions].set(v[:, 0])
+    elif cache_k is not None:
         k_all = lax.dynamic_update_slice(cache_k, k, (0, cache_index, 0, 0))
         v_all = lax.dynamic_update_slice(cache_v, v, (0, cache_index, 0, 0))
     else:
@@ -328,6 +335,7 @@ def forward(
     carry_tap: Optional[Tuple[Any, Callable[[Any, jax.Array, jax.Array], Any]]] = None,
     compute_logits: bool = True,
     attend_fn: Optional[Callable] = None,
+    cache_positions: Optional[jax.Array] = None,
 ) -> ForwardResult:
     """One compiled forward pass.
 
@@ -350,9 +358,23 @@ def forward(
     for a custom implementation that owns its masking — the sequence-parallel
     ring path (``parallel.sp.forward_sp``) passes a closure over ring
     attention here.  Mutually exclusive with ``cache``.
+
+    ``cache_positions`` ([B] int32, requires ``cache`` and T=1) writes each
+    row's new key/value at its OWN column instead of the shared
+    ``cache.length`` pointer: the continuous-batching serve engine
+    (``serve.engine``) keeps slots at different sequence lengths in one
+    batch, each slot owning columns ``[0, its length)`` of its cache row.
+    ``cache.length`` is neither read nor meaningfully advanced in this mode —
+    per-slot lengths live with the caller; masking already derives KV
+    positions from ``valid`` alone.
     """
     if attend_fn is not None and cache is not None:
         raise ValueError("attend_fn does not support the KV-cache decode path")
+    if cache_positions is not None and cache is None:
+        raise ValueError("cache_positions requires the KV-cache decode path")
+    if cache_positions is not None and input_ids.shape[1] != 1:
+        raise ValueError("cache_positions supports single-token chunks only "
+                         f"(got T={input_ids.shape[1]})")
     B, T = input_ids.shape
     cdt = cfg.compute_dtype
 
@@ -379,8 +401,14 @@ def forward(
         mask_global = mask_sliding = None   # attend_fn owns masking
     elif cache is not None:
         S = cache.k.shape[2]
-        # The new chunk's slot validity lands at [length, length+T).
-        new_valid = lax.dynamic_update_slice(cache.valid, attn_validity, (0, cache.length))
+        # The new chunk's slot validity lands at [length, length+T) — or, in
+        # serve mode, at each row's own column.
+        if cache_positions is not None:
+            new_valid = cache.valid.at[
+                jnp.arange(B), cache_positions].set(attn_validity[:, 0])
+        else:
+            new_valid = lax.dynamic_update_slice(
+                cache.valid, attn_validity, (0, cache.length))
         # KV "positions" for masking: slot i of row b holds a token whose RoPE
         # position is unknown here; causal/sliding masking must compare real
         # token positions.  We reconstruct them from validity: pads carry
@@ -413,12 +441,19 @@ def forward(
             cv = lax.dynamic_index_in_dim(v_stack, idx, 0, keepdims=False)
             h, (new_k, new_v) = _layer(
                 h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
-                ck, cv, cache.length,
+                ck, cv, cache.length, cache_positions=cache_positions,
             )
-            k_stack = lax.dynamic_update_slice(
-                k_stack, new_k[None], (idx, 0, cache.length, 0, 0))
-            v_stack = lax.dynamic_update_slice(
-                v_stack, new_v[None], (idx, 0, cache.length, 0, 0))
+            if cache_positions is not None:
+                rows = jnp.arange(B)
+                k_stack = k_stack.at[idx, rows, cache_positions].set(
+                    new_k[:, 0])
+                v_stack = v_stack.at[idx, rows, cache_positions].set(
+                    new_v[:, 0])
+            else:
+                k_stack = lax.dynamic_update_slice(
+                    k_stack, new_k[None], (idx, 0, cache.length, 0, 0))
+                v_stack = lax.dynamic_update_slice(
+                    v_stack, new_v[None], (idx, 0, cache.length, 0, 0))
             if edit_fn is not None:
                 h = edit_fn(h, idx)
             if carry_tap is not None:

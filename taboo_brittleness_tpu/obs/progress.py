@@ -84,6 +84,8 @@ class ProgressReporter:
         }
         self._word_t0: Optional[float] = None
         self._ema: Optional[float] = None
+        self._serving: Optional[Dict[str, Any]] = None
+        self._last_step_mono: Optional[float] = None
 
     # -- state setters (all thread-safe, all fail-open at the write) -------
 
@@ -123,6 +125,31 @@ class ProgressReporter:
             self._word_t0 = None
         self._write_throttled()
 
+    def serving_update(self, *, in_flight: int, completed: int,
+                       queued: int = 0, stepped: bool = False) -> None:
+        """Serving-mode heartbeat state (``tbx serve``; ISSUE 6 satellite).
+
+        The word-sweep staleness classifier assumes word-boundary progress —
+        a long-lived server that is healthy but IDLE emits no events, which
+        the two-signal rule would misread as "pipeline wedged".  Serving
+        mode publishes what liveness actually means for a server: the
+        in-flight session count, the completed-request counter, and the age
+        of the last decode step (``stepped=True`` marks one).  The
+        supervisor's wedge classifier (``runtime.supervise._wedge_reason``)
+        keys off ``workload == "serve"``: idle-but-alive is healthy by
+        heartbeat alone; only in-flight sessions with a stalled step clock
+        wedge."""
+        now = self._clock()
+        with self._lock:
+            if stepped or self._last_step_mono is None:
+                self._last_step_mono = now
+            self._serving = {
+                "in_flight": int(in_flight),
+                "completed_requests": int(completed),
+                "queued": int(queued),
+            }
+        self._write_throttled()
+
     def finish(self, status: str = "done") -> None:
         with self._lock:
             self._state["status"] = status
@@ -137,6 +164,8 @@ class ProgressReporter:
             state = dict(self._state)
             ema = self._ema
             word_t0 = self._word_t0
+            serving = dict(self._serving) if self._serving else None
+            last_step = self._last_step_mono
         remaining = max(
             0, state["words_total"] - state["words_done"]
             - state["words_quarantined"])
@@ -162,6 +191,12 @@ class ProgressReporter:
             "word_seconds_ema": round(ema, 3) if ema is not None else None,
             "eta_seconds": round(eta, 1) if eta is not None else None,
         }
+        if serving is not None:
+            out["workload"] = "serve"
+            if last_step is not None:
+                serving["last_step_age_seconds"] = round(
+                    max(0.0, self._clock() - last_step), 3)
+            out["serving"] = serving
         if self.tracer is not None:
             try:
                 out["last_event_age_seconds"] = round(

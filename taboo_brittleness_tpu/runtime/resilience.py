@@ -468,6 +468,10 @@ FAULT_SITES = (
     "obs.event_write",    # obs.trace.Tracer._emit — proves telemetry is
     #                       fail-open: an injected sink fault drops the event,
     #                       never the run (tests/test_obs.py)
+    "serve.step",         # serve.scheduler.SlotScheduler.step — fired once
+    #                       per in-flight session per step (context: request
+    #                       id + scenario) so a plan can poison ONE session;
+    #                       the scheduler quarantines it, the batch lives
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

@@ -30,7 +30,10 @@ child can stop:
 - exit 1 (quarantined words) → the sweep COMPLETED; the in-process
   retry/quarantine subsystem already exhausted its budget, so the
   supervisor passes 1 through instead of burning incarnations replaying a
-  permanent failure;
+  permanent failure.  This pass-through is conditional on the child's
+  declared workload (its progress file's ``workload`` field): for a SERVING
+  child (``tbx serve``) exit 1 is a crash loop, not completion, and burns
+  an incarnation like any other crash;
 - any other death (crash, OOM/SIGKILL, ``die`` fault) → relaunch after a
   seeded-jitter backoff (``RetryPolicy``), within a bounded incarnation
   budget;
@@ -244,13 +247,28 @@ def _wedge_reason(progress: Dict[str, Any], pid: int,
 
     Only THIS incarnation's heartbeat counts (pid match): right after a
     relaunch the file still holds the dead predecessor's state, which must
-    read as "child starting up", never as "child wedged"."""
+    read as "child starting up", never as "child wedged".
+
+    Serving children (``workload == "serve"``; ``obs.progress.serving_update``)
+    get their own pipeline-quiet signal: a healthy server that is IDLE emits
+    no telemetry events, so the event-age rule would kill it — instead the
+    classifier reads the serving heartbeat's in-flight count and last-step
+    age, and only wedges a server that HAS sessions but stopped stepping."""
     if progress.get("status") != "running" or progress.get("pid") != pid:
         return None
     if progress.get("stale"):
         # updated_at is old: the heartbeat thread itself stopped while the
         # process is still alive (we checked poll() first) — hard wedge.
         return "heartbeat-stale"
+    if progress.get("workload") == "serve":
+        serving = progress.get("serving") or {}
+        step_age = serving.get("last_step_age_seconds")
+        if (wedge_after and int(serving.get("in_flight", 0) or 0) > 0
+                and step_age is not None
+                and (float(step_age)
+                     + float(progress.get("age_seconds", 0.0)) > wedge_after)):
+            return "pipeline-wedged"
+        return None         # idle-but-alive: healthy by heartbeat alone
     age = progress.get("last_event_age_seconds")
     if wedge_after and age is not None:
         # The event age was measured when the heartbeat wrote the file; the
@@ -444,6 +462,21 @@ def supervise(
             rec["outcome"] = "drained"
             history.append(rec)
             continue
+        elif rc == EXIT_QUARANTINED and read_progress(
+                progress_path, missing_ok=True).get("workload") == "serve":
+            # A SWEEP's exit 1 means "completed, words quarantined" — the
+            # in-process retry budget is spent and rerunning replays the
+            # failure, so the supervisor passes it through.  A SERVER has no
+            # such semantics: its exit 1 is a crash (an exception escaped the
+            # serve loop), and passing it through would let a crash loop
+            # masquerade as completion — burn an incarnation instead.
+            rec["outcome"] = "crashed"
+            rec["reason"] = "serve-exit-1"
+            history.append(rec)
+            _emit_events(output_dir, [("supervise.crash",
+                                       {"incarnation": incarnation,
+                                        "reason": "serve-exit-1",
+                                        "exit_code": rc})])
         elif rc == EXIT_QUARANTINED:
             rec["outcome"] = "quarantined"
             history.append(rec)

@@ -1,0 +1,35 @@
+"""Multi-tenant brittleness-probe serving: continuous batching over one
+resident model.
+
+Everything else in the repo is an offline sweep; this package is the online
+workload the ROADMAP's north star demands — concurrent chat / token-forcing /
+SAE-ablated / lens-readout sessions multiplexed into ONE compiled decode step
+over one resident Gemma-2 checkpoint (the Sequoia production stance,
+arXiv:2402.12374: the same decode program serves every scenario; Kernel
+Looping, arXiv:2410.23668: the program stays resident, no per-scenario
+recompile).
+
+Layering (each module's docstring has depth):
+
+- :mod:`~taboo_brittleness_tpu.serve.engine` — the device half: a fixed-width
+  slot batch with per-slot KV pages (``models.gemma2.forward``'s
+  ``cache_positions`` mode) and per-request intervention config as in-graph
+  per-slot data switches, advanced by one jitted, donated, AOT-registered
+  ``serve_step`` program.
+- :mod:`~taboo_brittleness_tpu.serve.scheduler` — the host half: scenario
+  definitions, bounded-queue admission control, slot assignment/recycling,
+  per-scenario SLO latency histograms, drain semantics, and the
+  ``serve.step`` fault site (one poisoned session quarantines, the batch
+  lives).
+- :mod:`~taboo_brittleness_tpu.serve.server` — the long-lived ``tbx serve``
+  process: a file-spool request/response protocol, serving-mode
+  ``_progress.json`` heartbeats, SIGTERM drain (finish in-flight sessions,
+  reject new admissions, exit 75), and incarnation resume of claimed-but-
+  unanswered requests.
+- :mod:`~taboo_brittleness_tpu.serve.loadgen` — the closed-loop load
+  generator behind ``tbx loadgen`` and the ``serve_latency`` bench stage:
+  seeded scenario mix + arrival process, per-scenario p50/p99, goodput.
+"""
+
+from taboo_brittleness_tpu.serve.scheduler import (  # noqa: F401
+    Request, Response, Scenario, SlotScheduler, default_scenarios)

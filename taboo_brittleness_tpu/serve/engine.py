@@ -1,0 +1,385 @@
+"""The serve engine: one resident compiled step program over a slot batch.
+
+Design (the tentpole of ISSUE 6, following Sequoia's production stance —
+arXiv:2402.12374 — and Kernel Looping's no-host-round-trip-per-kernel
+argument, arXiv:2410.23668):
+
+- **One program for everything.**  Prefill and decode are the SAME
+  single-token step: a slot whose position is still inside its prompt feeds
+  the next prompt token (teacher-forced, chunk size 1 — the limiting case of
+  chunked prefill), a slot past its prompt feeds its own argmax.  Admitting a
+  session, switching its scenario, or recycling its slot never changes a
+  shape, so the step compiles exactly once and the AOT registry
+  (``runtime.aot``) serves every launch from that one executable — the
+  acceptance gate is literally ``aot.stats()["serve.step"]["misses"] == 0``
+  after warm-up.
+- **Per-slot KV pages.**  Each slot owns row ``s`` of a ``[L, S, C, K, Dh]``
+  cache and writes at its OWN column (``forward(cache_positions=...)``,
+  added for this engine): slots decode at different sequence lengths in one
+  batch, and recycling a slot is just invalidating its row.  The cache and
+  the slot state are DONATED through every step, so the resident ~GB KV
+  block updates in place.
+- **Interventions are data, not programs.**  The brittleness probes ride as
+  per-slot arrays exploiting the ops' identity-at-zero contracts:
+  SAE-ablation latent ids pad with ``-1`` (``ops.sae.ablate_latents``
+  matches nothing → exact identity), projection bases pad with zero columns
+  (``ops.projection.remove_subspace`` projects to 0 → identity), and the
+  lens readout target is ``-1`` for off.  A plain-chat session and an
+  SAE-ablated session differ only in what their slot's rows of
+  ``latent_ids``/``basis`` hold — no recompile, no branch divergence beyond
+  one ``lax.cond`` per edited layer.
+
+Host syncs: the engine pulls one small ``StepOut`` pytree per step (the
+emitted token ids the scheduler needs to detect completion) — that is the
+continuous-batching control loop, not an accident, and it is pragma'd at the
+call site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import (
+    Gemma2Config, KVCache, Params, forward, unembed)
+from taboo_brittleness_tpu.ops import projection, sae as sae_ops
+from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+from taboo_brittleness_tpu.runtime import aot, chat
+
+#: Default stop ids — the same response terminators the sweep decode uses.
+STOP_IDS = (chat.EOS_ID, chat.END_OF_TURN_ID)
+
+
+class SlotState(NamedTuple):
+    """Per-slot device state, advanced (donated) through every step.
+
+    All arrays lead with the slot axis ``[S, ...]``; every shape is fixed at
+    engine construction so the step program never retraces.
+    """
+
+    input_tok: jax.Array    # [S] int32 — token the next step feeds
+    pos: jax.Array          # [S] int32 — its position == the KV column written
+    active: jax.Array       # [S] bool — slot holds a session
+    done: jax.Array         # [S] bool — session finished, awaiting recycle
+    prompt_buf: jax.Array   # [S, P] int32 — left-aligned prompt ids
+    prompt_len: jax.Array   # [S] int32
+    gen_count: jax.Array    # [S] int32 — generated tokens so far
+    max_gen: jax.Array      # [S] int32 — per-slot generation budget
+    latent_ids: jax.Array   # [S, m] int32 — SAE latents to ablate (-1 inert)
+    basis: jax.Array        # [S, D, r] f32 — projection basis (0 inert)
+    lens_target: jax.Array  # [S] int32 — lens readout token id (-1 off)
+
+    @classmethod
+    def zeros(cls, cfg: Gemma2Config, slots: int, prompt_cols: int,
+              latent_slots: int, proj_rank: int) -> "SlotState":
+        S = slots
+        return cls(
+            input_tok=jnp.zeros((S,), jnp.int32),
+            pos=jnp.zeros((S,), jnp.int32),
+            active=jnp.zeros((S,), bool),
+            done=jnp.zeros((S,), bool),
+            prompt_buf=jnp.zeros((S, prompt_cols), jnp.int32),
+            prompt_len=jnp.zeros((S,), jnp.int32),
+            gen_count=jnp.zeros((S,), jnp.int32),
+            max_gen=jnp.zeros((S,), jnp.int32),
+            latent_ids=jnp.full((S, latent_slots), -1, jnp.int32),
+            basis=jnp.zeros((S, cfg.hidden_size, proj_rank), jnp.float32),
+            lens_target=jnp.full((S,), -1, jnp.int32),
+        )
+
+
+class StepOut(NamedTuple):
+    """What one step emits per slot (the scheduler's whole view of the
+    device).  ``tok`` is a real generated token only where ``emitted``;
+    ``finished`` marks slots whose session completed THIS step."""
+
+    tok: jax.Array        # [S] int32
+    emitted: jax.Array    # [S] bool
+    finished: jax.Array   # [S] bool
+    lens_prob: jax.Array  # [S] f32 — P(lens_target) at the tap layer (0 off)
+
+
+def _serve_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
+    """Per-slot intervention switch, applied inside the layer scan.
+
+    ``lax.cond`` on the (traced) layer index keeps the edit compute out of
+    the other layers entirely (the ``interventions._at_layer`` rationale);
+    WITHIN the edited layer, per-slot on/off is pure data — inert rows cost
+    the shared encode/decode FLOPs but change nothing.
+    """
+    if "sae" in ep:
+        h = lax.cond(
+            idx == ep["sae_layer"],
+            lambda x: sae_ops.ablate_latents(ep["sae"], x, ep["latent_ids"]),
+            lambda x: x, h)
+    h = lax.cond(
+        idx == ep["proj_layer"],
+        lambda x: projection.remove_subspace(x, ep["basis"]),
+        lambda x: x, h)
+    return h
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
+                          "stop_ids"),
+         donate_argnames=("cache", "state"))
+def serve_step(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    cache: KVCache,
+    state: SlotState,
+    *,
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+    stop_ids: Tuple[int, ...] = STOP_IDS,
+) -> Tuple[KVCache, SlotState, StepOut]:
+    """Advance every live slot by one token — prefill and decode unified.
+
+    Semantics per slot (S-wide, branch-free):
+
+    - feed ``input_tok`` at ``pos``; its K/V land at the slot's own column
+      ``pos`` (``cache_positions``);
+    - the forward's argmax becomes the slot's next input UNLESS the slot is
+      still inside its prompt, in which case the next prompt token does
+      (teacher-forced prefill at chunk size 1);
+    - a slot past its prompt EMITS the argmax; emitting a stop id or
+      exhausting ``max_gen`` finishes the session (the stop token itself is
+      kept, matching ``greedy_decode``);
+    - inactive/finished slots freeze: pad input, invalid attention, no
+      state advance — their cache rows stay masked and untouched.
+    """
+    S = state.input_tok.shape[0]
+    alive = state.active & ~state.done
+
+    ep: Dict[str, Any] = {
+        "latent_ids": state.latent_ids,
+        "basis": state.basis,
+        "proj_layer": proj_layer,
+    }
+    if sae is not None:
+        ep["sae"] = sae
+        ep["sae_layer"] = sae_layer
+    bound_edit = lambda h, i: _serve_edit(h, i, ep)
+
+    res = forward(
+        params, cfg, state.input_tok[:, None],
+        positions=state.pos[:, None],
+        attn_validity=alive[:, None],
+        cache=cache,
+        cache_positions=state.pos,
+        edit_fn=bound_edit,
+        carry_tap=residual_carry_tap(S, 1, cfg.hidden_size, tap_layer),
+        compute_logits=False,
+    )
+    logits = unembed(params, cfg, res.last_hidden)[:, 0]      # [S, V] f32
+    samp = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Lens readout tap: P(lens_target) at the tap layer for this position —
+    # the serving form of the paper's logit-lens probe.  One cond for the
+    # whole batch: steps with no readout session skip the vocab matmul.
+    lens_on = (state.lens_target >= 0) & alive
+
+    def _readout(resid_tgt):
+        resid, tgt = resid_tgt
+        from taboo_brittleness_tpu.ops.lens import _lens_logits
+
+        ll = _lens_logits(params, cfg, resid)[:, 0]           # [S, V] f32
+        lse = jax.scipy.special.logsumexp(ll, axis=-1)
+        picked = jnp.take_along_axis(
+            ll, jnp.clip(tgt, 0, cfg.vocab_size - 1)[:, None], axis=-1)[:, 0]
+        return jnp.exp(picked - lse)
+
+    lens_prob = lax.cond(
+        jnp.any(lens_on), _readout,
+        lambda _: jnp.zeros((S,), jnp.float32),
+        (res.carry_tap, state.lens_target))
+    lens_prob = jnp.where(lens_on, lens_prob, 0.0)
+
+    in_prompt = state.pos + 1 < state.prompt_len              # next tok forced
+    next_from_prompt = jnp.take_along_axis(
+        state.prompt_buf,
+        jnp.clip(state.pos + 1, 0, state.prompt_buf.shape[1] - 1)[:, None],
+        axis=1)[:, 0]
+
+    emitted = alive & ~in_prompt
+    stop = jnp.asarray(stop_ids, jnp.int32)
+    hit_stop = jnp.any(samp[:, None] == stop[None, :], axis=-1)
+    finished = emitted & (hit_stop | (state.gen_count + 1 >= state.max_gen))
+
+    alive_next = alive & ~finished
+    next_tok = jnp.where(in_prompt, next_from_prompt, samp)
+    next_tok = jnp.where(alive_next, next_tok, chat.PAD_ID)
+
+    new_state = state._replace(
+        input_tok=next_tok,
+        pos=jnp.where(alive_next, state.pos + 1, state.pos),
+        done=state.done | finished,
+        gen_count=state.gen_count + emitted.astype(jnp.int32),
+    )
+    out = StepOut(
+        tok=jnp.where(emitted, samp, chat.PAD_ID),
+        emitted=emitted, finished=finished, lens_prob=lens_prob)
+    return res.cache, new_state, out
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Static shape envelope of one engine — everything that selects the
+    compiled program.  ``max_context`` bounds prompt+generation per session;
+    ``prompt_cols`` bounds the prompt alone; ``latent_slots``/``proj_rank``
+    bound how much intervention state a single request may carry."""
+
+    slots: int = 8
+    max_context: int = 160
+    prompt_cols: int = 96
+    latent_slots: int = 8
+    proj_rank: int = 4
+    sae_layer: int = 0
+    proj_layer: int = 0
+    tap_layer: int = 0
+    stop_ids: Tuple[int, ...] = STOP_IDS
+
+
+class ServeEngine:
+    """Host handle on the resident slot batch: admission, stepping, recycle.
+
+    NOT thread-safe — the scheduler owns it from one thread (the serve loop).
+    """
+
+    def __init__(self, params: Params, cfg: Gemma2Config, tok, *,
+                 engine_config: Optional[EngineConfig] = None,
+                 sae: Optional[sae_ops.SAEParams] = None):
+        self.params = params
+        self.cfg = cfg
+        self.tok = tok
+        self.sae = sae
+        self.ec = engine_config or EngineConfig()
+        if self.ec.prompt_cols >= self.ec.max_context:
+            raise ValueError("prompt_cols must leave room to generate "
+                             f"(prompt_cols={self.ec.prompt_cols} >= "
+                             f"max_context={self.ec.max_context})")
+        self.state = SlotState.zeros(
+            cfg, self.ec.slots, self.ec.prompt_cols,
+            self.ec.latent_slots, self.ec.proj_rank)
+        self.cache = KVCache.zeros(cfg, self.ec.slots,
+                                   max_len=self.ec.max_context)
+        self.steps = 0
+
+    # -- program plumbing ---------------------------------------------------
+
+    def _static(self) -> Dict[str, Any]:
+        return dict(cfg=self.cfg, sae_layer=self.ec.sae_layer,
+                    proj_layer=self.ec.proj_layer,
+                    tap_layer=self.ec.tap_layer,
+                    stop_ids=self.ec.stop_ids)
+
+    def _dynamic(self) -> Dict[str, Any]:
+        return dict(params=self.params, sae=self.sae,
+                    cache=self.cache, state=self.state)
+
+    def warm_start(self) -> Dict[str, Any]:
+        """Trace+compile the step program ahead of the first request (the
+        AOT registry build path — ``aot.build`` records the trace/compile
+        split and installs the executable, so every subsequent ``step()`` is
+        a registry HIT and ``misses`` stays 0).  ``execute=False``: a warm-up
+        execution would consume the donated state/cache buffers."""
+        entry = aot.entry("serve.step", serve_step)
+        return entry.build(self._dynamic(), self._static(), execute=False)
+
+    def step(self) -> StepOut:
+        """Advance the batch one token; returns the HOST copy of StepOut.
+
+        The pull is the continuous-batching control point: the scheduler
+        must see emitted/finished flags to recycle slots and admit queued
+        sessions before the next step.  One small [S]-wide transfer per
+        step, by design.
+        """
+        self.cache, self.state, out = aot.dispatch(
+            "serve.step", serve_step,
+            dynamic=self._dynamic(), static=self._static())
+        self.steps += 1
+        # tbx: TBX001-ok — host control point: the scheduler needs emitted/
+        # finished flags each step to recycle slots (one [S]-wide pull).
+        return jax.device_get(out)
+
+    # -- admission / recycle ------------------------------------------------
+
+    def capacity_ok(self, prompt_len: int, max_new: int) -> bool:
+        return (0 < prompt_len <= self.ec.prompt_cols
+                and prompt_len + max_new <= self.ec.max_context)
+
+    def free_slots(self) -> List[int]:
+        st = jax.device_get(self.state.active)  # tbx: TBX001-ok — [S] bools, admission bookkeeping
+        return [i for i in range(self.ec.slots) if not bool(st[i])]
+
+    def admit(self, slot: int, prompt_ids: Sequence[int], *,
+              max_new: int,
+              latent_ids: Sequence[int] = (),
+              basis: Optional[np.ndarray] = None,
+              lens_target: int = -1) -> None:
+        """Install a session into ``slot``: write its prompt page, its
+        intervention rows, and invalidate the slot's KV row.  The first
+        prompt token becomes the slot's next input at position 0."""
+        P = self.ec.prompt_cols
+        n = len(prompt_ids)
+        if not self.capacity_ok(n, max_new):
+            raise ValueError(
+                f"prompt of {n} tokens + {max_new} new exceeds the engine "
+                f"envelope (prompt_cols={P}, max_context={self.ec.max_context})")
+        if len(latent_ids) > self.ec.latent_slots:
+            raise ValueError(f"{len(latent_ids)} latents > latent_slots="
+                             f"{self.ec.latent_slots}")
+        ids = np.asarray(list(prompt_ids), np.int32)
+        buf = np.zeros((P,), np.int32)
+        buf[:n] = ids
+        lat = np.full((self.ec.latent_slots,), -1, np.int32)
+        lat[:len(latent_ids)] = np.asarray(list(latent_ids), np.int32)
+        bas = np.zeros((self.cfg.hidden_size, self.ec.proj_rank), np.float32)
+        if basis is not None:
+            b = np.asarray(basis, np.float32)
+            if b.shape[0] != self.cfg.hidden_size or b.shape[1] > self.ec.proj_rank:
+                raise ValueError(
+                    f"basis {b.shape} does not fit [D={self.cfg.hidden_size}, "
+                    f"r<={self.ec.proj_rank}]")
+            bas[:, :b.shape[1]] = b
+
+        s = self.state
+        self.state = s._replace(
+            input_tok=s.input_tok.at[slot].set(int(ids[0])),
+            pos=s.pos.at[slot].set(0),
+            active=s.active.at[slot].set(True),
+            done=s.done.at[slot].set(False),
+            prompt_buf=s.prompt_buf.at[slot].set(jnp.asarray(buf)),
+            prompt_len=s.prompt_len.at[slot].set(n),
+            gen_count=s.gen_count.at[slot].set(0),
+            max_gen=s.max_gen.at[slot].set(int(max_new)),
+            latent_ids=s.latent_ids.at[slot].set(jnp.asarray(lat)),
+            basis=s.basis.at[slot].set(jnp.asarray(bas)),
+            lens_target=s.lens_target.at[slot].set(int(lens_target)),
+        )
+        # Recycle the KV page: the row's stale columns must never attend.
+        self.cache = self.cache._replace(
+            valid=self.cache.valid.at[slot, :].set(False))
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the free pool (its KV page is invalidated on the
+        NEXT admit; until then the frozen row is harmless)."""
+        s = self.state
+        self.state = s._replace(
+            active=s.active.at[slot].set(False),
+            lens_target=s.lens_target.at[slot].set(-1),
+        )
+
+    def any_alive(self) -> bool:
+        # tbx: TBX001-ok — [S]-wide liveness check drives the serve loop
+        st = jax.device_get((self.state.active, self.state.done))
+        return bool(np.any(st[0] & ~st[1]))

@@ -1,0 +1,309 @@
+"""Closed-loop load generator: seeded scenario mix, arrival process, SLO stats.
+
+``tbx loadgen`` drives the serving subsystem and reports what the ROADMAP
+asked to make a tracked number: per-scenario p50/p99 latency and goodput,
+in the same JSON-stage shape the bench publishes (``serve_latency``).
+
+Two drive modes, one measurement path:
+
+- **in-process** (default; the bench stage and ``--selfcheck``): build a
+  scheduler over a provided engine and run the arrival schedule against it
+  directly — hermetic, no subprocess, deterministic given the seed.
+- **spool** (``--spool DIR``): write request files into a running ``tbx
+  serve``'s spool and poll for responses — the cross-process mode the e2e
+  acceptance test SIGTERMs mid-load.
+
+The arrival process is seeded (``random.Random(seed)``): exponential
+inter-arrival gaps at ``rate`` req/s, scenario picked by weighted mix, and a
+closed-loop cap of ``concurrency`` outstanding requests (arrivals beyond the
+cap wait — a load generator that outruns the server measures queueing it
+caused itself).  Everything times on the monotonic clock.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from taboo_brittleness_tpu.serve.scheduler import (
+    Request, Scenario, SlotScheduler, default_scenarios)
+
+#: Histogram-schema keys every per-scenario block must carry (the selfcheck
+#: gate, and what tools downstream key on).
+LATENCY_KEYS = ("count", "p50_s", "p99_s", "mean_s", "max_s")
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(q * (len(sorted_vals) - 1) + 0.5)))
+    return sorted_vals[idx]
+
+
+def _latency_block(latencies: List[float]) -> Dict[str, Any]:
+    s = sorted(latencies)
+    n = len(s)
+    return {
+        "count": n,
+        "p50_s": round(_quantile(s, 0.50), 6),
+        "p99_s": round(_quantile(s, 0.99), 6),
+        "mean_s": round(sum(s) / n, 6) if n else 0.0,
+        "max_s": round(s[-1], 6) if n else 0.0,
+    }
+
+
+def build_schedule(
+    n_requests: int,
+    *,
+    seed: int,
+    rate: float,
+    mix: Dict[str, float],
+    scenarios: Dict[str, Scenario],
+    prompts: Sequence[str],
+) -> List[Tuple[float, Request]]:
+    """The seeded arrival plan: [(arrival_offset_seconds, Request)].
+
+    Deterministic given (seed, rate, mix, prompts): the same plan replays
+    byte-identically, so a latency regression between rounds is the server's,
+    not the generator's.
+    """
+    rng = random.Random(f"loadgen:{seed}")
+    names = sorted(mix)
+    weights = [float(mix[n]) for n in names]
+    t = 0.0
+    out: List[Tuple[float, Request]] = []
+    for i in range(n_requests):
+        t += rng.expovariate(rate) if rate > 0 else 0.0
+        name = rng.choices(names, weights=weights, k=1)[0]
+        out.append((t, Request(
+            id=f"r{i:04d}-{name}",
+            prompt=prompts[i % len(prompts)],
+            scenario=scenarios[name],
+            seed=seed * 10_000 + i)))
+    return out
+
+
+def _report(per_scenario_lat: Dict[str, List[float]], *,
+            admitted: int, completed: int, rejected: int, quarantined: int,
+            wall_seconds: float, config: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "stage": "serve_latency",
+        "scenarios": {name: _latency_block(lats)
+                      for name, lats in sorted(per_scenario_lat.items())},
+        "overall": _latency_block(
+            [x for lats in per_scenario_lat.values() for x in lats]),
+        "goodput": {
+            "admitted": admitted,
+            "completed": completed,
+            "rejected": rejected,
+            "quarantined": quarantined,
+            "completed_per_second": (round(completed / wall_seconds, 3)
+                                     if wall_seconds > 0 else None),
+        },
+        "wall_seconds": round(wall_seconds, 3),
+        "config": config,
+    }
+
+
+def run_inprocess(
+    engine,
+    *,
+    n_requests: int = 32,
+    seed: int = 0,
+    rate: float = 200.0,
+    concurrency: int = 16,
+    mix: Optional[Dict[str, float]] = None,
+    scenarios: Optional[Dict[str, Scenario]] = None,
+    prompts: Sequence[str] = ("Give me a hint",),
+    lens_target_id: int = -1,
+    queue_limit: int = 64,
+    clock: Callable[[], float] = time.monotonic,
+) -> Dict[str, Any]:
+    """Drive a fresh scheduler over ``engine`` through the seeded schedule;
+    returns the ``serve_latency`` report dict."""
+    scenarios = scenarios or default_scenarios()
+    mix = mix or {name: 1.0 for name in scenarios}
+    plan = build_schedule(n_requests, seed=seed, rate=rate, mix=mix,
+                          scenarios=scenarios, prompts=prompts)
+    sched = SlotScheduler(engine, queue_limit=queue_limit,
+                          lens_target_id=lens_target_id, clock=clock)
+    engine.warm_start()
+
+    lat: Dict[str, List[float]] = {}
+    t0 = clock()
+    pending = list(plan)
+    outstanding = 0
+    rejected = 0
+    resolved = 0
+    while resolved + rejected < n_requests:
+        now = clock() - t0
+        while (pending and pending[0][0] <= now
+               and outstanding < concurrency):
+            _, req = pending.pop(0)
+            if sched.submit(req):
+                outstanding += 1
+            else:
+                rejected += 1
+        if sched.in_flight or sched.queue_depth:
+            for resp in sched.step():
+                outstanding -= 1
+                resolved += 1
+                if resp.ok:
+                    lat.setdefault(resp.scenario, []).append(
+                        resp.latency_seconds)
+        elif pending:
+            # Nothing in flight and the next arrival is in the future: sleep
+            # to it (closed loop, not busy wait).
+            time.sleep(max(0.0, min(0.01, pending[0][0] - now)))
+        else:
+            break
+    wall = clock() - t0
+    return _report(
+        lat, admitted=sched.admitted, completed=sched.completed,
+        rejected=sched.rejected, quarantined=sched.quarantined,
+        wall_seconds=wall,
+        config={"mode": "in-process", "n_requests": n_requests, "seed": seed,
+                "rate": rate, "concurrency": concurrency,
+                "mix": mix, "slots": engine.ec.slots})
+
+
+def run_spool(
+    spool_dir: str,
+    *,
+    n_requests: int = 32,
+    seed: int = 0,
+    rate: float = 50.0,
+    concurrency: int = 16,
+    mix: Optional[Dict[str, float]] = None,
+    scenarios: Optional[Dict[str, Scenario]] = None,
+    prompts: Sequence[str] = ("Give me a hint",),
+    timeout_s: float = 300.0,
+    poll_s: float = 0.02,
+    clock: Callable[[], float] = time.monotonic,
+) -> Dict[str, Any]:
+    """Drive a RUNNING ``tbx serve`` through its spool; latency is
+    client-observed (request file written → response file seen).  Requests
+    left unanswered at ``timeout_s`` count as dropped (goodput shortfall) —
+    with a draining+supervised server the expectation is zero."""
+    from taboo_brittleness_tpu.serve.server import RequestSpool
+
+    scenarios = scenarios or default_scenarios()
+    mix = mix or {name: 1.0 for name in scenarios}
+    spool = RequestSpool(spool_dir)
+    plan = build_schedule(n_requests, seed=seed, rate=rate, mix=mix,
+                          scenarios=scenarios, prompts=prompts)
+
+    lat: Dict[str, List[float]] = {}
+    submit_at: Dict[str, float] = {}
+    scenario_of: Dict[str, str] = {}
+    pending = list(plan)
+    awaiting: List[str] = []
+    completed = 0
+    t0 = clock()
+    deadline = t0 + timeout_s
+    while (pending or awaiting) and clock() < deadline:
+        now = clock() - t0
+        while pending and pending[0][0] <= now and len(awaiting) < concurrency:
+            _, req = pending.pop(0)
+            rid = spool.put({"id": req.id, "prompt": req.prompt,
+                             "scenario": req.scenario.name,
+                             "seed": req.seed})
+            submit_at[rid] = clock()
+            scenario_of[rid] = req.scenario.name
+            awaiting.append(rid)
+        still = []
+        for rid in awaiting:
+            resp = spool.get_response(rid)
+            if resp is None:
+                still.append(rid)
+                continue
+            completed += 1
+            if resp.get("ok"):
+                lat.setdefault(scenario_of[rid], []).append(
+                    clock() - submit_at[rid])
+        awaiting = still
+        if awaiting or pending:
+            time.sleep(poll_s)
+    wall = clock() - t0
+    return _report(
+        lat, admitted=len(submit_at), completed=completed,
+        rejected=0, quarantined=len(submit_at) - completed,
+        wall_seconds=wall,
+        config={"mode": "spool", "spool": spool_dir,
+                "n_requests": n_requests, "seed": seed, "rate": rate,
+                "concurrency": concurrency, "mix": mix,
+                "dropped": len(awaiting) + len(pending)})
+
+
+# ---------------------------------------------------------------------------
+# Selfcheck: the CPU-sized CI smoke (tools/check.sh).
+# ---------------------------------------------------------------------------
+
+
+def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
+                           max_new_tokens: int = 6):
+    """Tiny-model engine for hermetic runs: gemma2_tiny + WordTokenizer +
+    a small random SAE — the same stack the supervised-execution e2e uses.
+    Returns (engine, scenarios, lens_target_id)."""
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime.tokenizer import (
+        WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
+    words = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+             "Give", "me", "a", "the", "about"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(seed + 1),
+                              cfg.hidden_size, 64)
+    tap = min(2, cfg.num_layers - 1)
+    engine = ServeEngine(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=slots, max_context=48, prompt_cols=24,
+            latent_slots=4, proj_rank=2,
+            sae_layer=tap, proj_layer=tap, tap_layer=tap),
+        sae=sae)
+    scenarios = default_scenarios(max_new_tokens=max_new_tokens,
+                                  ablate_latents=(0, 1, 2, 3), proj_rank=2)
+    return engine, scenarios, target_token_id(tok, "ship")
+
+
+def selfcheck(n_requests: int = 32, seed: int = 0) -> Dict[str, Any]:
+    """The CI smoke: tiny model, ``n_requests`` mixed-scenario requests,
+    assert goodput == admitted (nothing dropped/quarantined) and the
+    latency-histogram schema.  Raises AssertionError on violation; returns
+    the report."""
+    engine, scenarios, lens_tgt = build_synthetic_engine()
+    report = run_inprocess(
+        engine, n_requests=n_requests, seed=seed, rate=500.0,
+        concurrency=16, scenarios=scenarios, lens_target_id=lens_tgt,
+        prompts=("Give me a hint", "Give me a clue about the word"))
+    good = report["goodput"]
+    assert good["completed"] == good["admitted"] == n_requests, (
+        f"goodput shortfall: {good}")
+    assert good["quarantined"] == 0, good
+    for name, block in report["scenarios"].items():
+        missing = [k for k in LATENCY_KEYS if k not in block]
+        assert not missing, f"scenario {name} missing keys {missing}"
+        assert block["count"] > 0, f"scenario {name} never ran"
+    assert set(report["scenarios"]) == set(scenarios), (
+        "selfcheck mix must exercise every scenario: "
+        f"{sorted(report['scenarios'])} vs {sorted(scenarios)}")
+    return report
+
+
+def main_selfcheck() -> int:
+    report = selfcheck()
+    # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict JSON)
+    print(json.dumps({"selfcheck": "ok",
+                      "goodput": report["goodput"],
+                      "scenarios": sorted(report["scenarios"])}))
+    return 0

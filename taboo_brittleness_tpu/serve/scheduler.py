@@ -1,0 +1,346 @@
+"""Slot scheduler: admission control, scenario multiplexing, SLO metrics.
+
+The host half of the serving subsystem.  The engine (``serve.engine``) owns
+the device batch; this module owns the REQUEST lifecycle:
+
+    submit -> (bounded queue) -> admit into a free slot -> step*N -> complete
+                 |                                           |
+                 +-- rejected (queue full / draining)        +-- quarantined
+                                                                 (serve.step
+                                                                  fault)
+
+Scenarios are the paper's brittleness probes as per-request serving config:
+plain chat, SAE-latent ablation, low-rank projection removal, token-forcing
+prefill, and the logit-lens readout tap — every combination multiplexes into
+the ONE compiled step program (per-slot data switches; see engine docstring).
+
+SLO surfaces (``obs.metrics``, snapshotted into the run manifest):
+
+- ``serve.latency.<scenario>`` — end-to-end seconds, submit→complete (the
+  per-scenario p50/p99 the loadgen and bench report);
+- ``serve.queue_wait`` — seconds spent queued before a slot freed;
+- ``serve.in_flight`` / ``serve.queue_depth`` — live gauges;
+- ``serve.admitted`` / ``serve.rejected`` / ``serve.completed`` /
+  ``serve.quarantined`` / ``serve.steps`` — counters.
+
+Failure isolation: every step fires the ``serve.step`` fault site once per
+in-flight session (context: request id + scenario), so a seeded
+``TABOO_FAULT_PLAN`` can poison ONE session; the scheduler quarantines
+exactly that session (error response, slot recycled) and the rest of the
+batch keeps decoding — the sweep's quarantine-and-continue stance at
+request granularity.
+
+Drain: ``drain()`` flips admission off (submits are rejected, the queue
+stops feeding slots is NOT true — queued sessions already admitted-to-queue
+still run; see ``drain(hard=...)`` below) while in-flight sessions run to
+completion — the SIGTERM contract of ``tbx serve``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.runtime import chat, resilience
+from taboo_brittleness_tpu.serve.engine import ServeEngine
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One per-request probe configuration.
+
+    ``ablate_latents`` — SAE latent ids zeroed in-graph at the engine's SAE
+    layer (empty = off).  ``proj_rank`` — rank of a seeded random orthonormal
+    subspace removed at the projection layer (0 = off).  ``prefill`` — text
+    the model turn is forced to open with (token forcing, paper App. D.4).
+    ``lens_readout`` — per-step P(secret) at the tap layer rides back with
+    the response.  ``max_new_tokens`` — per-session generation budget.
+    """
+
+    name: str
+    ablate_latents: tuple = ()
+    proj_rank: int = 0
+    prefill: Optional[str] = None
+    lens_readout: bool = False
+    max_new_tokens: int = 24
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def default_scenarios(*, max_new_tokens: int = 24,
+                      ablate_latents: Sequence[int] = (0, 1, 2, 3),
+                      proj_rank: int = 2,
+                      forcing_prefill: str = "My secret word is",
+                      ) -> Dict[str, Scenario]:
+    """The serving scenario menu — one per probe family the paper sweeps."""
+    mk = lambda **kw: Scenario(max_new_tokens=max_new_tokens, **kw)
+    return {
+        "chat": mk(name="chat"),
+        "chat_lens": mk(name="chat_lens", lens_readout=True),
+        "sae_ablate": mk(name="sae_ablate",
+                         ablate_latents=tuple(ablate_latents),
+                         lens_readout=True),
+        "projection": mk(name="projection", proj_rank=proj_rank),
+        "forcing": mk(name="forcing", prefill=forcing_prefill),
+    }
+
+
+@dataclasses.dataclass
+class Request:
+    id: str
+    prompt: str
+    scenario: Scenario
+    seed: int = 0
+    submitted_at: float = 0.0      # monotonic; stamped by submit()
+
+
+@dataclasses.dataclass
+class Response:
+    id: str
+    scenario: str
+    ok: bool
+    text: str = ""
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finish: str = ""               # eos | budget | quarantined
+    steps: int = 0
+    queue_seconds: float = 0.0
+    latency_seconds: float = 0.0
+    lens_probs: Optional[List[float]] = None
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class _Session:
+    request: Request
+    slot: int
+    admitted_at: float
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    lens_probs: List[float] = dataclasses.field(default_factory=list)
+    steps: int = 0
+
+
+class SlotScheduler:
+    """Admission-controlled continuous batching over one :class:`ServeEngine`.
+
+    Single-threaded by design: the serve loop owns ``submit``/``step``.
+    ``on_complete`` (optional) fires with each :class:`Response` as it
+    resolves — the server's spool writer and the loadgen's collector hook.
+    """
+
+    def __init__(self, engine: ServeEngine, *,
+                 queue_limit: int = 64,
+                 lens_target_id: int = -1,
+                 on_complete: Optional[Callable[[Response], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.queue_limit = int(queue_limit)
+        self.lens_target_id = int(lens_target_id)
+        self.on_complete = on_complete
+        self._clock = clock
+        self._queue: Deque[Request] = deque()
+        self._sessions: Dict[int, _Session] = {}      # slot -> session
+        self.draining = False
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.quarantined = 0
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._sessions)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def idle(self) -> bool:
+        return not self._sessions and not self._queue
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, req: Request) -> bool:
+        """Admission control: False (rejected) when draining, when the
+        bounded queue is full, or when the request cannot fit the engine's
+        shape envelope.  True = the request WILL be served (queued or
+        admitted on the next ``step``)."""
+        if self.draining or len(self._queue) >= self.queue_limit:
+            self.rejected += 1
+            obs_metrics.counter("serve.rejected").inc()
+            obs.event("serve.reject", request=req.id,
+                      scenario=req.scenario.name,
+                      reason="draining" if self.draining else "queue-full")
+            return False
+        ids = self._encode(req)
+        if not self.engine.capacity_ok(len(ids), req.scenario.max_new_tokens):
+            self.rejected += 1
+            obs_metrics.counter("serve.rejected").inc()
+            obs.event("serve.reject", request=req.id,
+                      scenario=req.scenario.name, reason="prompt-too-long")
+            return False
+        req.submitted_at = self._clock()
+        self._queue.append(req)
+        obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
+        obs.event("serve.request", request=req.id,
+                  scenario=req.scenario.name, prompt_tokens=len(ids))
+        self._fill_slots()
+        return True
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight AND already-queued sessions run to
+        completion (they were accepted — zero dropped responses), new
+        submits are rejected."""
+        if not self.draining:
+            self.draining = True
+            obs.event("serve.drain", in_flight=self.in_flight,
+                      queued=self.queue_depth)
+
+    def _encode(self, req: Request) -> List[int]:
+        rendered = (chat.render_chat([chat.Turn("user", req.prompt)],
+                                     prefill=req.scenario.prefill)
+                    if req.scenario.prefill is not None
+                    else chat.user_prompt(req.prompt))
+        return self.engine.tok.encode(rendered)
+
+    def _basis(self, req: Request) -> Optional[np.ndarray]:
+        if req.scenario.proj_rank <= 0:
+            return None
+        import jax
+
+        from taboo_brittleness_tpu.ops import projection
+
+        key = jax.random.PRNGKey(req.seed & 0x7FFFFFFF)
+        rank = min(req.scenario.proj_rank, self.engine.ec.proj_rank)
+        return np.asarray(projection.random_subspace(
+            key, self.engine.cfg.hidden_size, rank))
+
+    def _fill_slots(self) -> None:
+        if not self._queue:
+            return
+        for slot in self.engine.free_slots():
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            now = self._clock()
+            sc = req.scenario
+            self.engine.admit(
+                slot, self._encode(req),
+                max_new=sc.max_new_tokens,
+                latent_ids=sc.ablate_latents,
+                basis=self._basis(req),
+                lens_target=(self.lens_target_id if sc.lens_readout else -1))
+            self._sessions[slot] = _Session(request=req, slot=slot,
+                                            admitted_at=now)
+            self.admitted += 1
+            queue_wait = now - req.submitted_at
+            obs_metrics.counter("serve.admitted").inc()
+            obs_metrics.histogram("serve.queue_wait").observe(queue_wait)
+            obs.event("serve.admit", request=req.id, slot=slot,
+                      scenario=sc.name, queue_seconds=round(queue_wait, 4))
+        obs_metrics.gauge("serve.in_flight").set(len(self._sessions))
+        obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self) -> List[Response]:
+        """One engine step plus bookkeeping; returns sessions that resolved.
+
+        The ``serve.step`` fault site fires once per in-flight session
+        BEFORE the launch: an armed fault that matches one session's
+        request/scenario poisons only that session (quarantined below) —
+        the launch then proceeds for the surviving batch.
+        """
+        if not self._sessions:
+            self._fill_slots()
+            if not self._sessions:
+                return []
+        responses: List[Response] = []
+        for slot, sess in list(self._sessions.items()):
+            try:
+                resilience.fire("serve.step", request=sess.request.id,
+                                scenario=sess.request.scenario.name)
+            except Exception as exc:  # noqa: BLE001 — quarantine one session
+                responses.append(self._finish(slot, "quarantined", exc=exc))
+        if not self._sessions:
+            self._after_step(responses)
+            return responses
+
+        out = self.engine.step()
+        obs_metrics.counter("serve.steps").inc()
+        for slot, sess in list(self._sessions.items()):
+            sess.steps += 1
+            if bool(out.emitted[slot]):
+                sess.tokens.append(int(out.tok[slot]))
+                if sess.request.scenario.lens_readout:
+                    sess.lens_probs.append(float(out.lens_prob[slot]))
+            if bool(out.finished[slot]):
+                stop_hit = sess.tokens and sess.tokens[-1] in self.engine.ec.stop_ids
+                responses.append(
+                    self._finish(slot, "eos" if stop_hit else "budget"))
+        self._after_step(responses)
+        return responses
+
+    def _after_step(self, responses: List[Response]) -> None:
+        if responses:
+            self._fill_slots()
+        obs_metrics.gauge("serve.in_flight").set(len(self._sessions))
+
+    def _finish(self, slot: int, finish: str,
+                exc: Optional[BaseException] = None) -> Response:
+        sess = self._sessions.pop(slot)
+        self.engine.release(slot)
+        now = self._clock()
+        req = sess.request
+        ok = exc is None
+        resp = Response(
+            id=req.id, scenario=req.scenario.name, ok=ok,
+            text=self.engine.tok.decode(sess.tokens) if sess.tokens else "",
+            tokens=list(sess.tokens), finish=finish, steps=sess.steps,
+            queue_seconds=round(sess.admitted_at - req.submitted_at, 6),
+            latency_seconds=round(now - req.submitted_at, 6),
+            lens_probs=(list(sess.lens_probs)
+                        if req.scenario.lens_readout else None),
+            error=f"{type(exc).__name__}: {exc}"[:300] if exc else None)
+        if ok:
+            self.completed += 1
+            obs_metrics.counter("serve.completed").inc()
+            obs_metrics.histogram(
+                f"serve.latency.{req.scenario.name}").observe(
+                resp.latency_seconds)
+        else:
+            self.quarantined += 1
+            obs_metrics.counter("serve.quarantined").inc()
+        obs.event("serve.complete", request=req.id, slot=slot,
+                  scenario=req.scenario.name, finish=finish,
+                  steps=sess.steps, ok=ok,
+                  latency_seconds=resp.latency_seconds,
+                  **({"error": resp.error} if resp.error else {}))
+        if self.on_complete is not None:
+            self.on_complete(resp)
+        return resp
+
+    # -- loop helper ---------------------------------------------------------
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> List[Response]:
+        """Step until every accepted session resolves (tests, loadgen's
+        closed loop tail).  Bounded so a logic bug cannot spin forever."""
+        done: List[Response] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return done
+            done.extend(self.step())
+        raise RuntimeError(
+            f"scheduler did not go idle within {max_steps} steps "
+            f"(in_flight={self.in_flight}, queued={self.queue_depth})")

@@ -1,0 +1,313 @@
+"""The long-lived ``tbx serve`` process: spool intake, drain, resume.
+
+Transport: a file spool, deliberately.  The repo's process-boundary
+contracts (atomic tmp+rename writes, quarantine-not-crash on torn files,
+incarnation resume under ``tbx supervise``) all speak filesystem, and a
+serving layer that speaks the same language inherits them for free — no new
+dependency, works over an rsync'd directory, and the supervisor's restart
+story applies unchanged.  A socket front end would be a thin adapter over
+exactly this loop.
+
+Layout under ``<output_dir>``::
+
+    requests/<id>.json             a submitted request (atomic write)
+    requests/<id>.json.claimed     ...claimed by the server (rename)
+    responses/<id>.json            the response (atomic write)
+    _progress.json                 serving-mode heartbeat (obs.progress)
+    _events.jsonl                  span/point stream (obs.trace)
+    _serve.json                    exit summary incl. AOT step-program stats
+
+Request schema: ``{"id": str, "prompt": str, "scenario": str,
+"seed": int?, "max_new_tokens": int?}`` — ``scenario`` names an entry of the
+server's scenario table (``scheduler.default_scenarios``).
+
+Lifecycle contracts:
+
+- **Claim-then-respond.**  A request is claimed by RENAME (crash-atomic);
+  the response is written atomically.  On startup the server re-queues any
+  claimed-but-unanswered request — a killed incarnation drops nothing.
+- **Drain.**  A latched SIGTERM/SIGINT (``runtime.supervise``) flips the
+  scheduler to draining: the current decode step finishes, no new
+  admissions, in-flight (and already-accepted queued) sessions run to
+  completion and get their responses, then the process exits 75
+  (``EX_TEMPFAIL``) — the supervisor relaunches and the next incarnation
+  picks up the unclaimed spool.
+- **Heartbeat.**  ``_progress.json`` carries ``workload: "serve"`` plus
+  in-flight/completed/last-step-age so a healthy IDLE server is never
+  classified as wedged (``supervise._wedge_reason``) and a crashed serving
+  child's exit 1 is never mistaken for sweep quarantine pass-through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs.progress import (
+    PROGRESS_FILENAME, ProgressReporter)
+from taboo_brittleness_tpu.obs.trace import EVENTS_FILENAME
+from taboo_brittleness_tpu.runtime import supervise
+from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+from taboo_brittleness_tpu.serve.engine import ServeEngine
+from taboo_brittleness_tpu.serve.scheduler import (
+    Request, Response, Scenario, SlotScheduler)
+
+SERVE_SUMMARY_FILENAME = "_serve.json"
+REQUESTS_DIRNAME = "requests"
+RESPONSES_DIRNAME = "responses"
+CLAIMED_SUFFIX = ".claimed"
+
+
+class RequestSpool:
+    """Filesystem request/response exchange (see module docstring)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.requests_dir = os.path.join(root, REQUESTS_DIRNAME)
+        self.responses_dir = os.path.join(root, RESPONSES_DIRNAME)
+        os.makedirs(self.requests_dir, exist_ok=True)
+        os.makedirs(self.responses_dir, exist_ok=True)
+
+    # -- client side --------------------------------------------------------
+
+    def put(self, payload: Dict[str, Any]) -> str:
+        """Submit one request (loadgen / external client).  Returns the id."""
+        rid = str(payload.get("id") or uuid.uuid4().hex[:12])
+        payload = {**payload, "id": rid}
+        atomic_json_dump(payload,
+                         os.path.join(self.requests_dir, f"{rid}.json"))
+        return rid
+
+    def response_path(self, rid: str) -> str:
+        return os.path.join(self.responses_dir, f"{rid}.json")
+
+    def get_response(self, rid: str) -> Optional[Dict[str, Any]]:
+        path = self.response_path(rid)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- server side --------------------------------------------------------
+
+    def _parse(self, path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def claim(self, limit: int) -> List[Dict[str, Any]]:
+        """Claim up to ``limit`` pending requests (rename = crash-atomic
+        ownership).  A torn/unparseable file is left in place — the writer's
+        atomic rename means it is mid-flight, not corrupt; it parses on a
+        later poll."""
+        if limit <= 0:
+            return []
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            return []
+        out: List[Dict[str, Any]] = []
+        for name in names:
+            if len(out) >= limit:
+                break
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.requests_dir, name)
+            payload = self._parse(path)
+            if payload is None or "prompt" not in payload:
+                continue
+            try:
+                os.replace(path, path + CLAIMED_SUFFIX)
+            except OSError:
+                continue            # raced another pickup / vanished
+            out.append(payload)
+        return out
+
+    def recover(self) -> List[Dict[str, Any]]:
+        """Claimed-but-unanswered requests from a dead predecessor
+        incarnation — re-queued at startup so a kill drops nothing."""
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(CLAIMED_SUFFIX):
+                continue
+            payload = self._parse(os.path.join(self.requests_dir, name))
+            if (payload is not None and "prompt" in payload
+                    and self.get_response(str(payload.get("id"))) is None):
+                out.append(payload)
+        return out
+
+    def respond(self, resp: Response) -> None:
+        atomic_json_dump(resp.to_dict(), self.response_path(resp.id))
+
+    def completed_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.responses_dir)
+                       if n.endswith(".json"))
+        except OSError:
+            return 0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    exit_code: int
+    status: str             # done | drained
+    completed: int
+    steps: int
+
+
+def _to_request(payload: Dict[str, Any],
+                scenarios: Dict[str, Scenario]) -> Optional[Request]:
+    name = str(payload.get("scenario", "chat"))
+    sc = scenarios.get(name)
+    if sc is None:
+        return None
+    max_new = payload.get("max_new_tokens")
+    if max_new is not None:
+        sc = dataclasses.replace(sc, max_new_tokens=int(max_new))
+    return Request(id=str(payload.get("id") or uuid.uuid4().hex[:12]),
+                   prompt=str(payload.get("prompt", "")),
+                   scenario=sc, seed=int(payload.get("seed", 0) or 0))
+
+
+def serve_forever(
+    engine: ServeEngine,
+    scenarios: Dict[str, Scenario],
+    output_dir: str,
+    *,
+    lens_target_id: int = -1,
+    queue_limit: int = 64,
+    max_requests: Optional[int] = None,
+    poll_s: float = 0.05,
+    idle_sleep=time.sleep,
+    clock=time.monotonic,
+) -> ServeResult:
+    """The serve loop: poll spool → admit → step → respond, under the drain
+    contract.  Returns when ``max_requests`` responses exist on disk (exit
+    0) or a drain completes (exit 75); runs forever otherwise.
+
+    ``max_requests`` counts responses ON DISK (including prior
+    incarnations') so a supervised relaunch resumes toward the same goal
+    instead of restarting the count.
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    spool = RequestSpool(output_dir)
+    tracer = obs.activate(os.path.join(output_dir, EVENTS_FILENAME),
+                          run_id=uuid.uuid4().hex[:12]) if obs.enabled() else None
+    run_span = None
+    reporter = None
+    if tracer is not None:
+        from taboo_brittleness_tpu.runtime.resilience import (
+            current_incarnation)
+
+        inc = current_incarnation()
+        run_span = tracer.span(
+            "serve", kind="run", pipeline="serve",
+            slots=engine.ec.slots, scenarios=sorted(scenarios),
+            **({"incarnation": inc} if inc else {}))
+        reporter = ProgressReporter(
+            os.path.join(output_dir, PROGRESS_FILENAME),
+            total_words=0, run_id=tracer.run_id, tracer=tracer).start()
+        reporter.serving_update(in_flight=0,
+                                completed=spool.completed_count())
+
+    sched = SlotScheduler(engine, queue_limit=queue_limit,
+                          lens_target_id=lens_target_id,
+                          on_complete=spool.respond, clock=clock)
+    warm = engine.warm_start()
+    obs.event("serve.warm_start", **{k: v for k, v in warm.items()
+                                     if k in ("source", "trace_seconds",
+                                              "compile_seconds", "error")})
+
+    def _take(payload: Dict[str, Any]) -> None:
+        """Claimed requests ALWAYS get a response: parse+submit, and answer
+        a rejection (unknown scenario, over-capacity prompt/budget) with an
+        explicit rejected response instead of dropping it silently."""
+        req = _to_request(payload, scenarios)
+        if req is None:
+            spool.respond(Response(
+                id=str(payload.get("id")), ok=False,
+                scenario=str(payload.get("scenario")),
+                finish="rejected", error="unknown scenario"))
+            return
+        if not sched.submit(req):
+            spool.respond(Response(
+                id=req.id, ok=False, scenario=req.scenario.name,
+                finish="rejected",
+                error="admission rejected (capacity envelope or draining)"))
+
+    # Resume: a predecessor's claimed-but-unanswered requests come first.
+    for payload in spool.recover():
+        _take(payload)
+
+    status, exit_code = "done", 0
+    try:
+        while True:
+            if supervise.drain_requested() and not sched.draining:
+                sched.drain()
+            if not sched.draining:
+                for payload in spool.claim(queue_limit - sched.queue_depth):
+                    _take(payload)
+            stepped = False
+            if sched.in_flight or sched.queue_depth:
+                sched.step()
+                stepped = True
+            completed = spool.completed_count()
+            if reporter is not None:
+                reporter.serving_update(
+                    in_flight=sched.in_flight, completed=completed,
+                    queued=sched.queue_depth, stepped=stepped)
+            if sched.draining and sched.idle:
+                status, exit_code = "drained", supervise.EXIT_DRAINED
+                break
+            if (max_requests is not None and sched.idle
+                    and completed >= max_requests):
+                break
+            if not stepped:
+                idle_sleep(poll_s)
+    finally:
+        summary = {
+            "status": status,
+            "completed_responses": spool.completed_count(),
+            "engine_steps": engine.steps,
+            "admitted": sched.admitted,
+            "rejected": sched.rejected,
+            "quarantined": sched.quarantined,
+            "aot": _step_program_stats(),
+        }
+        try:
+            atomic_json_dump(summary,
+                             os.path.join(output_dir, SERVE_SUMMARY_FILENAME))
+        except OSError:
+            pass
+        if reporter is not None:
+            reporter.serving_update(in_flight=sched.in_flight,
+                                    completed=spool.completed_count())
+            reporter.stop(status="preempted" if status == "drained"
+                          else "done")
+        if run_span is not None:
+            if status == "drained":
+                run_span.set(drained=True)
+            run_span.end()
+        if tracer is not None:
+            obs.deactivate(tracer)
+    return ServeResult(exit_code=exit_code, status=status,
+                       completed=spool.completed_count(),
+                       steps=engine.steps)
+
+
+def _step_program_stats() -> Dict[str, Any]:
+    from taboo_brittleness_tpu.runtime import aot
+
+    return dict(aot.stats().get("serve.step", {}))

@@ -1,0 +1,454 @@
+"""Serving subsystem (taboo_brittleness_tpu/serve/, ISSUE 6).
+
+Layers:
+
+- engine: parity of the slot-stepped decode against the batched
+  ``greedy_decode`` program, per-slot in-graph intervention switches, and
+  the one-compiled-program contract (AOT registry: zero misses after
+  warm-up);
+- scheduler state machine: bounded-queue admission (rejection when full),
+  slot recycle after EOS, mid-batch scenario switching, drain with
+  in-flight sessions (zero dropped responses), and the ``serve.step``
+  fault site (one poisoned session quarantines; the batch lives);
+- serving-mode progress heartbeat + the supervisor's serve-aware wedge
+  classifier (a healthy idle server is never wedged) and workload-
+  conditional exit-1 handling (fake children, no jax in the child);
+- the spool protocol (claim/recover/respond) and the loadgen selfcheck.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.obs.progress import ProgressReporter, read_progress
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.runtime import aot, chat, decode, resilience, supervise
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector, RetryPolicy
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer, target_token_id
+from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+from taboo_brittleness_tpu.serve.scheduler import (
+    Request, Scenario, SlotScheduler, default_scenarios)
+
+WORDS = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+         "Give", "me", "a", "the", "about"]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    tok = WordTokenizer(WORDS, vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(8), cfg.hidden_size, 64)
+    return params, cfg, tok, sae
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+
+
+def make_engine(tiny, *, slots=3, stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+                with_sae=True, max_context=48, prompt_cols=24):
+    params, cfg, tok, sae = tiny
+    tap = 2
+    return ServeEngine(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=slots, max_context=max_context, prompt_cols=prompt_cols,
+            latent_slots=4, proj_rank=2,
+            sae_layer=tap, proj_layer=tap, tap_layer=tap,
+            stop_ids=stop_ids),
+        sae=sae if with_sae else None)
+
+
+def run_slot(engine, slot, prompt_ids, *, max_new, **admit_kw):
+    """Drive ONE admitted slot to completion; returns its emitted tokens."""
+    engine.admit(slot, prompt_ids, max_new=max_new, **admit_kw)
+    toks = []
+    for _ in range(200):
+        out = engine.step()
+        if bool(out.emitted[slot]):
+            toks.append(int(out.tok[slot]))
+        if bool(out.finished[slot]):
+            engine.release(slot)
+            return toks
+    raise AssertionError("slot never finished")
+
+
+# ---------------------------------------------------------------------------
+# Engine.
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_greedy_decode(tiny):
+    """The slot-stepped serve decode (token-by-token prefill, per-row KV
+    columns) reproduces the batched one-program greedy_decode exactly —
+    same model, same greedy argmax, different program structure."""
+    params, cfg, tok, _ = tiny
+    prompt = "Give me a hint about the word"
+    result, texts, ids = decode.generate(
+        params, cfg, tok, [prompt], max_new_tokens=8)
+    want = list(np.asarray(result.tokens)[0][:int(np.asarray(result.lengths)[0])])
+
+    engine = make_engine(tiny)
+    got = run_slot(engine, 0, ids[0], max_new=8)
+    assert got == [int(t) for t in want]
+
+
+def test_engine_forcing_prefill_matches_greedy_decode(tiny):
+    """Token-forcing scenario: the opened model turn (prefill text) rides
+    the same unified step; parity against generate(prefills=...)."""
+    params, cfg, tok, _ = tiny
+    prompt, prefill = "Give me a hint", "My secret word is"
+    result, _, ids = decode.generate(
+        params, cfg, tok, [prompt], prefills=[prefill], max_new_tokens=6)
+    want = [int(t) for t in
+            np.asarray(result.tokens)[0][:int(np.asarray(result.lengths)[0])]]
+
+    engine = make_engine(tiny)
+    got = run_slot(engine, 1, ids[0], max_new=6)
+    assert got == want
+
+
+def test_per_slot_intervention_switch(tiny):
+    """Three concurrent sessions over the SAME prompt: two plain, one
+    SAE-ablated — all through one program.  The plain slots agree exactly;
+    the ablated slot's readout (and typically its tokens) diverge — the
+    per-slot switch is real and slot-local."""
+    params, cfg, tok, sae = tiny
+    ids = tok.encode(chat.user_prompt("Give me a hint"))
+    tgt = target_token_id(tok, "ship")
+    # stop_ids=(-1,): fixed-length sessions so every slot emits max_new
+    # tokens and the comparison is column-by-column.
+    engine = make_engine(tiny, stop_ids=(-1,))
+    n_new = 6
+    engine.admit(0, ids, max_new=n_new, lens_target=tgt)
+    engine.admit(1, ids, max_new=n_new, latent_ids=(0, 1, 2, 3),
+                 lens_target=tgt)
+    engine.admit(2, ids, max_new=n_new, lens_target=tgt)
+
+    toks = {0: [], 1: [], 2: []}
+    lens = {0: [], 1: [], 2: []}
+    for _ in range(len(ids) + n_new + 2):
+        out = engine.step()
+        for s in toks:
+            if bool(out.emitted[s]):
+                toks[s].append(int(out.tok[s]))
+                lens[s].append(float(out.lens_prob[s]))
+        if all(bool(d) for d in np.asarray(engine.state.done)[:3]):
+            break
+    assert len(toks[0]) == len(toks[2]) == n_new
+    assert toks[0] == toks[2]                      # plain slots identical
+    assert lens[0] == pytest.approx(lens[2])
+    # The ablation changed the residual at the tap layer, so the lens
+    # readout over the SAME prompt must differ (the tokens usually do too,
+    # but a tiny random model can tie on argmax — the readout cannot).
+    assert lens[1] != pytest.approx(lens[0])
+
+
+def test_engine_zero_aot_misses_after_warm_start(tiny):
+    aot.reset()
+    engine = make_engine(tiny)
+    rec = engine.warm_start()
+    assert rec["source"] in ("compiled", "memory", "disk")
+    ids = engine.tok.encode(chat.user_prompt("Give me a hint"))
+    run_slot(engine, 0, ids, max_new=4)
+    run_slot(engine, 2, ids, max_new=4)            # recycle another slot
+    st = aot.stats()["serve.step"]
+    assert st["misses"] == 0 and st["fallbacks"] == 0
+    assert st["hits"] >= 2
+
+
+def test_engine_capacity_envelope(tiny):
+    engine = make_engine(tiny, max_context=16, prompt_cols=8)
+    assert engine.capacity_ok(8, 8)
+    assert not engine.capacity_ok(9, 4)            # prompt too long
+    assert not engine.capacity_ok(8, 9)            # context overflow
+    with pytest.raises(ValueError):
+        engine.admit(0, list(range(1, 10)), max_new=4)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler state machine.
+# ---------------------------------------------------------------------------
+
+def _req(i, scenario, prompt="Give me a hint", seed=None):
+    return Request(id=f"r{i:03d}", prompt=prompt, scenario=scenario,
+                   seed=i if seed is None else seed)
+
+
+def test_scheduler_admission_rejects_when_queue_full(tiny):
+    engine = make_engine(tiny, slots=1, stop_ids=(-1,))
+    sc = Scenario(name="chat", max_new_tokens=4)
+    sched = SlotScheduler(engine, queue_limit=2)
+    accepted = [sched.submit(_req(i, sc)) for i in range(6)]
+    # 1 admitted straight into the slot; 2 queued; the rest rejected.
+    assert accepted == [True, True, True, False, False, False]
+    assert sched.rejected == 3
+    resp = sched.run_until_idle()
+    assert len(resp) == 3 and all(r.ok for r in resp)
+    assert sched.completed == 3
+
+
+def test_scheduler_recycles_slots_after_eos(tiny):
+    """More sessions than slots: completion (EOS on the tiny model) frees
+    the slot and the queue refills it — every accepted request resolves."""
+    engine = make_engine(tiny, slots=2)
+    sc = Scenario(name="chat", max_new_tokens=8)
+    sched = SlotScheduler(engine, queue_limit=16)
+    for i in range(7):
+        assert sched.submit(_req(i, sc))
+    resps = sched.run_until_idle()
+    assert sorted(r.id for r in resps) == [f"r{i:03d}" for i in range(7)]
+    assert all(r.ok for r in resps)
+    assert sched.admitted == 7 and sched.completed == 7
+    assert engine.free_slots() == [0, 1]           # all returned to the pool
+
+
+def test_scheduler_switches_scenarios_mid_batch(tiny):
+    """Slots re-admit with DIFFERENT scenarios while other sessions are in
+    flight; the per-slot config switches with the slot, not the program."""
+    engine = make_engine(tiny, slots=2, stop_ids=(-1,))
+    tgt = target_token_id(engine.tok, "ship")
+    scs = default_scenarios(max_new_tokens=4)
+    sched = SlotScheduler(engine, queue_limit=16, lens_target_id=tgt)
+    order = ["chat", "sae_ablate", "forcing", "chat_lens", "projection",
+             "chat"]
+    for i, name in enumerate(order):
+        assert sched.submit(_req(i, scs[name]))
+    resps = {r.id: r for r in sched.run_until_idle()}
+    assert len(resps) == 6 and all(r.ok for r in resps.values())
+    # Readout rode exactly the lens-enabled scenarios.
+    assert resps["r001"].lens_probs and resps["r003"].lens_probs
+    assert resps["r000"].lens_probs is None
+    # Forcing prefill extends the prompt, not the generation.
+    assert resps["r002"].steps > resps["r000"].steps
+
+
+def test_scheduler_drain_with_in_flight_drops_nothing(tiny):
+    """The SIGTERM contract at scheduler level: after drain(), new submits
+    are rejected but every in-flight AND queued session completes."""
+    engine = make_engine(tiny, slots=2, stop_ids=(-1,))
+    sc = Scenario(name="chat", max_new_tokens=6)
+    sched = SlotScheduler(engine, queue_limit=8)
+    for i in range(5):
+        assert sched.submit(_req(i, sc))
+    sched.step()                                   # sessions genuinely in flight
+    assert sched.in_flight == 2 and sched.queue_depth == 3
+    sched.drain()
+    assert not sched.submit(_req(99, sc))          # admission closed
+    resps = sched.run_until_idle()
+    assert sched.completed == 5                    # zero dropped
+    assert sorted(r.id for r in resps) == [f"r{i:03d}" for i in range(5)]
+
+
+def test_scheduler_quarantines_poisoned_session_not_batch(tiny):
+    """A seeded serve.step fault matching ONE request id kills that session
+    only: it resolves as quarantined, every other session completes."""
+    inj = FaultInjector()
+    inj.arm("serve.step", mode="fail", kind="permanent", times=1,
+            match="poison")
+    resilience.set_injector(inj)
+    engine = make_engine(tiny, slots=3, stop_ids=(-1,))
+    sc = Scenario(name="chat", max_new_tokens=5)
+    sched = SlotScheduler(engine, queue_limit=8)
+    assert sched.submit(Request(id="ok-1", prompt="Give me a hint", scenario=sc))
+    assert sched.submit(Request(id="poison-1", prompt="Give me a hint", scenario=sc))
+    assert sched.submit(Request(id="ok-2", prompt="Give me a hint", scenario=sc))
+    resps = {r.id: r for r in sched.run_until_idle()}
+    assert not resps["poison-1"].ok
+    assert resps["poison-1"].finish == "quarantined"
+    assert "InjectedPermanentFault" in resps["poison-1"].error
+    assert resps["ok-1"].ok and resps["ok-2"].ok
+    assert resps["ok-1"].steps == resps["ok-2"].steps > 0
+    assert sched.quarantined == 1 and sched.completed == 2
+
+
+def test_scheduler_fault_plan_via_env(tiny, monkeypatch):
+    """The operator path: TABOO_FAULT_PLAN arms the serve.step site."""
+    monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps(
+        {"serve.step": {"mode": "fail", "kind": "permanent",
+                        "times": 1, "match": "victim"}}))
+    resilience.set_injector(None)                  # rebuild from env
+    engine = make_engine(tiny, slots=2)
+    sc = Scenario(name="chat", max_new_tokens=4)
+    sched = SlotScheduler(engine, queue_limit=4)
+    sched.submit(Request(id="victim", prompt="Give me a hint", scenario=sc))
+    sched.submit(Request(id="bystander", prompt="Give me a hint", scenario=sc))
+    resps = {r.id: r for r in sched.run_until_idle()}
+    assert not resps["victim"].ok and resps["bystander"].ok
+
+
+# ---------------------------------------------------------------------------
+# Serving-mode progress + the supervisor's serve-aware classification.
+# ---------------------------------------------------------------------------
+
+def test_progress_serving_snapshot_fields(tmp_path):
+    t = {"now": 100.0}
+    rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
+                           interval=3600, clock=lambda: t["now"])
+    rep.serving_update(in_flight=2, completed=5, queued=1, stepped=True)
+    t["now"] = 104.5
+    snap = rep.snapshot()
+    assert snap["workload"] == "serve"
+    assert snap["serving"]["in_flight"] == 2
+    assert snap["serving"]["completed_requests"] == 5
+    assert snap["serving"]["queued"] == 1
+    assert snap["serving"]["last_step_age_seconds"] == pytest.approx(4.5)
+    rep.write_now()
+    on_disk = read_progress(rep.path)
+    assert on_disk["workload"] == "serve"
+    assert on_disk["serving"]["in_flight"] == 2
+
+
+def _serve_progress(*, in_flight, last_step_age, pid=1234, stale=False):
+    return {"status": "running", "pid": pid, "stale": stale,
+            "workload": "serve", "age_seconds": 0.0,
+            "serving": {"in_flight": in_flight,
+                        "completed_requests": 3,
+                        "last_step_age_seconds": last_step_age}}
+
+
+def test_idle_server_is_never_wedged():
+    """ISSUE 6 satellite: a healthy IDLE server (no sessions, no events for
+    ages) must not be classified as pipeline-wedged by the supervisor."""
+    p = _serve_progress(in_flight=0, last_step_age=9999.0)
+    p["last_event_age_seconds"] = 9999.0           # would wedge a sweep
+    assert supervise._wedge_reason(p, pid=1234, wedge_after=1.0) is None
+
+
+def test_busy_server_with_stalled_steps_is_wedged():
+    p = _serve_progress(in_flight=2, last_step_age=50.0)
+    assert supervise._wedge_reason(p, pid=1234, wedge_after=1.0) == \
+        "pipeline-wedged"
+    fresh = _serve_progress(in_flight=2, last_step_age=0.01)
+    assert supervise._wedge_reason(fresh, pid=1234, wedge_after=1.0) is None
+
+
+def test_stale_heartbeat_still_wedges_a_server():
+    p = _serve_progress(in_flight=0, last_step_age=0.0, stale=True)
+    assert supervise._wedge_reason(p, pid=1234, wedge_after=1.0) == \
+        "heartbeat-stale"
+
+
+_WORKLOAD_CHILD = r"""
+import json, os, sys, time
+
+out, workload = sys.argv[1], sys.argv[2]
+inc = os.environ.get("TBX_INCARNATION", "0")
+payload = {"v": 1, "pid": os.getpid(), "updated_at": time.time(),
+           "heartbeat_seconds": 0.05, "status": "running",
+           "incarnation": int(inc)}
+if workload == "serve":
+    payload["workload"] = "serve"
+    payload["serving"] = {"in_flight": 0, "completed_requests": 0,
+                          "last_step_age_seconds": 0.0}
+tmp = os.path.join(out, "_progress.json.tmp")
+with open(tmp, "w") as f:
+    json.dump(payload, f)
+os.replace(tmp, os.path.join(out, "_progress.json"))
+sys.exit(1 if inc == "0" or workload != "serve" else 0)
+"""
+
+
+def _run_workload_child(tmp_path, workload):
+    out = str(tmp_path / "out")
+    os.makedirs(out, exist_ok=True)
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_WORKLOAD_CHILD)
+    return supervise.supervise(
+        [sys.executable, child, out, workload], out,
+        max_incarnations=3, poll_interval=0.02, grace=0.5, wedge_after=5.0,
+        policy=RetryPolicy(max_retries=8, base_delay=0.0))
+
+
+def test_supervise_serve_exit1_burns_incarnation(tmp_path):
+    """ISSUE 6 satellite: a serving child's exit 1 is a crash loop, not
+    'quarantine = completed' — the supervisor restarts it (and the second
+    incarnation, exiting 0, completes the run)."""
+    res = _run_workload_child(tmp_path, "serve")
+    assert [r["outcome"] for r in res.incarnations] == ["crashed", "done"]
+    assert res.incarnations[0]["reason"] == "serve-exit-1"
+    assert res.exit_code == 0 and res.status == "done"
+
+
+def test_supervise_sweep_exit1_still_passes_through(tmp_path):
+    """The pre-existing sweep contract is untouched: exit 1 without a serve
+    workload declaration passes through as quarantined-completed."""
+    res = _run_workload_child(tmp_path, "sweep")
+    assert [r["outcome"] for r in res.incarnations] == ["quarantined"]
+    assert res.exit_code == 1 and res.status == "quarantined"
+
+
+# ---------------------------------------------------------------------------
+# Spool protocol + loadgen.
+# ---------------------------------------------------------------------------
+
+def test_spool_claim_recover_respond_roundtrip(tmp_path):
+    from taboo_brittleness_tpu.serve.scheduler import Response
+    from taboo_brittleness_tpu.serve.server import RequestSpool
+
+    spool = RequestSpool(str(tmp_path))
+    a = spool.put({"prompt": "hi", "scenario": "chat"})
+    b = spool.put({"prompt": "yo", "scenario": "chat"})
+    claimed = spool.claim(limit=10)
+    assert sorted(p["id"] for p in claimed) == sorted([a, b])
+    assert spool.claim(limit=10) == []             # claim is exclusive
+    # Crash before responding: recover() re-surfaces both...
+    assert sorted(p["id"] for p in spool.recover()) == sorted([a, b])
+    # ...but an answered request stays recovered-free.
+    spool.respond(Response(id=a, scenario="chat", ok=True, text="x"))
+    assert [p["id"] for p in spool.recover()] == [b]
+    assert spool.get_response(a)["ok"] is True
+    assert spool.get_response(b) is None
+    assert spool.completed_count() == 1
+
+
+def test_spool_claim_respects_limit_and_torn_files(tmp_path):
+    from taboo_brittleness_tpu.serve.server import RequestSpool
+
+    spool = RequestSpool(str(tmp_path))
+    for _ in range(3):
+        spool.put({"prompt": "hi", "scenario": "chat"})
+    with open(os.path.join(spool.requests_dir, "torn.json"), "w") as f:
+        f.write('{"prompt": "tr')                  # mid-flight writer
+    assert len(spool.claim(limit=2)) == 2
+    assert len(spool.claim(limit=10)) == 1         # torn file skipped
+    assert os.path.exists(os.path.join(spool.requests_dir, "torn.json"))
+
+
+def test_loadgen_selfcheck(tiny):
+    from taboo_brittleness_tpu.serve import loadgen
+
+    report = loadgen.selfcheck(n_requests=16, seed=3)
+    assert report["stage"] == "serve_latency"
+    assert report["goodput"]["completed"] == 16
+    for block in report["scenarios"].values():
+        for key in loadgen.LATENCY_KEYS:
+            assert key in block
+
+
+def test_loadgen_schedule_is_seeded_deterministic():
+    from taboo_brittleness_tpu.serve import loadgen
+
+    scs = default_scenarios()
+    mix = {name: 1.0 for name in scs}
+    a = loadgen.build_schedule(12, seed=5, rate=10.0, mix=mix,
+                               scenarios=scs, prompts=("p",))
+    b = loadgen.build_schedule(12, seed=5, rate=10.0, mix=mix,
+                               scenarios=scs, prompts=("p",))
+    assert [(t, r.id, r.scenario.name) for t, r in a] == \
+           [(t, r.id, r.scenario.name) for t, r in b]
+    c = loadgen.build_schedule(12, seed=6, rate=10.0, mix=mix,
+                               scenarios=scs, prompts=("p",))
+    assert [(t, r.id) for t, r in a] != [(t, r.id) for t, r in c]

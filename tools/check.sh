@@ -22,6 +22,9 @@ python tools/report_bench_row.py --check reports/exec_summary/executive_summary.
 echo "== trace_report schema gate (committed obs fixture)"
 python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
 
+echo "== serve loadgen selfcheck (CPU smoke: tiny model, 32 requests)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
+
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \

@@ -140,6 +140,58 @@ def load_roofline(path: Optional[str]) -> Optional[Dict[str, Any]]:
         return None
 
 
+def _serving_section(serve_runs: List[Span],
+                     points: List[Dict[str, Any]]) -> str:
+    """Request-lifecycle summary for ``tbx serve`` runs: the point events
+    ``serve.request`` → ``serve.admit`` → (decode steps) → ``serve.complete``
+    pooled across incarnations, with per-scenario latency/steps and the
+    reject/quarantine tallies (the sweep's word grid has no meaning here)."""
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for p in points:
+        name = str(p.get("name", ""))
+        if name.startswith("serve."):
+            by_name.setdefault(name, []).append(p)
+    completes = by_name.get("serve.complete", [])
+    per_scenario: Dict[str, Dict[str, List[float]]] = {}
+    quarantined = 0
+    for p in completes:
+        attrs = p.get("attrs") or {}
+        sc = str(attrs.get("scenario", "?"))
+        cell = per_scenario.setdefault(sc, {"lat": [], "steps": []})
+        if attrs.get("ok") is False:
+            quarantined += 1
+        try:
+            cell["lat"].append(float(attrs.get("latency_seconds", 0.0)))
+            cell["steps"].append(float(attrs.get("steps", 0)))
+        except (TypeError, ValueError):
+            continue
+    lines = ["serving:"]
+    lines.append(
+        f"  requests: {len(by_name.get('serve.request', []))} submitted, "
+        f"{len(by_name.get('serve.admit', []))} admitted, "
+        f"{len(completes)} completed "
+        f"({quarantined} quarantined), "
+        f"{len(by_name.get('serve.reject', []))} rejected")
+    if per_scenario:
+        header = ["scenario", "n", "mean_s", "max_s", "mean_steps"]
+        body = []
+        for sc, cell in sorted(per_scenario.items()):
+            n = len(cell["lat"])
+            mean = sum(cell["lat"]) / n if n else 0.0
+            mx = max(cell["lat"]) if n else 0.0
+            msteps = sum(cell["steps"]) / n if n else 0.0
+            body.append([f"  {sc}", str(n), _fmt_s(mean), _fmt_s(mx),
+                         f"{msteps:.1f}"])
+        lines.append(_table(header, body))
+    for p in by_name.get("serve.drain", []):
+        attrs = p.get("attrs") or {}
+        lines.append(f"  drain at t={_fmt_s(float(p.get('t', 0)))}s  "
+                     f"(in_flight={attrs.get('in_flight')}, "
+                     f"queued={attrs.get('queued')})")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def report(events: List[Dict[str, Any]], *,
            roofline: Optional[Dict[str, Any]] = None) -> str:
     spans, points = build_spans(events)
@@ -176,11 +228,23 @@ def report(events: List[Dict[str, Any]], *,
             out.append(f"  {p.get('name')}  {brief}")
         out.append("")
 
+    serve_runs = [r for r in runs if r.attrs.get("pipeline") == "serve"]
+    if serve_runs:
+        out.append(_serving_section(serve_runs, points))
+
     for run in runs:
         pipeline = run.attrs.get("pipeline", run.name)
         inc = run.attrs.get("incarnation")
         inc_label = f", incarnation {inc}" if inc is not None else ""
         drained = ", DRAINED" if run.attrs.get("drained") else ""
+        if pipeline == "serve":
+            # Serving runs have no word grid; the request lifecycle summary
+            # above covers them — keep just the one-line run header.
+            out.append(f"run: serve  (duration {_fmt_s(run.dur)}s, "
+                       f"{run.attrs.get('slots', '?')} slots"
+                       f"{inc_label}{drained})")
+            out.append("")
+            continue
         out.append(f"run: {pipeline}  "
                    f"(duration {_fmt_s(run.dur)}s, "
                    f"{run.attrs.get('words_total', '?')} words planned"
