@@ -799,6 +799,111 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
     }
 
 
+def _device_profile_bench(params, cfg, sae, tap_layer: int, prompt_len: int,
+                          new_tokens: int, on_accel: bool) -> dict:
+    """``device_profile`` stage (ISSUE 7): one captured, annotated pass of
+    the sweep's three compiled programs under the XLA profiler
+    (obs/profile.py), so each round commits MEASURED per-phase device-busy
+    seconds, the device-idle (dispatch-gap) share, and the op-class split —
+    the device-clock ground truth the host-wall phase_seconds approximate.
+    Gated like ``readout_ab`` (BENCH_DEVICE_PROFILE; on by default on an
+    accelerator) because a capture costs a profiler session + trace parse.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.obs import profile as obs_profile
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode
+
+    rows = int(os.environ.get("BENCH_DEVICE_PROFILE_ROWS",
+                              "110" if on_accel else "4"))
+    resp_start = prompt_len - 1
+
+    def make_inputs(seed: int):
+        rng = np.random.default_rng(seed)
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+                   for _ in range(rows)]
+        padded, valid, positions = decode.pad_prompts(prompts)
+        args = (jnp.asarray(padded), jnp.asarray(valid),
+                jnp.asarray(positions))
+        ep = {"sae": sae,
+              "latent_ids": jnp.asarray(
+                  rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)),
+                  jnp.int32),
+              "layer": tap_layer}
+        return args, ep
+
+    def run_trio(args, ep, annotate: bool):
+        def ann(program, fn, span_id):
+            return (obs_profile.annotate(program, fn=fn, span_id=span_id)
+                    if annotate else obs_profile._NULL_CTX)
+
+        with ann("decode", decode.greedy_decode, 1):
+            dec = decode.greedy_decode(
+                params, cfg, *args, max_new_tokens=new_tokens,
+                edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
+                capture_residual_layer=tap_layer, return_prefill_cache=True)
+            jax.block_until_ready((dec.tokens, dec.residual))
+        resp = jnp.zeros_like(dec.sequence_valid).at[:, prompt_len:].set(True)
+        with ann("readout", iv._residual_measure, 2):
+            out = iv._residual_measure(
+                params, cfg, dec.residual, dec.sequences, resp,
+                jnp.zeros((rows,), jnp.int32), top_k=5,
+                resp_start=resp_start,
+                chunk=iv._readout_chunk_override(),
+                variant=iv._readout_variant())
+            jax.block_until_ready(out["agg_ids"])
+        pos2 = jnp.maximum(
+            jnp.cumsum(dec.sequence_valid, axis=1) - 1, 0).astype(jnp.int32)
+        next_mask = jnp.zeros_like(
+            dec.sequence_valid).at[:, prompt_len - 1:-1].set(True)
+        with ann("nll", iv._nll_cached_jit, 3):
+            nll = iv._nll_cached_jit(
+                params, cfg, *dec.prefill_cache,
+                dec.sequences, dec.sequence_valid, pos2, next_mask,
+                edit_fn=iv.sae_ablation_edit,
+                edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
+                resp_start=resp_start)
+            jax.block_until_ready(nll)
+
+    run_trio(*make_inputs(70_000), annotate=False)    # compile, uncaptured
+    trace_dir = tempfile.mkdtemp(prefix="tbx_bench_prof_")
+    try:
+        capture = obs_profile.DeviceCapture(trace_dir)
+        if not capture.start():
+            return {"error": "profiler capture could not start"}
+        run_trio(*make_inputs(71_000), annotate=True)  # fresh inputs: dedup-proof
+        profile = capture.stop()
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    if profile is None:
+        return {"error": "no trace parsed from the capture"}
+    dev = profile["device"]
+    busy_share = (dev["busy_union_seconds"] / dev["capture_seconds"]
+                  if dev["capture_seconds"] else 0.0)
+    return {
+        "rows": rows,
+        "phase_device_seconds": {
+            name: ph["device_seconds"]
+            for name, ph in profile["phases"].items()},
+        "device": dev,
+        "busy_share": round(busy_share, 4),
+        "top_ops": profile["top_ops"][:10],
+        "op_classes": profile["op_classes"],
+        "unattributed": profile["unattributed"],
+        "programs": profile["programs"],
+        "note": "one annotated decode+readout+nll pass under the XLA "
+                "profiler (obs/profile.py); device seconds are measured op "
+                "slices, idle_share is the measured dispatch gap — compare "
+                "against phase_seconds_per_launch (host wall) and the "
+                "phase_roofline ceilings",
+    }
+
+
 def _serve_bench(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
     """``serve_latency`` stage: the serving subsystem's closed-loop SLO bench
     (ISSUE 6) — per-scenario p50/p99 and goodput become tracked numbers like
@@ -962,6 +1067,12 @@ def main() -> int:
     if os.environ.get("BENCH_SERVE", "1") == "1":
         serve_stage = _serve_bench(params, cfg, sae, tap_layer, on_accel)
 
+    device_profile = None
+    if os.environ.get("BENCH_DEVICE_PROFILE",
+                      "1" if on_accel else "0") == "1":
+        device_profile = _device_profile_bench(
+            params, cfg, sae, tap_layer, prompt_len, new_tokens, on_accel)
+
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "results", "bench_detail.json")
     headline = {
@@ -1002,6 +1113,14 @@ def main() -> int:
         # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;
         # the contract is <2% wall overhead (detail block "obs_overhead").
         "obs_overhead_pct": (obs_ab and obs_ab.get("overhead_pct")),
+        # Device-timeline profile (obs/profile.py): MEASURED per-phase
+        # device-busy seconds + the device-idle share of one annotated
+        # captured pass; full artifact in the detail block "device_profile".
+        "device_profile": (
+            {"busy_share": device_profile["busy_share"],
+             "idle_share": device_profile["device"]["idle_share"],
+             "phase_device_seconds": device_profile["phase_device_seconds"]}
+            if device_profile and "error" not in device_profile else None),
         # Serving SLO (serve subsystem): closed-loop loadgen over the
         # resident engine — pooled p50/p99 + goodput; per-scenario table in
         # the detail block "serve_latency".
@@ -1031,7 +1150,8 @@ def main() -> int:
         os.makedirs(os.path.dirname(detail_path), exist_ok=True)
         _atomic_json_dump(
             {"headline": headline, "sweep": sweep, "study": study,
-             "obs_overhead": obs_ab, "serve_latency": serve_stage},
+             "obs_overhead": obs_ab, "serve_latency": serve_stage,
+             "device_profile": device_profile},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract
         print(f"bench_detail.json write failed (headline unaffected): {e}",

@@ -228,6 +228,14 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
 ]
 
 
+def entry_point_names() -> frozenset:
+    """Bare function names of the registered jit entry points — the call-site
+    vocabulary rule TBX010 (analysis/rules.py) holds to the
+    TraceAnnotation/named_scope contract of obs/profile.py.  Derived from
+    the registry so a new entry point is covered the day it is registered."""
+    return frozenset(name.rsplit(".", 1)[1] for name, _ in ENTRY_POINTS)
+
+
 # ---------------------------------------------------------------------------
 # Jaxpr walk.
 # ---------------------------------------------------------------------------

@@ -560,6 +560,96 @@ class BarePrintRule:
                 "`# tbx: TBX009-ok — <reason>` pragma")
 
 
+# ---------------------------------------------------------------------------
+# TBX010 — registered jit entry point dispatched without a TraceAnnotation /
+# named_scope wrapper.
+# ---------------------------------------------------------------------------
+
+#: Context-manager names that count as an annotation wrapper: the repo's own
+#: helper (obs.profile.annotate) and the raw jax primitives it wraps.
+_ANNOTATION_CM_SUFFIXES = (".annotate", ".TraceAnnotation", ".named_scope")
+_ANNOTATION_CM_NAMES = {"annotate", "TraceAnnotation", "named_scope"}
+
+
+class UnannotatedEntryCallRule:
+    """A registered jit entry point (analysis/deep.py ``ENTRY_POINTS``)
+    called directly in package code with no enclosing
+    ``obs.profile.annotate`` / ``jax.profiler.TraceAnnotation`` /
+    ``jax.named_scope`` wrapper: its device slices are unattributable on the
+    profiler timeline (obs/profile.py), so ``trace_report --device`` reports
+    its time as an anonymous gap — precisely the blindness the device
+    profiler exists to remove.  The AOT-registry path (``aot.dispatch``)
+    passes the function as a VALUE, not a call, and its call sites carry
+    their own annotations; this rule covers the direct-dispatch escape
+    hatches.  Calls inside traced code are not dispatch sites and are
+    skipped; ``tools/``, ``tests/``, and the ``analysis/`` subpackage (whose
+    deep registry must call entries by construction) are out of scope."""
+
+    code = "TBX010"
+    alias = "annotate"
+    summary = "registered jit entry point dispatched outside a TraceAnnotation"
+
+    def _in_scope(self, rel: str) -> bool:
+        rel = rel.replace(os.sep, "/")
+        if _PRINT_EXEMPT_MARKER in rel:
+            return False
+        return _PKG_MARKER in rel or rel.startswith("taboo_brittleness_tpu")
+
+    def _entry_names(self) -> frozenset:
+        from taboo_brittleness_tpu.analysis.deep import entry_point_names
+
+        return entry_point_names()
+
+    def _annotated_spans(self, ctx: ModuleContext) -> List[tuple]:
+        """(lineno, end_lineno) of every ``with`` statement whose items
+        include an annotation context manager."""
+        spans = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if not isinstance(expr, ast.Call):
+                    continue
+                name = ctx.dotted(expr.func) or ""
+                short = name.rsplit(".", 1)[-1]
+                if (name.endswith(_ANNOTATION_CM_SUFFIXES)
+                        or short in _ANNOTATION_CM_NAMES):
+                    spans.append((node.lineno,
+                                  getattr(node, "end_lineno", node.lineno)))
+                    break
+        return spans
+
+    def check(self, ctx: ModuleContext, repo: RepoContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx.rel):
+            return
+        entries = self._entry_names()
+        spans = self._annotated_spans(ctx)
+
+        def annotated(lineno: int) -> bool:
+            return any(a <= lineno <= b for a, b in spans)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name not in entries:
+                continue
+            if ctx.enclosing_traced(node) is not None:
+                continue            # a call under trace is not a dispatch site
+            if annotated(node.lineno):
+                continue
+            yield ctx.finding(
+                node, self.code, self.alias,
+                f"registered jit entry point `{name}` dispatched without a "
+                "TraceAnnotation/named_scope wrapper — wrap the call in "
+                "`with obs.profile.annotate(<program>, fn=...)` so the "
+                "device profiler can attribute its XLA slices (or pragma "
+                "with the reason it must stay unannotated)")
+
+
 RULES = [
     HostSyncRule(),
     VocabF32Rule(),
@@ -570,6 +660,7 @@ RULES = [
     WallClockRule(),
     CapturedConstantRule(),
     BarePrintRule(),
+    UnannotatedEntryCallRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -59,6 +59,12 @@ def _common(p: argparse.ArgumentParser) -> None:
                    help="directory of local HF snapshots (or set TABOO_CHECKPOINT_ROOT)")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace into this directory")
+    p.add_argument("--profile", action="store_true",
+                   help="device-timeline profiling (sets TBX_PROFILE=1): "
+                        "capture the first TBX_PROFILE_WORDS (default 2) "
+                        "computed words under the XLA profiler and write "
+                        "<output>/_device_profile.json — render with "
+                        "tools/trace_report.py --device")
     p.add_argument("--no-manifest", action="store_true",
                    help="skip writing run_manifest.json")
     p.add_argument("--max-retries", type=int, default=2,
@@ -552,6 +558,36 @@ def cmd_loadgen(args) -> int:
     return 0 if dropped == 0 else 1
 
 
+def cmd_profile(args) -> int:
+    """Profiler front end (``obs.profile``): the single entry point that
+    replaced ``tools/profile_sweep.py`` (device: one annotated launch under
+    an XLA capture, top ops by device time) and
+    ``tools/profile_study_host.py`` (``--study-host``: nested wall-clock
+    stage timers over real study words)."""
+    from taboo_brittleness_tpu.obs import profile as profile_mod
+
+    if args.study_host:
+        report = profile_mod.run_study_host_profile(
+            words=args.words, prompt_len=args.prompt_len,
+            new_tokens=args.new_tokens)
+        for word_report in report["words"]:
+            for line in word_report["lines"]:
+                print(line)  # tbx: TBX009-ok — CLI stdout contract (profiler report)
+            print()  # tbx: TBX009-ok — CLI stdout contract (profiler report)
+        return 0
+    result = profile_mod.run_launch_profile(
+        phase=args.phase, rows=args.rows, prompt_len=args.prompt_len,
+        new_tokens=args.new_tokens, trace_dir=args.trace_dir, top=args.top)
+    for line in result["lines"]:
+        print(line)  # tbx: TBX009-ok — CLI stdout contract (profiler report)
+    if args.out:
+        from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+        atomic_json_dump(result["profile"], args.out)
+        print(f"device profile -> {args.out}")  # tbx: TBX009-ok — CLI stdout contract (artifact path)
+    return 0
+
+
 def cmd_supervise(args) -> int:
     """Run a pipeline subcommand under the preemption-safe supervisor
     (``runtime.supervise``): launch as a child process, restart on crash or
@@ -692,6 +728,38 @@ def build_parser() -> argparse.ArgumentParser:
                          "asserts goodput == admitted + histogram schema")
     lg.set_defaults(fn=cmd_loadgen)
 
+    pf = sub.add_parser(
+        "profile",
+        help="device/host profiler over one synthetic launch or study word",
+        description="Profile the sweep's compiled programs on the current "
+                    "backend (obs/profile.py). Default: capture ONE "
+                    "annotated launch of --phase under the XLA profiler and "
+                    "rank its ops by device time (the old "
+                    "tools/profile_sweep.py flow). --study-host instead "
+                    "runs real study words under nested host stage timers "
+                    "(the old tools/profile_study_host.py flow). For a "
+                    "whole-sweep device profile, run any sweep subcommand "
+                    "with --profile and render _device_profile.json via "
+                    "tools/trace_report.py --device.")
+    pf.add_argument("--study-host", action="store_true",
+                    help="host wall-clock breakdown of real study words "
+                         "instead of a device capture")
+    pf.add_argument("--phase", choices=("decode", "readout", "nll"),
+                    default="decode")
+    pf.add_argument("--rows", type=int, default=None,
+                    help="launch rows (default: 330 on an accelerator — the "
+                         "production 33-arm shape — else 8)")
+    pf.add_argument("--prompt-len", type=int, default=32)
+    pf.add_argument("--new-tokens", type=int, default=50)
+    pf.add_argument("--words", type=int, default=2,
+                    help="--study-host: words to run (first pays compiles)")
+    pf.add_argument("--trace-dir", default=None,
+                    help="keep the raw XLA trace here (default /tmp/tbx_prof)")
+    pf.add_argument("--top", type=int, default=20)
+    pf.add_argument("--out", default=None,
+                    help="also write the parsed _device_profile.json here")
+    pf.set_defaults(fn=cmd_profile)
+
     sv = sub.add_parser(
         "supervise",
         help="run a subcommand under the preemption-safe supervisor",
@@ -743,6 +811,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (TBX_COMPILE_CACHE=0 opts out).
     jax_cache.enable()
     args = build_parser().parse_args(argv)
+    if getattr(args, "profile", False):
+        # --profile is sugar for TBX_PROFILE=1: the sweep observer arms the
+        # bounded device capture (obs/profile.py).
+        os.environ["TBX_PROFILE"] = "1"
     # Latch SIGTERM/SIGINT into the graceful drain: pipelines stop at the
     # next word boundary and exit 75 (see module docstring).  The supervise
     # subcommand polls the same latch to forward the notice to its child.

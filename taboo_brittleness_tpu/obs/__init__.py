@@ -36,7 +36,7 @@ import sys
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
-from taboo_brittleness_tpu.obs import memory, metrics, progress, trace
+from taboo_brittleness_tpu.obs import memory, metrics, profile, progress, trace
 from taboo_brittleness_tpu.obs.trace import (
     EVENTS_FILENAME, NULL_SPAN, SCHEMA_VERSION, Tracer, activate, deactivate,
     enabled, event, events_path, get_tracer, iter_events, last_seq, span)
@@ -47,8 +47,8 @@ __all__ = [
     "EVENTS_FILENAME", "PROGRESS_FILENAME", "SCHEMA_VERSION",
     "ProgressReporter", "SweepObserver", "Tracer",
     "activate", "deactivate", "enabled", "event", "events_path",
-    "get_tracer", "iter_events", "last_seq", "memory", "metrics", "progress",
-    "read_progress", "span", "sweep_observer", "trace", "warn",
+    "get_tracer", "iter_events", "last_seq", "memory", "metrics", "profile",
+    "progress", "read_progress", "span", "sweep_observer", "trace", "warn",
 ]
 
 
@@ -73,12 +73,14 @@ class SweepObserver:
                  run_span=None,
                  reporter: Optional[ProgressReporter] = None,
                  owns_tracer: bool = False,
-                 mem_sampler: Optional[memory.MemorySampler] = None):
+                 mem_sampler: Optional[memory.MemorySampler] = None,
+                 device_capture: Optional["profile.SweepCapture"] = None):
         self.tracer = tracer
         self.run_span = run_span
         self.reporter = reporter
         self._owns_tracer = owns_tracer
         self._mem_sampler = mem_sampler
+        self._device_capture = device_capture
         self._final_status: Optional[str] = None
 
     @property
@@ -117,6 +119,13 @@ class SweepObserver:
                 self.reporter.word_done(word)
                 metrics.histogram("word.seconds").observe(
                     _span_duration(sp))
+                if self._device_capture is not None:
+                    # A computed word just finished on the device profiler's
+                    # clock; the bounded capture stops itself after K of them.
+                    try:
+                        self._device_capture.word_done()
+                    except Exception:  # noqa: BLE001 — profiling is best-effort
+                        pass
 
     @contextlib.contextmanager
     def phase(self, name: str, **attrs: Any) -> Iterator[Any]:
@@ -158,6 +167,13 @@ class SweepObserver:
             _publish_aot_stats()
         except Exception:  # noqa: BLE001
             pass
+        if self._device_capture is not None:
+            # A sweep shorter than the capture budget still lands its
+            # _device_profile.json at close.
+            try:
+                self._device_capture.finish()
+            except Exception:  # noqa: BLE001 — profiling is best-effort
+                pass
         if self._mem_sampler is not None:
             self._mem_sampler.stop()
         if self.run_span is not None:
@@ -226,9 +242,18 @@ def sweep_observer(output_dir: Optional[str], *, pipeline: str,
             total_words=len(words), run_id=tracer.run_id,
             tracer=tracer).start()
         sampler = memory.MemorySampler(tracer).start()
+        capture = None
+        if owns and profile.enabled():
+            # Device-timeline capture (TBX_PROFILE=1): one bounded
+            # jax.profiler window over the first TBX_PROFILE_WORDS computed
+            # words, parsed into <output_dir>/_device_profile.json.  Only the
+            # outermost observer may own it (profiler sessions don't nest).
+            capture = profile.SweepCapture(output_dir, tracer=tracer)
+            if not capture.start():
+                capture = None
         ob = SweepObserver(tracer=tracer, run_span=run_span,
                            reporter=reporter, owns_tracer=owns,
-                           mem_sampler=sampler)
+                           mem_sampler=sampler, device_capture=capture)
     except Exception:  # noqa: BLE001 — observability must never block a sweep
         yield SweepObserver()
         return

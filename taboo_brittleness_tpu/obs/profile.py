@@ -1,0 +1,1003 @@
+"""Phase-scoped device-timeline profiling: XLA traces joined to host spans.
+
+Everything the span stream (obs.trace) records is HOST wall time: a program
+span covers tracing + dispatch (and sometimes a blocking pull), and
+``tools/trace_report.py`` *infers* dispatch gaps as "word time covered by no
+phase span".  Host clocks cannot distinguish device-idle from
+device-busy-on-the-wrong-thing — which is exactly the evidence the ROADMAP's
+fused-loop item (Kernel Looping, arXiv:2410.23668) is gated on.  This module
+is the device half of the telemetry story:
+
+1. **Capture** (:class:`SweepCapture` / :class:`DeviceCapture`) — opt-in via
+   ``TBX_PROFILE=1`` (or the CLI ``--profile`` flag), the sweep observer
+   wraps the first ``TBX_PROFILE_WORDS`` (default 2) computed words of a run
+   in ONE ``jax.profiler`` capture window, written under
+   ``<output_dir>/_profile/``.  Bounding the window keeps the trace small; a
+   couple of steady-state words is what attribution needs.
+2. **Annotation** (:func:`annotate`) — every registered program launch
+   (decode / readout / nll / serve.step / the aot warm-start executions /
+   the direct lens+forcing dispatches) wraps itself in a
+   ``jax.profiler.TraceAnnotation`` named ``tbx:<program>#<span_id>@<fn>``,
+   so device slices are attributable to the exact host span that launched
+   them.  When no capture is active the wrapper is a shared null context —
+   nanoseconds, so the obs-overhead budget (<2% with profiling off) holds.
+3. **Parse** (:func:`parse_trace_file` / :func:`build_profile`) — a
+   stdlib-only reader for the emitted Perfetto ``*.trace.json.gz`` that
+   pools XLA op slices (events carrying ``args.hlo_op`` / device-lane
+   events) per annotation and writes ``<output_dir>/_device_profile.json``:
+   per-program and per-phase device-busy seconds, device-idle (dispatch-gap)
+   share measured from the device timeline itself, top-N ops by device time,
+   and HBM-traffic-proportional op classes (matmul / fusion / copy / ...).
+
+Joining device slices to annotations is a three-pass per-HLO-module match
+(annotations carry the jit fn name; executions of ``jit_<fn>`` are grouped
+by time gaps):
+
+- **window** — a group whose midpoint falls inside exactly one candidate
+  annotation window (host blocked inside the annotation; slice overlap is
+  clipped to the window, so joined device time can never exceed the span);
+- **fifo** — remaining groups zip against remaining candidate annotations in
+  dispatch order when the counts agree (the device executes programs FIFO,
+  so async dispatches that outlive their window still attribute exactly);
+- **order** — otherwise, the latest candidate annotation that started before
+  the group (a best-effort fallback, labeled as such in the artifact).
+
+``tools/trace_report.py --device`` renders the artifact against
+``_events.jsonl`` and the ``perf/roofline.py`` ceilings: per-phase *measured*
+device occupancy vs ceiling, dispatch-gap share from device idle, and a
+host-vs-device disagreement column flagging spans that mislead.
+
+This module also hosts the profiler drivers behind the ``tbx profile`` CLI
+(:func:`run_launch_profile` — one phase launch under capture, the round-4
+"what does the while-loop body spend time on" flow — and
+:func:`run_study_host_profile` + :class:`StageTimers`, the host wall-clock
+breakdown that used to live in ``tools/profile_study_host.py``).
+
+Contract, as for the rest of obs/: host-side only, fail-open end to end
+(capture/parse errors never take down a run), stdlib + lazily-imported jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import glob
+import gzip
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Bumped whenever ``_device_profile.json`` gains/renames a REQUIRED key;
+#: readers (tools/trace_report.py --device) accept their own version and older.
+SCHEMA_VERSION = 1
+
+DEVICE_PROFILE_FILENAME = "_device_profile.json"
+PROFILE_DIRNAME = "_profile"
+
+#: Annotation wire format: ``tbx:<program>#<span_id>[@<fn_name>]``.
+_ANNOT_PREFIX = "tbx:"
+_ANNOT_RE = re.compile(r"^tbx:(?P<program>[^#]+)#(?P<span>\d+)(?:@(?P<fn>.+))?$")
+
+#: Gap (microseconds) that splits two slices of the same HLO module into
+#: separate execution groups.  Intra-program thunk gaps are microseconds;
+#: separate launches of the same program are separated by at least a host
+#: round-trip.
+_GROUP_GAP_US = 5000.0
+
+#: Cap on per-launch records in the artifact (a profiled serving run steps
+#: thousands of times; phases still aggregate everything).
+_MAX_PROGRAM_RECORDS = 400
+
+
+def enabled() -> bool:
+    """Opt-in master switch: ``TBX_PROFILE=1`` (or the CLI ``--profile``
+    flag, which sets it) arms the sweep observer's device capture."""
+    return os.environ.get("TBX_PROFILE", "0") == "1"
+
+
+def capture_words() -> int:
+    """How many computed words one capture window covers (``TBX_PROFILE_WORDS``,
+    default 2 — the steady-state pair attribution needs; bounding the window
+    keeps trace size sane on a 20-word sweep)."""
+    try:
+        return max(1, int(os.environ.get("TBX_PROFILE_WORDS", "2")))
+    except ValueError:
+        return 2
+
+
+# ---------------------------------------------------------------------------
+# Annotation.
+# ---------------------------------------------------------------------------
+
+#: True while a capture started by THIS module is live.  ``annotate`` keys
+#: off it so the per-dispatch cost with profiling off is one attribute read.
+_ACTIVE = False
+
+
+class _NullCtx:
+    """Shared no-op context for the not-capturing fast path."""
+
+    def __enter__(self) -> "_NullCtx":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NULL_CTX = _NullCtx()
+
+
+def annotation_name(program: str, span_id: Optional[int],
+                    fn_name: Optional[str]) -> str:
+    name = f"{_ANNOT_PREFIX}{program}#{int(span_id or 0)}"
+    if fn_name:
+        name += f"@{fn_name}"
+    return name
+
+
+def annotate(program: str, *, fn: Any = None,
+             span_id: Optional[int] = None):
+    """Context manager marking one program launch on the profiler timeline.
+
+    ``fn`` (the jitted callable, or its name as a string) rides along so the
+    parser can match device slices by HLO module name (``jit_<fn>``) even
+    when an async dispatch's execution outlives the annotation window.
+    ``span_id`` defaults to the innermost active obs span — the id the
+    artifact is later joined back to ``_events.jsonl`` with.
+
+    A shared null context when no capture is active: call sites wrap every
+    dispatch unconditionally and pay ~nothing in the common case.
+    """
+    if not _ACTIVE:
+        return _NULL_CTX
+    try:
+        import jax
+
+        if span_id is None:
+            from taboo_brittleness_tpu.obs import trace as trace_mod
+
+            t = trace_mod.get_tracer()
+            cur = t.current_span() if t is not None else None
+            span_id = getattr(cur, "span_id", None)
+        fn_name = fn if isinstance(fn, str) else (
+            getattr(fn, "__name__", None) if fn is not None else None)
+        return jax.profiler.TraceAnnotation(
+            annotation_name(program, span_id, fn_name))
+    except Exception:  # noqa: BLE001 — profiling must never poison a dispatch
+        return _NULL_CTX
+
+
+# ---------------------------------------------------------------------------
+# Capture.
+# ---------------------------------------------------------------------------
+
+class DeviceCapture:
+    """One ``jax.profiler`` capture window → parsed profile dict.
+
+    Fail-open: ``start`` returns False (and the capture stays inert) when
+    profiling cannot start — another capture live in the process, a backend
+    without profiler support, a read-only trace dir."""
+
+    def __init__(self, trace_dir: str, *, meta: Optional[Dict[str, Any]] = None):
+        self.trace_dir = trace_dir
+        self.meta = dict(meta or {})
+        self.active = False
+        self._t0: Optional[float] = None
+        self._session: Any = None       # ProfilerSession when options worked
+
+    def start(self) -> bool:
+        global _ACTIVE
+        if self.active or _ACTIVE:
+            return False
+        try:
+            import jax
+
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.devices()               # backends must exist before a session
+            try:
+                # Preferred: a ProfilerSession with the python tracer OFF.
+                # jax.profiler.start_trace hardcodes python_tracer_level=1,
+                # and the resulting ~1M python-frame events overflow the
+                # trace converter's event cap on even a two-word sweep —
+                # crowding out the XLA op slices this capture exists for.
+                from jax._src.lib import xla_client
+
+                opts = xla_client.profiler.ProfileOptions()
+                opts.python_tracer_level = 0
+                opts.host_tracer_level = 2
+                self._session = xla_client.profiler.ProfilerSession(opts)
+            except Exception:  # noqa: BLE001 — fall back to the public API
+                self._session = None
+                jax.profiler.start_trace(self.trace_dir)
+        except Exception:  # noqa: BLE001 — profiling is best-effort
+            return False
+        self.active = True
+        self._t0 = time.monotonic()
+        _ACTIVE = True
+        return True
+
+    def stop(self) -> Optional[Dict[str, Any]]:
+        """Stop the window, parse the newest emitted trace file, and return
+        the profile dict (None on any failure)."""
+        global _ACTIVE
+        if not self.active:
+            return None
+        self.active = False
+        _ACTIVE = False
+        wall = (time.monotonic() - self._t0) if self._t0 is not None else None
+        try:
+            if self._session is not None:
+                session, self._session = self._session, None
+                session.export(session.stop(), self.trace_dir)
+            else:
+                import jax
+
+                jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            return None
+        try:
+            path = find_trace_file(self.trace_dir)
+            if path is None:
+                return None
+            meta = dict(self.meta)
+            if wall is not None:
+                meta["capture_wall_seconds"] = round(wall, 3)
+            try:
+                import jax
+
+                meta.setdefault("backend", jax.default_backend())
+                meta.setdefault("device_kind", jax.devices()[0].device_kind)
+            except Exception:  # noqa: BLE001
+                pass
+            annotations, slices = parse_trace_file(path)
+            profile = build_profile(annotations, slices, meta=meta,
+                                    trace_file=path)
+            return profile
+        except Exception:  # noqa: BLE001 — a bad trace must not kill the run
+            return None
+
+
+class SweepCapture:
+    """The sweep observer's bounded capture: starts with the run, stops after
+    ``TBX_PROFILE_WORDS`` computed words (or at observer close), writes
+    ``<output_dir>/_device_profile.json``."""
+
+    def __init__(self, output_dir: str, *, tracer: Any = None,
+                 words_limit: Optional[int] = None):
+        self.output_dir = output_dir
+        self.tracer = tracer
+        self.limit = words_limit if words_limit is not None else capture_words()
+        self._capture = DeviceCapture(
+            os.path.join(output_dir, PROFILE_DIRNAME))
+        self._words_done = 0
+        self.profile: Optional[Dict[str, Any]] = None
+        self.artifact_path: Optional[str] = None
+
+    def start(self) -> bool:
+        return self._capture.start()
+
+    def word_done(self) -> None:
+        """One computed (non-resumed) word finished; stop once the budget is
+        spent so the trailing 18 words of a real sweep cost nothing."""
+        if not self._capture.active:
+            return
+        self._words_done += 1
+        if self._words_done >= self.limit:
+            self.finish()
+
+    def finish(self) -> None:
+        if not self._capture.active:
+            return
+        profile = self._capture.stop()
+        if profile is None:
+            return
+        profile.setdefault("capture", {})["words"] = self._words_done
+        self.profile = profile
+        path = os.path.join(self.output_dir, DEVICE_PROFILE_FILENAME)
+        try:
+            from taboo_brittleness_tpu.runtime.resilience import (
+                atomic_json_dump)
+
+            atomic_json_dump(profile, path)
+            self.artifact_path = path
+        except Exception:  # noqa: BLE001 — fail-open
+            return
+        if self.tracer is not None:
+            try:
+                self.tracer.event(
+                    "profile.captured", words=self._words_done,
+                    file=DEVICE_PROFILE_FILENAME,
+                    programs=len(profile.get("programs", [])),
+                    device_busy_seconds=profile.get("device", {}).get(
+                        "busy_union_seconds"))
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Trace parsing (stdlib-only; also used by tools/trace_report.py --device).
+# ---------------------------------------------------------------------------
+
+def find_trace_file(trace_dir: str) -> Optional[str]:
+    """Newest Perfetto ``*.trace.json.gz`` under a profiler log dir."""
+    files = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=lambda p: os.path.getmtime(p))
+    return files[-1] if files else None
+
+
+def parse_trace_file(path: str) -> Tuple[List[Dict[str, Any]],
+                                         List[Dict[str, Any]]]:
+    """(annotations, device slices) from one Perfetto trace.
+
+    - An *annotation* is a complete event whose name parses as
+      ``tbx:<program>#<span>[@<fn>]`` (emitted by :func:`annotate`).
+    - A *device slice* is a complete event carrying ``args.hlo_op`` /
+      ``args.hlo_module`` (the XLA executor's per-op execution events — on
+      the CPU backend these live on ``tf_XLATfrtCpuClient`` threads), or any
+      complete event on a ``/device:``-named process lane (TPU/GPU device
+      streams).  Times are microseconds as emitted.
+    """
+    with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents") or []
+    device_pids = set()
+    for ev in events:
+        if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                and "/device:" in str((ev.get("args") or {}).get("name", ""))):
+            device_pids.add(ev.get("pid"))
+    annotations: List[Dict[str, Any]] = []
+    slices: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = str(ev.get("name", ""))
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        dur = float(ev.get("dur", 0.0) or 0.0)
+        if name.startswith(_ANNOT_PREFIX):
+            m = _ANNOT_RE.match(name)
+            if m:
+                annotations.append({
+                    "program": m.group("program"),
+                    "span_id": int(m.group("span")),
+                    "fn": m.group("fn"),
+                    "t0": float(ts), "t1": float(ts) + dur,
+                })
+            continue
+        args = ev.get("args") or {}
+        on_device_lane = ev.get("pid") in device_pids
+        if "hlo_op" in args or "hlo_module" in args or on_device_lane:
+            slices.append({
+                "name": name,
+                "module": args.get("hlo_module"),
+                "t0": float(ts), "dur": dur,
+                "tid": ev.get("tid"),
+            })
+    annotations.sort(key=lambda a: a["t0"])
+    slices.sort(key=lambda s: s["t0"])
+    return annotations, slices
+
+
+#: HBM-traffic-proportional op classes, coarsest-that-still-ranks: matmuls
+#: stream weights, copies/transposes are pure HBM traffic (the retiling-copy
+#: class the readout A/B chased), fusions blend both.
+_OP_CLASS_PATTERNS = (
+    # Order matters: collectives/transfers first (an "all-gather" must not
+    # read as a copy, nor an "all-reduce" as a reduce).
+    ("collective", re.compile(r"all-reduce|all-gather|all-to-all|"
+                              r"collective|psum|permute", re.I)),
+    ("host-transfer", re.compile(r"infeed|outfeed|transfer|copy-start|"
+                                 r"copy-done", re.I)),
+    ("matmul", re.compile(r"dot|conv|gemm|einsum", re.I)),
+    ("copy", re.compile(
+        r"copy|transpose|reshape|bitcast|concatenate|dynamic-slice|"
+        r"dynamic_slice|dynamic-update|dynamic_update|slice|pad|gather|scatter",
+        re.I)),
+    ("fusion", re.compile(r"fusion", re.I)),
+    ("reduce", re.compile(r"reduce|sort|top-k|topk|cumsum|argmax|argmin", re.I)),
+)
+
+
+def classify_op(name: str) -> str:
+    for cls, pat in _OP_CLASS_PATTERNS:
+        if pat.search(name):
+            return cls
+    return "other"
+
+
+def _base_op_name(name: str) -> str:
+    """``dot.4`` → ``dot`` — the per-instruction suffix only splits totals."""
+    return re.sub(r"\.\d+$", "", name)
+
+
+def _union_seconds(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered microseconds of a set of [t0, t1) intervals → seconds."""
+    if not intervals:
+        return 0.0
+    intervals = sorted(intervals)
+    total = 0.0
+    cur0, cur1 = intervals[0]
+    for t0, t1 in intervals[1:]:
+        if t0 > cur1:
+            total += cur1 - cur0
+            cur0, cur1 = t0, t1
+        else:
+            cur1 = max(cur1, t1)
+    total += cur1 - cur0
+    return total / 1e6
+
+
+def _group_slices(slices: List[Dict[str, Any]],
+                  annotations: Sequence[Dict[str, Any]] = ()) -> Dict[
+                      Optional[str], List[Dict[str, Any]]]:
+    """Per-HLO-module execution groups — one group ≈ one launch's execution.
+
+    A group is a maximal run of same-module slices on one executor thread:
+    the run breaks when a slice of a DIFFERENT module lands in between (the
+    queue moved on to the next program), when the intra-module gap exceeds
+    ``_GROUP_GAP_US``, or when a new fn-matched ANNOTATION started inside
+    the gap (two back-to-back launches of the same program with almost no
+    host time between them — e.g. consecutive tiny-model words — are two
+    dispatches, so they must be two groups for the FIFO match to hold).
+    Runs are per-thread because the executor interleaves programs, not
+    threads, within one launch."""
+    ann_starts: Dict[Optional[str], List[float]] = {}
+    if annotations:
+        modules = {s["module"] for s in slices}
+        for module in modules:
+            starts = sorted(a["t0"] for a in annotations
+                            if _module_matches(module, a.get("fn")))
+            if starts:
+                ann_starts[module] = starts
+
+    def dispatch_between(module: Optional[str], t0: float, t1: float) -> bool:
+        starts = ann_starts.get(module)
+        if not starts:
+            return False
+        i = bisect.bisect_right(starts, t0)
+        return i < len(starts) and starts[i] <= t1
+
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for s in slices:
+        by_tid.setdefault(s["tid"], []).append(s)
+    groups: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for ss in by_tid.values():                         # already time-sorted
+        cur: Optional[Dict[str, Any]] = None
+        for s in ss:
+            t1 = s["t0"] + s["dur"]
+            if (cur is not None and s["module"] == cur["module"]
+                    and s["t0"] - cur["t1"] <= _GROUP_GAP_US
+                    and not dispatch_between(s["module"], cur["t1"],
+                                             s["t0"])):
+                cur["t1"] = max(cur["t1"], t1)
+                cur["slices"].append(s)
+            else:
+                cur = {"module": s["module"], "t0": s["t0"], "t1": t1,
+                       "slices": [s]}
+                groups.setdefault(s["module"], []).append(cur)
+    for module_groups in groups.values():
+        module_groups.sort(key=lambda g: g["t0"])
+    return groups
+
+
+def _module_matches(module: Optional[str], fn: Optional[str]) -> bool:
+    if not module or not fn:
+        return False
+    return module == f"jit_{fn}" or module == fn or module.startswith(
+        f"jit_{fn}")
+
+
+def _join(annotations: List[Dict[str, Any]],
+          groups: Dict[Optional[str], List[Dict[str, Any]]]) -> Tuple[
+              Dict[int, List[Tuple[Dict[str, Any], str]]],
+              List[Dict[str, Any]]]:
+    """Assign execution groups to annotations (see module docstring for the
+    window → fifo → order cascade).  Returns (annotation index → list of
+    (group, how)), plus the unattributed groups."""
+    assigned: Dict[int, List[Tuple[Dict[str, Any], str]]] = {}
+    unattributed: List[Dict[str, Any]] = []
+
+    def candidates(module: Optional[str]) -> List[int]:
+        out = [i for i, a in enumerate(annotations)
+               if _module_matches(module, a.get("fn"))]
+        if out:
+            return out
+        # No fn-matched annotation for this module: fall back to window
+        # containment against every annotation (direct named_scope users).
+        return list(range(len(annotations)))
+
+    for module, module_groups in groups.items():
+        cand = candidates(module)
+        fn_matched = any(_module_matches(module, annotations[i].get("fn"))
+                         for i in cand)
+        remaining_groups: List[Dict[str, Any]] = []
+        taken: set = set()
+        # Pass 1: window containment (group midpoint inside the window).
+        for g in module_groups:
+            mid = (g["t0"] + g["t1"]) / 2.0
+            hits = [i for i in cand
+                    if annotations[i]["t0"] <= mid <= annotations[i]["t1"]]
+            if len(hits) == 1 or (hits and fn_matched):
+                # Ambiguity (nested/overlapping windows) resolves to the
+                # latest-started containing window — the innermost dispatch.
+                i = max(hits, key=lambda j: annotations[j]["t0"])
+                assigned.setdefault(i, []).append((g, "window"))
+                taken.add(i)
+            elif fn_matched:
+                remaining_groups.append(g)
+            else:
+                unattributed.append(g)
+        if not fn_matched:
+            continue
+        # Pass 2: FIFO zip when the leftover counts agree exactly.
+        free = [i for i in cand if i not in taken]
+        if remaining_groups and len(remaining_groups) == len(free):
+            for g, i in zip(remaining_groups, free):
+                assigned.setdefault(i, []).append((g, "fifo"))
+            continue
+        # Pass 3: latest candidate annotation started before the group.
+        for g in remaining_groups:
+            before = [i for i in cand if annotations[i]["t0"] <= g["t0"]]
+            i = max(before, default=(cand[0] if cand else None),
+                    key=lambda j: annotations[j]["t0"])
+            if i is None:
+                unattributed.append(g)
+            else:
+                assigned.setdefault(i, []).append((g, "order"))
+    return assigned, unattributed
+
+
+def build_profile(annotations: List[Dict[str, Any]],
+                  slices: List[Dict[str, Any]], *,
+                  meta: Optional[Dict[str, Any]] = None,
+                  trace_file: Optional[str] = None) -> Dict[str, Any]:
+    """Pool device slices per annotation and assemble the
+    ``_device_profile.json`` payload (see the module docstring for the
+    schema's meaning; ``v`` gates readers)."""
+    groups = _group_slices(slices, annotations)
+    assigned, unattributed = _join(annotations, groups)
+    last_slice_end = max((s["t0"] + s["dur"] for s in slices), default=0.0)
+
+    programs: List[Dict[str, Any]] = []
+    phases: Dict[str, Dict[str, Any]] = {}
+    for i, a in enumerate(annotations):
+        window_s = max(0.0, (a["t1"] - a["t0"]) / 1e6)
+        got = assigned.get(i, [])
+        device_us = 0.0
+        n_slices = 0
+        rec_intervals: List[Tuple[float, float]] = []
+        how = "unjoined"
+        for g, g_how in got:
+            for s in g["slices"]:
+                if g_how == "window":
+                    # Clip to the window: joined device time can then never
+                    # exceed the host span that launched it (the --check
+                    # invariant holds on the occupancy union below).
+                    o0 = max(s["t0"], a["t0"])
+                    o1 = min(s["t0"] + s["dur"], a["t1"])
+                    if o1 <= o0:
+                        continue
+                    device_us += o1 - o0
+                    rec_intervals.append((o0, o1))
+                else:
+                    device_us += s["dur"]
+                    rec_intervals.append((s["t0"], s["t0"] + s["dur"]))
+                n_slices += 1
+        if got:
+            hows = {g_how for _, g_how in got}
+            how = ("window" if hows == {"window"}
+                   else "fifo" if "fifo" in hows
+                   else "order")
+        rec = {
+            "program": a["program"],
+            "span_id": a["span_id"],
+            "fn": a.get("fn"),
+            "window_seconds": round(window_s, 6),
+            # sum = device resource-seconds (parallel thunks double-count);
+            # union = device occupancy — the quantity bounded by the span.
+            "device_seconds": round(device_us / 1e6, 6),
+            "device_union_seconds": round(_union_seconds(rec_intervals), 6),
+            "slices": n_slices,
+            "joined": how,
+        }
+        if how == "unjoined" and a["t0"] >= last_slice_end:
+            # Dispatched inside the capture window but executed after it
+            # closed (an in-flight tail, e.g. the next word's pre-dispatched
+            # baseline): truncated by the capture boundary, not a join miss.
+            rec["truncated"] = True
+        if len(programs) < _MAX_PROGRAM_RECORDS:
+            programs.append(rec)
+        ph = phases.setdefault(a["program"], {
+            "launches": 0, "device_seconds": 0.0, "window_seconds": 0.0,
+            "slices": 0, "unjoined_launches": 0})
+        ph["launches"] += 1
+        ph["device_seconds"] += device_us / 1e6
+        ph["window_seconds"] += window_s
+        ph["slices"] += n_slices
+        if how == "unjoined":
+            ph["unjoined_launches"] += 1
+    for ph in phases.values():
+        ph["device_seconds"] = round(ph["device_seconds"], 6)
+        ph["window_seconds"] = round(ph["window_seconds"], 6)
+
+    # Device-timeline totals: busy union vs the capture extent IS the
+    # measured dispatch-gap share (no host inference involved).
+    intervals = [(s["t0"], s["t0"] + s["dur"]) for s in slices]
+    busy_union = _union_seconds(intervals)
+    busy_sum = sum(s["dur"] for s in slices) / 1e6
+    ts_all = ([s["t0"] for s in slices] + [a["t0"] for a in annotations])
+    te_all = ([s["t0"] + s["dur"] for s in slices]
+              + [a["t1"] for a in annotations])
+    capture_s = ((max(te_all) - min(ts_all)) / 1e6) if ts_all else 0.0
+    idle_s = max(0.0, capture_s - busy_union)
+
+    top: Dict[str, Dict[str, Any]] = {}
+    for s in slices:
+        base = _base_op_name(s["name"])
+        cell = top.setdefault(base, {"op": base, "seconds": 0.0, "count": 0,
+                                     "class": classify_op(base)})
+        cell["seconds"] += s["dur"] / 1e6
+        cell["count"] += 1
+    top_ops = sorted(top.values(), key=lambda c: -c["seconds"])[:15]
+    for c in top_ops:
+        c["seconds"] = round(c["seconds"], 6)
+    op_classes: Dict[str, float] = {}
+    for cell in top.values():
+        op_classes[cell["class"]] = (op_classes.get(cell["class"], 0.0)
+                                     + cell["seconds"])
+    op_classes = {
+        k: {"seconds": round(v, 6),
+            "share": round(v / busy_sum, 4) if busy_sum > 0 else 0.0}
+        for k, v in sorted(op_classes.items(), key=lambda kv: -kv[1])}
+
+    unattr_s = sum(s["dur"] for g in unattributed for s in g["slices"]) / 1e6
+    capture_meta = {
+        "annotations": len(annotations),
+        "device_slices": len(slices),
+    }
+    if trace_file:
+        capture_meta["trace_file"] = trace_file
+    meta = dict(meta or {})
+    capture_meta.update(
+        {k: meta.pop(k) for k in list(meta)
+         if k in ("capture_wall_seconds", "words")})
+    return {
+        "v": SCHEMA_VERSION,
+        "generated_by": "taboo_brittleness_tpu.obs.profile",
+        **meta,
+        "capture": capture_meta,
+        "programs": programs,
+        "phases": phases,
+        "device": {
+            "busy_seconds": round(busy_sum, 6),
+            "busy_union_seconds": round(busy_union, 6),
+            "capture_seconds": round(capture_s, 6),
+            "idle_seconds": round(idle_s, 6),
+            "idle_share": round(idle_s / capture_s, 4) if capture_s > 0 else 0.0,
+        },
+        "top_ops": top_ops,
+        "op_classes": op_classes,
+        "unattributed": {
+            "seconds": round(unattr_s, 6),
+            "groups": len(unattributed),
+        },
+    }
+
+
+def load_device_profile(path: str) -> Dict[str, Any]:
+    """Read a ``_device_profile.json`` (raises on unreadable/newer-schema —
+    callers decide whether that is fatal)."""
+    with open(path, "r", encoding="utf-8") as f:
+        profile = json.load(f)
+    if not isinstance(profile, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if int(profile.get("v", 0)) > SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: schema v{profile.get('v')} is newer than this reader "
+            f"(v{SCHEMA_VERSION})")
+    return profile
+
+
+# ---------------------------------------------------------------------------
+# `tbx profile` drivers.
+# ---------------------------------------------------------------------------
+
+def run_launch_profile(*, phase: str = "decode", rows: Optional[int] = None,
+                       prompt_len: int = 32, new_tokens: int = 50,
+                       trace_dir: Optional[str] = None,
+                       top: int = 20) -> Dict[str, Any]:
+    """Device-profile ONE compiled sweep launch (decode / readout / nll) on
+    the current backend — the flow that found the round-4 KV-stack copies
+    (22% of the decode phase).  Compiles outside the capture window, then
+    captures exactly one annotated launch and returns the parsed profile
+    plus a rendered ``lines`` summary for the CLI to print."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode
+
+    if phase not in ("decode", "readout", "nll"):
+        raise ValueError(f"unknown phase {phase!r}")
+    on_accel = jax.default_backend() != "cpu"
+    cfg = gemma2.PRESETS["gemma2_bench" if on_accel else "gemma2_tiny"]
+    rows = rows or (330 if on_accel else 8)
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    sae = sae_ops.init_random(jax.random.PRNGKey(1), cfg.hidden_size,
+                              16384 if on_accel else 64)
+    tap = min(31, cfg.num_layers - 1)
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+               for _ in range(rows)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    ins = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    ep = {"sae": sae,
+          "latent_ids": jnp.asarray(
+              rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)), jnp.int32),
+          "layer": tap}
+    resp_start = prompt_len - 1
+
+    def run_decode():
+        with annotate("decode", fn=decode.greedy_decode, span_id=1):
+            d = decode.greedy_decode(
+                params, cfg, *ins, max_new_tokens=new_tokens,
+                edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
+                capture_residual_layer=tap, return_prefill_cache=True)
+            jax.block_until_ready(d.tokens)
+        return d
+
+    dec = run_decode()                       # compile + downstream inputs
+    layout = decode.response_layout_device(dec)
+
+    def run_readout():
+        with annotate("readout", fn=iv._residual_measure, span_id=2):
+            out = iv._residual_measure(
+                params, cfg, dec.residual, layout.sequences,
+                layout.response_mask, jnp.zeros((rows,), jnp.int32),
+                top_k=5, resp_start=resp_start)
+            jax.block_until_ready(out["agg_ids"])
+
+    def run_nll():
+        pos2 = jnp.maximum(jnp.cumsum(dec.sequence_valid, 1) - 1, 0)
+        pos2 = pos2.astype(jnp.int32)
+        nm = jnp.zeros_like(dec.sequence_valid).at[:, resp_start:-1].set(True)
+        with annotate("nll", fn=iv._nll_cached_jit, span_id=3):
+            nll = iv._nll_cached_jit(
+                params, cfg, *dec.prefill_cache,
+                dec.sequences, dec.sequence_valid, pos2, nm,
+                edit_fn=iv.sae_ablation_edit,
+                edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
+                resp_start=resp_start)
+            jax.block_until_ready(nll)
+
+    fn = {"decode": run_decode, "readout": run_readout, "nll": run_nll}[phase]
+    fn()                                      # compile the chosen phase
+    trace_dir = trace_dir or os.path.join("/tmp", "tbx_prof")
+    capture = DeviceCapture(trace_dir)
+    if not capture.start():
+        raise RuntimeError(
+            f"could not start a profiler capture into {trace_dir} "
+            "(another capture live in this process?)")
+    fn()
+    profile = capture.stop()
+    if profile is None:
+        raise RuntimeError(f"no trace parsed from {trace_dir}")
+
+    lines = [f"top {top} ops for ONE {phase} launch at {rows} rows:"]
+    for cell in profile["top_ops"][:top]:
+        lines.append(f"  {cell['seconds']:10.6f}s  x{cell['count']:5d}  "
+                     f"[{cell['class']:<8}] {cell['op'][:80]}")
+    dev = profile["device"]
+    lines.append(
+        f"device busy {dev['busy_seconds']:.4f}s "
+        f"(union {dev['busy_union_seconds']:.4f}s) over a "
+        f"{dev['capture_seconds']:.4f}s capture — idle share "
+        f"{dev['idle_share']:.1%}")
+    lines.append(f"raw trace -> {trace_dir}")
+    return {"profile": profile, "phase": phase, "rows": rows, "lines": lines}
+
+
+class StageTimers:
+    """Nested wall-clock timers with self-time attribution (the host half of
+    the profiler; previously ``tools/profile_study_host.py``).
+
+    ``wrap(mod, name)`` monkeypatches ``mod.name`` with a timed version;
+    nesting is tracked on a stack so a parent's self-time excludes its timed
+    children (e.g. ``prepare_word_state`` minus its ``_residual_measure``).
+    """
+
+    def __init__(self) -> None:
+        self.total: Dict[str, float] = {}
+        self.self_time: Dict[str, float] = {}
+        self.count: Dict[str, int] = {}
+        self._stack: List[List] = []   # [name, t0, child_seconds]
+
+    def enter(self, name: str) -> None:
+        self._stack.append([name, time.perf_counter(), 0.0])
+
+    def exit(self) -> None:
+        name, t0, child = self._stack.pop()
+        dt = time.perf_counter() - t0
+        self.total[name] = self.total.get(name, 0.0) + dt
+        self.self_time[name] = self.self_time.get(name, 0.0) + dt - child
+        self.count[name] = self.count.get(name, 0) + 1
+        if self._stack:
+            self._stack[-1][2] += dt
+
+    def wrap(self, mod: Any, name: str, label: Optional[str] = None) -> None:
+        import functools
+
+        label = label or name
+        fn = getattr(mod, name)
+
+        @functools.wraps(fn)
+        def timed(*a, **kw):
+            self.enter(label)
+            try:
+                return fn(*a, **kw)
+            finally:
+                self.exit()
+
+        setattr(mod, name, timed)
+
+    def reset(self) -> None:
+        self.total.clear()
+        self.self_time.clear()
+        self.count.clear()
+
+    def report_lines(self, wall: float, title: str) -> List[str]:
+        lines = [f"== {title} (wall {wall:.2f}s) ==",
+                 f"  {'stage':42s} {'total':>8s} {'self':>8s} {'calls':>6s}"]
+        for name in sorted(self.self_time, key=self.self_time.get,
+                           reverse=True):
+            lines.append(f"  {name:42s} {self.total[name]:8.3f} "
+                         f"{self.self_time[name]:8.3f} {self.count[name]:6d}")
+        accounted = sum(self.total[n] for n in self.total
+                        if self.count[n] and n.startswith("word:"))
+        untimed = wall - accounted
+        if abs(untimed) > 0.01:
+            lines.append(f"  {'(outside timed stages)':42s} {untimed:8.3f}")
+        return lines
+
+
+def run_study_host_profile(*, words: int = 2, prompt_len: int = 32,
+                           new_tokens: int = 50) -> Dict[str, Any]:
+    """Host-side wall-clock breakdown of real study words (VERDICT r04 #1):
+    runs the REAL ``run_intervention_studies`` driver on synthetic
+    bench-shape words with every interesting stage wrapped in a nested
+    timer, and returns a self-time-ranked tree per word.  Device waits show
+    up inside whichever stage blocks — read next to ``_device_profile.json``
+    (the device half) to separate "device busy" from "host busy".
+
+    The first word pays all compiles; per-word reports return separately so
+    the steady state is readable on its own.  ``TBX_PROFILE_NO_SPLIT=1``
+    times the real overlapped ``_collect_rows`` instead of splitting it into
+    device-wait + host halves."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from taboo_brittleness_tpu.runtime import jax_cache
+
+    jax_cache.enable()
+
+    from taboo_brittleness_tpu.config import (
+        Config, ExperimentConfig, InterventionConfig, ModelConfig)
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import lens, projection, sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    on_accel = jax.default_backend() != "cpu"
+    preset = "gemma2_bench" if on_accel else "gemma2_tiny"
+    cfg = gemma2.PRESETS[preset]
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    sae = sae_ops.init_random(jax.random.PRNGKey(2), cfg.hidden_size,
+                              16384 if on_accel else 64)
+    tap = min(31, cfg.num_layers - 1)
+
+    word_list = [f"profword{i}" for i in range(words)]
+    lex = [f"w{i:02d}" for i in range(
+        max(4, min(64, (cfg.vocab_size - 109) // 2 - words - 2)))]
+    tok = WordTokenizer(word_list + lex, vocab_size=cfg.vocab_size)
+    rng = np.random.default_rng(7)
+    prompts = [" ".join(rng.choice(lex, size=max(prompt_len - 8, 2)))
+               for _ in range(10)]
+    config = Config(
+        model=ModelConfig(layer_idx=tap, top_k=5, arch=preset,
+                          dtype="bfloat16", param_dtype="bfloat16"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=new_tokens,
+                                    pad_to_multiple=prompt_len),
+        intervention=InterventionConfig(),
+        word_plurals={w: [w] for w in word_list},
+        prompts=prompts,
+    )
+
+    t = StageTimers()
+    # Stage wrappers, outer to inner.  _dispatch_rows is pure enqueue (host
+    # trace + transfer time); _collect_rows blocks on the device queue.
+    t.wrap(iv, "prepare_word_state")
+    t.wrap(iv, "score_latents_for_word")
+    t.wrap(iv, "plan_ablation_sweep")
+    t.wrap(iv, "plan_projection_sweep")
+    t.wrap(iv, "measure_arm_sets")
+    t.wrap(iv, "_dispatch_rows")
+    t.wrap(iv, "_residual_measure", "residual_measure(dispatch)")
+    t.wrap(iv, "_decode_guess_rows")
+    t.wrap(iv, "_tile_rows_ep")
+    t.wrap(iv, "_atomic_json_dump", "json_dump")
+    t.wrap(iv.metrics_mod, "calculate_metrics")
+    t.wrap(iv.metrics_mod, "leak_rate")
+    t.wrap(projection, "principal_subspace")
+    t.wrap(decode, "generate", "decode.generate(dispatch)")
+    t.wrap(decode, "decode_texts", "decode_texts(host work)")
+    t.wrap(decode, "texts_from_tokens", "texts_from_tokens(host)")
+    t.wrap(decode, "response_layout_device")
+    t.wrap(lens, "spike_positions_batch", "spike_positions(dispatch)")
+
+    # Split _collect_rows into device-wait vs host work: block on every
+    # in-flight output FIRST under a wait timer, so the wrapped inner stages
+    # measure pure host time.  (This serializes what the real collect
+    # overlaps; per-stage attribution is exact while the word wall-clock
+    # stays within ~the overlap window of the real run.)
+    split = os.environ.get("TBX_PROFILE_NO_SPLIT", "0") != "1"
+    real_collect = iv._collect_rows
+
+    def collect_split(tok_, config_, state_, handle):
+        t.enter("collect.device_wait")
+        try:
+            jax.block_until_ready((handle["dec"].tokens,
+                                   handle["edited_nll"],
+                                   handle["out"]["agg_ids"]))
+        finally:
+            t.exit()
+        t.enter("collect.host")
+        try:
+            return real_collect(tok_, config_, state_, handle)
+        finally:
+            t.exit()
+
+    if split:
+        iv._collect_rows = collect_split
+    else:
+        t.wrap(iv, "_collect_rows")
+
+    def model_loader(word):
+        return params, cfg, tok
+
+    out_dir = tempfile.mkdtemp(prefix="tbx_prof_study_")
+    reports: List[Dict[str, Any]] = []
+    try:
+        for i, w in enumerate(word_list):
+            t.reset()
+            t.enter(f"word:{w}")
+            t0 = time.perf_counter()
+            iv.run_intervention_studies(
+                config, model_loader=model_loader, sae=sae, words=[w],
+                output_dir=out_dir)
+            wall = time.perf_counter() - t0
+            t.exit()
+            title = f"word {i} ({'compile' if i == 0 else 'steady'})"
+            reports.append({
+                "word": w, "wall_seconds": round(wall, 3),
+                "total": {k: round(v, 4) for k, v in t.total.items()},
+                "self": {k: round(v, 4) for k, v in t.self_time.items()},
+                "calls": dict(t.count),
+                "lines": t.report_lines(wall, title),
+            })
+    finally:
+        iv._collect_rows = real_collect
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return {"preset": preset, "words": reports}

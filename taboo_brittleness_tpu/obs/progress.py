@@ -85,6 +85,7 @@ class ProgressReporter:
         self._word_t0: Optional[float] = None
         self._ema: Optional[float] = None
         self._serving: Optional[Dict[str, Any]] = None
+        self._serving_latency: Optional[Dict[str, Any]] = None
         self._last_step_mono: Optional[float] = None
 
     # -- state setters (all thread-safe, all fail-open at the write) -------
@@ -126,7 +127,8 @@ class ProgressReporter:
         self._write_throttled()
 
     def serving_update(self, *, in_flight: int, completed: int,
-                       queued: int = 0, stepped: bool = False) -> None:
+                       queued: int = 0, stepped: bool = False,
+                       latency: Optional[Dict[str, Any]] = None) -> None:
         """Serving-mode heartbeat state (``tbx serve``; ISSUE 6 satellite).
 
         The word-sweep staleness classifier assumes word-boundary progress —
@@ -138,11 +140,19 @@ class ProgressReporter:
         supervisor's wedge classifier (``runtime.supervise._wedge_reason``)
         keys off ``workload == "serve"``: idle-but-alive is healthy by
         heartbeat alone; only in-flight sessions with a stalled step clock
-        wedge."""
+        wedge.
+
+        ``latency`` (ISSUE 7 satellite) carries the rolling per-scenario
+        percentiles (``SlotScheduler.latency_percentiles``) so operators see
+        SLO burn LIVE instead of only in the exit-time ``_serve.json``; the
+        last non-None value persists across heartbeats (the scheduler only
+        recomputes it when requests complete)."""
         now = self._clock()
         with self._lock:
             if stepped or self._last_step_mono is None:
                 self._last_step_mono = now
+            if latency is not None:
+                self._serving_latency = latency
             self._serving = {
                 "in_flight": int(in_flight),
                 "completed_requests": int(completed),
@@ -165,6 +175,8 @@ class ProgressReporter:
             ema = self._ema
             word_t0 = self._word_t0
             serving = dict(self._serving) if self._serving else None
+            serving_latency = (dict(self._serving_latency)
+                               if self._serving_latency else None)
             last_step = self._last_step_mono
         remaining = max(
             0, state["words_total"] - state["words_done"]
@@ -196,6 +208,8 @@ class ProgressReporter:
             if last_step is not None:
                 serving["last_step_age_seconds"] = round(
                     max(0.0, self._clock() - last_step), 3)
+            if serving_latency:
+                serving["latency"] = serving_latency
             out["serving"] = serving
         if self.tracer is not None:
             try:

@@ -94,10 +94,14 @@ def generate_for_word(
         # LL-Top-k aggregation at generation time: the summary then carries the
         # finished guesses, so `logit-lens` over a summary cache never touches
         # the model (run_evaluation(model_loader=None) works end-to-end).
-        agg_ids, agg_probs = lens.aggregate_from_residual(
-            params, model_cfg, res.residual, jnp.asarray(seqs),
-            jnp.asarray(layout.response_mask), top_k=config.model.top_k)
-        agg_ids, agg_probs = np.asarray(agg_ids), np.asarray(agg_probs)
+        from taboo_brittleness_tpu import obs
+
+        with obs.profile.annotate("lens.aggregate",
+                                  fn=lens.aggregate_from_residual):
+            agg_ids, agg_probs = lens.aggregate_from_residual(
+                params, model_cfg, res.residual, jnp.asarray(seqs),
+                jnp.asarray(layout.response_mask), top_k=config.model.top_k)
+            agg_ids, agg_probs = np.asarray(agg_ids), np.asarray(agg_probs)
 
     for row, p_idx in enumerate(missing):
         # The reference traces the full output truncated before the response's

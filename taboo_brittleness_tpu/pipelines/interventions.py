@@ -323,29 +323,49 @@ def _readout_chunk_override() -> Optional[int]:
 def _measure_residual(params, cfg, residual, seqs, resp_mask, target_ids, *,
                       top_k: int, resp_start: int, mesh=None):
     """``_residual_measure`` through the AOT program registry (plain jit
-    call under a mesh, or whenever no warm-started executable matches)."""
-    return aot.dispatch(
-        "readout", _residual_measure,
-        dynamic=dict(params=params, residual=residual, seqs=seqs,
-                     resp_mask=resp_mask, target_ids=target_ids),
-        static=dict(cfg=cfg, top_k=top_k, resp_start=resp_start,
-                    chunk=_readout_chunk_override(),
-                    variant=_readout_variant()),
-        route=mesh is None)
+    call under a mesh, or whenever no warm-started executable matches).
+
+    Opens a ``readout`` program span (the study's second compiled program
+    now has its own line in trace_report, not just the decode) and, under an
+    active device capture, a matching TraceAnnotation so the XLA timeline's
+    slices join back to this exact dispatch (obs/profile.py)."""
+    from taboo_brittleness_tpu import obs
+
+    with obs.span("readout", kind="program",
+                  rows=int(getattr(residual, "shape", (0,))[0]),
+                  fn="_residual_measure") as sp:
+        with obs.profile.annotate("readout", fn=_residual_measure,
+                                  span_id=getattr(sp, "span_id", None)):
+            return aot.dispatch(
+                "readout", _residual_measure,
+                dynamic=dict(params=params, residual=residual, seqs=seqs,
+                             resp_mask=resp_mask, target_ids=target_ids),
+                static=dict(cfg=cfg, top_k=top_k, resp_start=resp_start,
+                            chunk=_readout_chunk_override(),
+                            variant=_readout_variant()),
+                route=mesh is None)
 
 
 def _nll_cached(params, cfg, cache_k, cache_v, cache_valid, seqs, valid,
                 positions, next_mask, *, edit_fn=None, edit_params=None,
                 resp_start: int, mesh=None):
-    """``_nll_cached_jit`` through the AOT program registry."""
-    return aot.dispatch(
-        "nll", _nll_cached_jit,
-        dynamic=dict(params=params, cache_k=cache_k, cache_v=cache_v,
-                     cache_valid=cache_valid, seqs=seqs, valid=valid,
-                     positions=positions, next_mask=next_mask,
-                     edit_params=edit_params),
-        static=dict(cfg=cfg, edit_fn=edit_fn, resp_start=resp_start),
-        route=mesh is None)
+    """``_nll_cached_jit`` through the AOT program registry (program span +
+    device-profiler annotation, as in :func:`_measure_residual`)."""
+    from taboo_brittleness_tpu import obs
+
+    with obs.span("nll", kind="program",
+                  rows=int(getattr(seqs, "shape", (0,))[0]),
+                  fn="_teacher_forced_nll_cached") as sp:
+        with obs.profile.annotate("nll", fn=_nll_cached_jit,
+                                  span_id=getattr(sp, "span_id", None)):
+            return aot.dispatch(
+                "nll", _nll_cached_jit,
+                dynamic=dict(params=params, cache_k=cache_k, cache_v=cache_v,
+                             cache_valid=cache_valid, seqs=seqs, valid=valid,
+                             positions=positions, next_mask=next_mask,
+                             edit_params=edit_params),
+                static=dict(cfg=cfg, edit_fn=edit_fn, resp_start=resp_start),
+                route=mesh is None)
 
 
 @partial(jax.jit,

@@ -170,9 +170,13 @@ def analyze_word_on_device(
             params, model_cfg, res.residual, seqs_in,
             resp_in, top_k=top_k, mesh=mesh)
     else:
-        top_ids, top_probs = lens.aggregate_from_residual(
-            params, model_cfg, res.residual, seqs_in,
-            resp_in, top_k=top_k)
+        from taboo_brittleness_tpu import obs
+
+        with obs.profile.annotate("lens.aggregate",
+                                  fn=lens.aggregate_from_residual):
+            top_ids, top_probs = lens.aggregate_from_residual(
+                params, model_cfg, res.residual, seqs_in,
+                resp_in, top_k=top_k)
     texts = decode.decode_texts(tok, dec)    # overlaps the queued lens work
     layout = (layout_host if pad_rows else decode.response_layout(dec))
     seqs, valid = layout.sequences, layout.valid

@@ -46,12 +46,22 @@ def _decode_rendered(
         ids, pad_to_multiple=pad_to_multiple)
     import jax.numpy as jnp
 
-    result = decode.greedy_decode(
-        params, cfg,
-        jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),
-        max_new_tokens=max_new_tokens,
-        edit_fn=edit_fn, edit_params=edit_params)
-    return decode.decode_texts(tok, result)
+    from taboo_brittleness_tpu import obs
+
+    # Direct jit dispatch (bypasses decode.generate's chat templating), so it
+    # carries its own device-profiler annotation + program span: without the
+    # marker the forcing decodes' device slices would be unattributable
+    # (obs/profile.py; tbx-check rule TBX010 holds every such site to this).
+    with obs.span("forcing.decode", kind="program", rows=len(rendered),
+                  fn="greedy_decode") as sp:
+        with obs.profile.annotate("forcing.decode", fn=decode.greedy_decode,
+                                  span_id=getattr(sp, "span_id", None)):
+            result = decode.greedy_decode(
+                params, cfg,
+                jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),
+                max_new_tokens=max_new_tokens,
+                edit_fn=edit_fn, edit_params=edit_params)
+            return decode.decode_texts(tok, result)
 
 
 def _strip_stop(text: str) -> str:

@@ -184,8 +184,17 @@ class AotEntry:
                 if store is not None and store.save(self.name, key, compiled):
                     rec["stored"] = True
             if execute and _all_concrete(dynamic):
+                from taboo_brittleness_tpu.obs import profile as obs_profile
+
                 t0 = time.perf_counter()
-                jax.block_until_ready(compiled(**dynamic))
+                # Device-profiler annotation: warm-start executions run the
+                # SAME HLO modules as the pipeline's launches, so without
+                # their own marker the trace parser would attribute their
+                # device slices to a word's program span (obs/profile.py).
+                with obs_profile.annotate(
+                        "aot.build",
+                        fn=getattr(self.jit_fn, "__name__", self.name)):
+                    jax.block_until_ready(compiled(**dynamic))
                 rec["execute_seconds"] = round(time.perf_counter() - t0, 3)
             self.programs[key] = compiled
         except Exception as e:  # noqa: BLE001 — a failed build = plain jit path

@@ -431,27 +431,33 @@ def generate(
     obs_metrics.counter("decode.rows").inc(len(prompts))
     # Program span: host-side dispatch only (the launch is async — the span
     # covers tracing/dispatch and, with return_texts, the blocking token
-    # pull; device time shows up in whichever span later blocks).
+    # pull; device time shows up in whichever span later blocks).  Under an
+    # active device capture (TBX_PROFILE, obs.profile) the whole block also
+    # rides inside a TraceAnnotation carrying this span's id, so the XLA
+    # timeline's slices join back to exactly this launch.
     with obs.span("decode", kind="program", rows=len(prompts),
-                  cols=int(padded.shape[1]), new_tokens=max_new_tokens):
-        result = aot.dispatch(
-            "decode", greedy_decode,
-            dynamic=dict(
-                params=params,
-                prompt_ids=place(padded), prompt_valid=place(valid),
-                prompt_positions=place(positions),
-                edit_params=edit_params,
-            ),
-            static=dict(
-                cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
-                decode_edit=decode_edit,
-                stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
-                capture_residual_layer=capture_residual_layer,
-                return_prefill_cache=return_prefill_cache,
-            ),
-            route=input_sharding is None,
-        )
-        texts = decode_texts(tok, result) if return_texts else None
+                  cols=int(padded.shape[1]), new_tokens=max_new_tokens,
+                  fn="greedy_decode") as sp:
+        with obs.profile.annotate("decode", fn=greedy_decode,
+                                  span_id=getattr(sp, "span_id", None)):
+            result = aot.dispatch(
+                "decode", greedy_decode,
+                dynamic=dict(
+                    params=params,
+                    prompt_ids=place(padded), prompt_valid=place(valid),
+                    prompt_positions=place(positions),
+                    edit_params=edit_params,
+                ),
+                static=dict(
+                    cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
+                    decode_edit=decode_edit,
+                    stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+                    capture_residual_layer=capture_residual_layer,
+                    return_prefill_cache=return_prefill_cache,
+                ),
+                route=input_sharding is None,
+            )
+            texts = decode_texts(tok, result) if return_texts else None
     return result, texts, ids
 
 

@@ -302,14 +302,22 @@ class ServeEngine:
         must see emitted/finished flags to recycle slots and admit queued
         sessions before the next step.  One small [S]-wide transfer per
         step, by design.
+
+        Under an active device capture (TBX_PROFILE, obs.profile) each step
+        rides inside a TraceAnnotation so its device slices are attributable
+        — a no-op shared context otherwise, so the per-step cost off-profile
+        stays one attribute read.
         """
-        self.cache, self.state, out = aot.dispatch(
-            "serve.step", serve_step,
-            dynamic=self._dynamic(), static=self._static())
-        self.steps += 1
-        # tbx: TBX001-ok — host control point: the scheduler needs emitted/
-        # finished flags each step to recycle slots (one [S]-wide pull).
-        return jax.device_get(out)
+        from taboo_brittleness_tpu.obs import profile as obs_profile
+
+        with obs_profile.annotate("serve.step", fn=serve_step):
+            self.cache, self.state, out = aot.dispatch(
+                "serve.step", serve_step,
+                dynamic=self._dynamic(), static=self._static())
+            self.steps += 1
+            # tbx: TBX001-ok — host control point: the scheduler needs emitted/
+            # finished flags each step to recycle slots (one [S]-wide pull).
+            return jax.device_get(out)
 
     # -- admission / recycle ------------------------------------------------
 

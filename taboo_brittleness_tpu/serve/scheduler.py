@@ -149,6 +149,7 @@ class SlotScheduler:
         self._clock = clock
         self._queue: Deque[Request] = deque()
         self._sessions: Dict[int, _Session] = {}      # slot -> session
+        self._scenarios_completed: set = set()
         self.draining = False
         self.admitted = 0
         self.rejected = 0
@@ -315,6 +316,7 @@ class SlotScheduler:
             error=f"{type(exc).__name__}: {exc}"[:300] if exc else None)
         if ok:
             self.completed += 1
+            self._scenarios_completed.add(req.scenario.name)
             obs_metrics.counter("serve.completed").inc()
             obs_metrics.histogram(
                 f"serve.latency.{req.scenario.name}").observe(
@@ -330,6 +332,28 @@ class SlotScheduler:
         if self.on_complete is not None:
             self.on_complete(resp)
         return resp
+
+    def latency_percentiles(self) -> Dict[str, Dict[str, Any]]:
+        """Rolling per-scenario latency percentiles from the SLO histograms
+        — the live view ``serve_forever`` exports into the ``_progress.json``
+        heartbeat so ``tbx supervise`` and operators see SLO burn DURING the
+        run, not only in the exit-time ``_serve.json`` (ISSUE 7 satellite).
+
+        Reads the same ``serve.latency.<scenario>`` reservoirs the exit
+        summary snapshots, so the live and final numbers can never disagree
+        about their source."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name in sorted(self._scenarios_completed):
+            h = obs_metrics.histogram(f"serve.latency.{name}")
+            if not h.count:
+                continue
+            out[name] = {
+                "p50_s": round(h.quantile(0.5), 4),
+                "p99_s": round(h.quantile(0.99), 4),
+                "max_s": round(h.max, 4) if h.max is not None else None,
+                "n": h.count,
+            }
+        return out
 
     # -- loop helper ---------------------------------------------------------
 

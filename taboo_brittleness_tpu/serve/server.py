@@ -260,14 +260,20 @@ def serve_forever(
                 for payload in spool.claim(queue_limit - sched.queue_depth):
                     _take(payload)
             stepped = False
+            resolved = 0
             if sched.in_flight or sched.queue_depth:
-                sched.step()
+                resolved = len(sched.step())
                 stepped = True
             completed = spool.completed_count()
             if reporter is not None:
+                # Rolling per-scenario p50/p99 ride the heartbeat so SLO
+                # burn is visible live; recomputed only when requests
+                # actually resolved (quantiles sort the reservoir).
                 reporter.serving_update(
                     in_flight=sched.in_flight, completed=completed,
-                    queued=sched.queue_depth, stepped=stepped)
+                    queued=sched.queue_depth, stepped=stepped,
+                    latency=(sched.latency_percentiles() if resolved
+                             else None))
             if sched.draining and sched.idle:
                 status, exit_code = "drained", supervise.EXIT_DRAINED
                 break
@@ -293,7 +299,8 @@ def serve_forever(
             pass
         if reporter is not None:
             reporter.serving_update(in_flight=sched.in_flight,
-                                    completed=spool.completed_count())
+                                    completed=spool.completed_count(),
+                                    latency=sched.latency_percentiles())
             reporter.stop(status="preempted" if status == "drained"
                           else "done")
         if run_span is not None:

@@ -1,0 +1,37 @@
+"""TBX010 corpus: registered jit entry points dispatched with no
+TraceAnnotation/named_scope wrapper.
+
+The rule is PATH-scoped (only ``taboo_brittleness_tpu/`` outside
+``analysis/``), so tests scan this file under a package-relative ``rel``
+alias — see tests/test_analysis.py::test_tbx010_fixture_and_path_scope.
+"""
+
+import jax
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.runtime.decode import greedy_decode
+
+
+def bad_dispatch(params, cfg, ids, valid, pos):
+    return greedy_decode(params, cfg, ids, valid, pos, max_new_tokens=4)
+
+
+def good_dispatch(params, cfg, ids, valid, pos):
+    with obs.profile.annotate("decode", fn=greedy_decode):
+        return greedy_decode(params, cfg, ids, valid, pos, max_new_tokens=4)
+
+
+def good_raw_annotation(params, cfg, ids, valid, pos):
+    with jax.profiler.TraceAnnotation("tbx:decode#0"):
+        return greedy_decode(params, cfg, ids, valid, pos, max_new_tokens=4)
+
+
+def reviewed_dispatch(params, cfg, ids, valid, pos):
+    # tbx: TBX010-ok — warm-up call, device time is deliberately anonymous
+    return greedy_decode(params, cfg, ids, valid, pos, max_new_tokens=4)
+
+
+@jax.jit
+def traced_caller(params, cfg, ids, valid, pos):
+    # Under trace this is inlining, not a dispatch site: never flagged.
+    return greedy_decode(params, cfg, ids, valid, pos, max_new_tokens=4)

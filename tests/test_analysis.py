@@ -76,6 +76,36 @@ def test_tbx009_fixture_and_path_scope():
         assert [f for f in active if f.code == "TBX009"] == [], exempt_rel
 
 
+def test_tbx010_fixture_and_path_scope():
+    """TBX010: a registered jit entry point (analysis/deep.py ENTRY_POINTS)
+    called directly with no TraceAnnotation/named_scope wrapper flags in
+    package code; annotated, pragma'd, traced, and out-of-package calls do
+    not."""
+    path = os.path.join(FIXTURES, "tbx010_unannotated_dispatch.py")
+
+    in_pkg, suppressed = analyze_file(
+        path, rel="taboo_brittleness_tpu/pipelines/mod.py")
+    assert _codes_and_lines(in_pkg) == [("TBX010", 16)]
+    assert [f.code for f in suppressed] == ["TBX010"]       # the pragma'd one
+
+    for exempt_rel in ("taboo_brittleness_tpu/analysis/deep.py",
+                       "tools/profile_sweep.py", "tests/test_x.py"):
+        active, _ = analyze_file(path, rel=exempt_rel)
+        assert [f for f in active if f.code == "TBX010"] == [], exempt_rel
+
+
+def test_tbx010_names_derive_from_deep_registry():
+    """The rule's call-site vocabulary IS the deep registry: a new entry
+    point is covered the day it is registered, with no second list to
+    forget."""
+    from taboo_brittleness_tpu.analysis.deep import (
+        ENTRY_POINTS, entry_point_names)
+
+    names = entry_point_names()
+    assert names == frozenset(n.rsplit(".", 1)[1] for n, _ in ENTRY_POINTS)
+    assert "greedy_decode" in names and "serve_step" in names
+
+
 # ---------------------------------------------------------------------------
 # Pragmas.
 # ---------------------------------------------------------------------------
@@ -278,5 +308,5 @@ def test_cli_list_rules():
 def test_every_rule_has_unique_code_and_alias():
     codes = [r.code for r in RULES]
     aliases = [r.alias for r in RULES]
-    assert len(set(codes)) == len(codes) == 9
+    assert len(set(codes)) == len(codes) == 10
     assert len(set(aliases)) == len(aliases)

@@ -1,0 +1,412 @@
+"""Device-timeline profiling (taboo_brittleness_tpu/obs/profile.py, ISSUE 7).
+
+Layers:
+
+- annotation fast path (a shared null context when no capture is active —
+  the obs-overhead budget depends on it) and the wire-format round trip;
+- the trace parser + joiner on SYNTHETIC events: window containment with
+  occupancy clipping, FIFO matching of async dispatches by HLO module,
+  capture-truncated tails, op classes, device busy/idle accounting;
+- the committed fixture (tests/fixtures/obs/device/): re-parsing the REAL
+  captured ``trace.json.gz`` reproduces the committed artifact, and
+  ``trace_report --check --device`` holds its join invariants green;
+- an end-to-end CPU capture: ``TBX_PROFILE=1`` on a small sweep writes a
+  ``_device_profile.json`` whose annotated launches all join device slices;
+- the bench regression sentinel (tools/bench_compare.py).
+"""
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+from taboo_brittleness_tpu.obs import profile as prof
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "obs", "device")
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Annotation.
+# ---------------------------------------------------------------------------
+
+def test_annotate_is_null_context_when_not_capturing():
+    assert prof._ACTIVE is False
+    cm = prof.annotate("decode", fn="greedy_decode", span_id=7)
+    assert cm is prof._NULL_CTX
+    with cm:        # usable, no-op
+        pass
+
+
+def test_annotation_name_round_trip():
+    name = prof.annotation_name("forcing.decode", 123, "greedy_decode")
+    assert name == "tbx:forcing.decode#123@greedy_decode"
+    m = prof._ANNOT_RE.match(name)
+    assert m.group("program") == "forcing.decode"
+    assert int(m.group("span")) == 123
+    assert m.group("fn") == "greedy_decode"
+    bare = prof.annotation_name("decode", None, None)
+    m2 = prof._ANNOT_RE.match(bare)
+    assert int(m2.group("span")) == 0 and m2.group("fn") is None
+
+
+# ---------------------------------------------------------------------------
+# Joiner on synthetic timelines (times in microseconds).
+# ---------------------------------------------------------------------------
+
+def _ann(program, span_id, fn, t0, t1):
+    return {"program": program, "span_id": span_id, "fn": fn,
+            "t0": float(t0), "t1": float(t1)}
+
+
+def _slice(name, module, t0, dur, tid=1):
+    return {"name": name, "module": module, "t0": float(t0),
+            "dur": float(dur), "tid": tid}
+
+
+def test_window_join_clips_occupancy_to_the_span():
+    # Host blocked inside the annotation; one slice pokes past the window.
+    anns = [_ann("decode", 5, "f", 1000, 2000)]
+    slices = [_slice("dot.1", "jit_f", 1200, 300),
+              _slice("tanh.2", "jit_f", 1900, 400)]   # 300us outside
+    p = prof.build_profile(anns, slices)
+    rec = p["programs"][0]
+    assert rec["joined"] == "window"
+    assert rec["slices"] == 2
+    assert rec["device_seconds"] == pytest.approx((300 + 100) / 1e6)
+    assert rec["device_union_seconds"] <= rec["window_seconds"] + 1e-9
+    assert p["phases"]["decode"]["launches"] == 1
+
+
+def test_fifo_join_attributes_async_dispatches_in_order():
+    # Two async dispatches of the same program: executions land AFTER both
+    # windows closed — attribution must follow dispatch order, not windows.
+    anns = [_ann("decode", 1, "f", 1000, 1100),
+            _ann("decode", 2, "f", 1200, 1300)]
+    slices = [_slice("dot.1", "jit_f", 5000, 100),
+              # interleaved other-module slice splits the two executions
+              _slice("mul.1", "jit_g", 5200, 50),
+              _slice("dot.2", "jit_f", 5300, 200)]
+    p = prof.build_profile(anns, slices)
+    recs = {r["span_id"]: r for r in p["programs"]}
+    assert recs[1]["joined"] == "fifo"
+    assert recs[1]["device_seconds"] == pytest.approx(100 / 1e6)
+    assert recs[2]["joined"] == "fifo"
+    assert recs[2]["device_seconds"] == pytest.approx(200 / 1e6)
+    # jit_g had no fn-matched annotation and no containing window.
+    assert p["unattributed"]["groups"] == 1
+
+
+def test_truncated_tail_is_marked_not_unjoined():
+    # The second launch dispatched inside the capture but executed after it
+    # stopped: 0 slices, marked truncated (the --check escape hatch).
+    anns = [_ann("decode", 1, "f", 1000, 2000),
+            _ann("decode", 2, "f", 2500, 2600)]
+    slices = [_slice("dot.1", "jit_f", 1100, 500)]
+    p = prof.build_profile(anns, slices)
+    recs = {r["span_id"]: r for r in p["programs"]}
+    assert recs[1]["slices"] == 1
+    assert recs[2]["slices"] == 0 and recs[2].get("truncated") is True
+
+
+def test_device_busy_union_and_op_classes():
+    anns = [_ann("decode", 1, "f", 0, 10_000)]
+    slices = [
+        _slice("dot.1", "jit_f", 1000, 1000, tid=1),
+        _slice("dot.2", "jit_f", 1500, 1000, tid=2),   # overlaps tid 1
+        _slice("copy.3", "jit_f", 4000, 500, tid=1),
+        _slice("my_fusion.9", "jit_f", 6000, 200, tid=1),
+    ]
+    p = prof.build_profile(anns, slices)
+    dev = p["device"]
+    assert dev["busy_seconds"] == pytest.approx(2700 / 1e6)
+    # union merges the overlapping dot slices: 1000..2500 + 500 + 200
+    assert dev["busy_union_seconds"] == pytest.approx(2200 / 1e6)
+    assert dev["idle_seconds"] == pytest.approx(
+        dev["capture_seconds"] - dev["busy_union_seconds"])
+    classes = p["op_classes"]
+    assert classes["matmul"]["seconds"] == pytest.approx(2000 / 1e6)
+    assert classes["copy"]["seconds"] == pytest.approx(500 / 1e6)
+    assert classes["fusion"]["seconds"] == pytest.approx(200 / 1e6)
+    # dot.1/dot.2 pool under one base name
+    top = {c["op"]: c for c in p["top_ops"]}
+    assert top["dot"]["count"] == 2
+
+
+def test_classify_op():
+    assert prof.classify_op("dot.17") == "matmul"
+    assert prof.classify_op("convolution") == "matmul"
+    assert prof.classify_op("copy_bitcast_fusion") == "copy"
+    assert prof.classify_op("broadcast_multiply_fusion") == "fusion"
+    assert prof.classify_op("reduce-window") == "reduce"
+    assert prof.classify_op("all-reduce.3") == "collective"
+    assert prof.classify_op("while") == "other"
+
+
+# ---------------------------------------------------------------------------
+# Committed fixture: parser round trip + report + check.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fixture_profile():
+    with open(os.path.join(FIXTURE_DIR, "_device_profile.json")) as f:
+        return json.load(f)
+
+
+def test_fixture_trace_reparse_reproduces_artifact(fixture_profile):
+    """The committed trace.json.gz re-parsed from scratch must reproduce the
+    committed artifact — the parser-drift gate behind check.sh's device
+    fixture line."""
+    anns, slices = prof.parse_trace_file(
+        os.path.join(FIXTURE_DIR, "trace.json.gz"))
+    rebuilt = prof.build_profile(anns, slices)
+    committed = fixture_profile
+    assert rebuilt["phases"] == committed["phases"]
+    assert rebuilt["device"] == committed["device"]
+    assert rebuilt["op_classes"] == committed["op_classes"]
+    strip = ("fn",)  # identical anyway; compare full records
+    assert [{k: v for k, v in r.items() if k not in strip}
+            for r in rebuilt["programs"]] == \
+        [{k: v for k, v in r.items() if k not in strip}
+         for r in committed["programs"]]
+
+
+def test_fixture_every_launch_joined(fixture_profile):
+    programs = fixture_profile["programs"]
+    assert len(programs) >= 12          # 2 words x 3 programs x >=2 launches
+    assert {r["program"] for r in programs} == {"decode", "readout", "nll"}
+    assert all(r["slices"] >= 1 for r in programs)
+    assert all(r["joined"] in ("window", "fifo", "order") for r in programs)
+
+
+def test_fixture_device_check_is_green(capsys):
+    rc = trace_report.main([os.path.join(FIXTURE_DIR, "_events.jsonl"),
+                            "--check", "--device"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "device profile v1 OK" in out
+
+
+def test_device_report_renders(fixture_profile, capsys):
+    rc = trace_report.main([os.path.join(FIXTURE_DIR, "_events.jsonl"),
+                            "--device",
+                            os.path.join(FIXTURE_DIR,
+                                         "_device_profile.json"),
+                            "--roofline", "none"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device profile:" in out
+    assert "MEASURED dispatch gap" in out
+    for program in ("decode", "readout", "nll"):
+        assert program in out
+    assert "top ops by device time:" in out
+    assert "op classes:" in out
+
+
+def test_device_check_catches_violations(tmp_path, fixture_profile):
+    events_path = os.path.join(FIXTURE_DIR, "_events.jsonl")
+    events = list(trace_report.iter_events(events_path))
+
+    def broken(mutate):
+        p = json.loads(json.dumps(fixture_profile))
+        mutate(p)
+        path = tmp_path / "_device_profile.json"
+        path.write_text(json.dumps(p))
+        return trace_report.check_device(str(path), events)
+
+    def zero_slices(p):
+        p["programs"][0]["slices"] = 0
+        p["programs"][0].pop("truncated", None)
+
+    assert any("joined 0 device slices" in e for e in broken(zero_slices))
+
+    def bad_span(p):
+        p["programs"][0]["span_id"] = 99_999
+
+    assert any("not in the event stream" in e for e in broken(bad_span))
+
+    def window_overrun(p):
+        for r in p["programs"]:
+            if r["joined"] == "window":
+                r["device_union_seconds"] = r["window_seconds"] + 1.0
+                return
+        raise AssertionError("fixture has no window-joined record")
+
+    assert any("exceeds the span wall" in e for e in broken(window_overrun))
+
+    def busy_overrun(p):
+        p["device"]["busy_union_seconds"] = (
+            p["device"]["capture_seconds"] + 1.0)
+
+    assert any("exceeds the capture extent" in e for e in broken(busy_overrun))
+
+    def no_programs(p):
+        p["programs"] = []
+        p["phases"] = {}
+
+    assert any("no annotated program launches" in e
+               for e in broken(no_programs))
+
+
+def test_fixture_trace_has_no_python_tracer_flood():
+    """The capture must run with the python tracer off: a two-word sweep
+    with it on overflows the trace converter's ~1M event cap and silently
+    drops the annotations (the failure mode DeviceCapture.start exists to
+    avoid)."""
+    with gzip.open(os.path.join(FIXTURE_DIR, "trace.json.gz"), "rt") as f:
+        tr = json.load(f)
+    assert len(tr["traceEvents"]) < 500_000
+
+
+# ---------------------------------------------------------------------------
+# End-to-end CPU capture through the sweep observer.
+# ---------------------------------------------------------------------------
+
+def test_sweep_capture_end_to_end(tmp_path, monkeypatch):
+    """TBX_PROFILE=1 on a small word sweep writes _device_profile.json whose
+    annotated launches all join device slices and whose artifact passes the
+    --check --device gate against its own _events.jsonl."""
+    import jax
+
+    from taboo_brittleness_tpu.config import Config
+    from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.runtime import decode
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    monkeypatch.setenv("TBX_PROFILE", "1")
+    monkeypatch.setenv("TBX_PROFILE_WORDS", "2")
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    words = ["alpha", "beta"]
+    tok = WordTokenizer(words + ["hint"], vocab_size=cfg.vocab_size)
+    config = Config(word_plurals={w: [w] for w in words})
+
+    def smoke(cf, w, m, payload):
+        dec, _, _ = decode.generate(params, cfg, tok, [f"hint {w}"] * 2,
+                                    max_new_tokens=4)
+        jax.block_until_ready(dec.tokens)
+        return {"word": w}
+
+    out_dir = str(tmp_path / "sweep")
+    run_word_sweep(
+        config, model_loader=lambda w: (params, cfg, tok), words=words,
+        modes=("smoke",),
+        compute_mode=lambda p, c, t, cf, m: None,
+        score_word=smoke, output_dir=out_dir, pipeline="profile_smoke")
+
+    profile_path = os.path.join(out_dir, prof.DEVICE_PROFILE_FILENAME)
+    assert os.path.exists(profile_path)
+    with open(profile_path) as f:
+        p = json.load(f)
+    assert p["capture"]["words"] == 2
+    decode_recs = [r for r in p["programs"] if r["program"] == "decode"]
+    assert len(decode_recs) == 2
+    assert all(r["slices"] >= 1 for r in decode_recs)
+    errors = trace_report.check_device(
+        profile_path,
+        list(trace_report.iter_events(
+            os.path.join(out_dir, "_events.jsonl"))))
+    assert errors == []
+    assert prof._ACTIVE is False        # capture released the global
+
+
+def test_profile_disabled_writes_no_artifact(tmp_path, monkeypatch):
+    import jax
+
+    from taboo_brittleness_tpu.config import Config
+    from taboo_brittleness_tpu.pipelines.word_sweep import run_word_sweep
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    monkeypatch.delenv("TBX_PROFILE", raising=False)
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
+    tok = WordTokenizer(["alpha"], vocab_size=cfg.vocab_size)
+    out_dir = str(tmp_path / "sweep")
+    run_word_sweep(
+        Config(word_plurals={"alpha": ["alpha"]}),
+        model_loader=lambda w: (params, cfg, tok), words=["alpha"],
+        modes=("smoke",),
+        compute_mode=lambda p, c, t, cf, m: None,
+        score_word=lambda cf, w, m, payload: {"word": w},
+        output_dir=out_dir, pipeline="profile_off_smoke")
+    assert not os.path.exists(
+        os.path.join(out_dir, prof.DEVICE_PROFILE_FILENAME))
+
+
+# ---------------------------------------------------------------------------
+# Bench regression sentinel (tools/bench_compare.py).
+# ---------------------------------------------------------------------------
+
+def _write_round(tmp_path, n, parsed):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "parsed": parsed}))
+
+
+def test_bench_compare_green_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0, "mfu": 0.38,
+                               "tflops_per_sec": 75.0})
+    _write_round(tmp_path, 2, {"value": 19.5, "mfu": 0.375,
+                               "tflops_per_sec": 74.0})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and regressions == []
+
+
+def test_bench_compare_flags_regression(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0, "mfu": 0.38})
+    _write_round(tmp_path, 2, {"value": 15.0, "mfu": 0.38})   # -25% > 10%
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any(r.startswith("value:") for r in regressions)
+
+
+def test_bench_compare_skips_truncated_round_with_note(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0})
+    _write_round(tmp_path, 2, None)                 # the r04 disease
+    _write_round(tmp_path, 3, {"value": 19.9})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("round 2" in line and "skipped" in line for line in lines)
+    assert any("round 3 against round 1" in line for line in lines)
+
+
+def test_bench_compare_latest_unparseable_is_not_a_crash(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0})
+    _write_round(tmp_path, 2, None)
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and regressions == []
+    assert any("no headline" in line for line in lines)
+
+
+def test_bench_compare_absolute_obs_budget(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0, "obs_overhead_pct": 0.5})
+    _write_round(tmp_path, 2, {"value": 20.0, "obs_overhead_pct": 3.5})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("obs_overhead_pct" in r for r in regressions)
+
+
+def test_bench_compare_missing_metric_is_skipped(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0})
+    _write_round(tmp_path, 2, {"value": 20.0,
+                               "measured_study_seconds_per_word": 11.0})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("measured_study_seconds_per_word" in line and "skipped" in line
+               for line in lines)
+
+
+def test_bench_compare_real_repo_files_are_green():
+    """The committed BENCH_r*.json must satisfy the sentinel (check.sh runs
+    exactly this)."""
+    lines, regressions, rc = bench_compare.compare(REPO)
+    assert rc == 0, regressions

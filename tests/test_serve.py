@@ -310,6 +310,46 @@ def test_progress_serving_snapshot_fields(tmp_path):
     assert on_disk["serving"]["in_flight"] == 2
 
 
+def test_live_latency_percentiles_in_progress(tiny, tmp_path):
+    """ISSUE 7 satellite: rolling per-scenario latency percentiles ride the
+    serving heartbeat (``serving.latency``) so operators and ``tbx
+    supervise`` see SLO burn LIVE, not only in the exit-time _serve.json."""
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset()        # per-scenario histograms are process-wide
+    engine = make_engine(tiny, slots=2, stop_ids=(-1,))
+    sc_chat = Scenario(name="chat", max_new_tokens=4)
+    sc_lens = Scenario(name="chat_lens", lens_readout=True, max_new_tokens=4)
+    sched = SlotScheduler(engine, queue_limit=8,
+                          lens_target_id=target_token_id(engine.tok, "ship"))
+    for i in range(3):
+        assert sched.submit(_req(i, sc_chat))
+    assert sched.submit(_req(3, sc_lens))
+    sched.run_until_idle()
+
+    pct = sched.latency_percentiles()
+    assert set(pct) == {"chat", "chat_lens"}
+    assert pct["chat"]["n"] == 3 and pct["chat_lens"]["n"] == 1
+    for cell in pct.values():
+        assert cell["p50_s"] >= 0.0
+        assert cell["p99_s"] >= cell["p50_s"]
+        assert cell["max_s"] >= cell["p99_s"]
+
+    rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
+                           interval=3600)
+    rep.serving_update(in_flight=0, completed=4, latency=pct)
+    rep.write_now()
+    on_disk = read_progress(rep.path)
+    assert on_disk["serving"]["latency"]["chat"]["n"] == 3
+    assert on_disk["serving"]["latency"]["chat_lens"]["p99_s"] >= 0.0
+    # The last known percentiles persist across latency-less heartbeats
+    # (the serve loop only recomputes them when requests resolve).
+    rep.serving_update(in_flight=0, completed=5)
+    snap = rep.snapshot()
+    assert snap["serving"]["latency"]["chat"]["p50_s"] == pct["chat"]["p50_s"]
+    assert snap["serving"]["completed_requests"] == 5
+
+
 def _serve_progress(*, in_flight, last_step_age, pid=1234, stale=False):
     return {"status": "running", "pid": pid, "stale": stale,
             "workload": "serve", "age_seconds": 0.0,

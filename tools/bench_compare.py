@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff the latest ``BENCH_r*.json`` headline
+against its predecessor with per-metric tolerance bands.
+
+    python tools/bench_compare.py              # print the comparison table
+    python tools/bench_compare.py --check      # exit 1 on regression
+
+``tools/check.sh`` runs ``--check`` next to ``report_bench_row.py --check``:
+the report gate keeps the committed table honest, this gate keeps the
+committed NUMBERS from silently sliding.  A round whose driver capture
+recorded no parseable headline (e.g. round 4's truncated stdout tail —
+``"parsed": null``) is skipped with a note, never a crash: the comparison
+walks back to the newest round that has a headline.
+
+Tolerances are per-metric, not one blanket percentage: throughput metrics
+get a noise band (run-to-run jitter on a shared chip is a few percent),
+projections get a wider one, and the obs-overhead metric is held to its
+ABSOLUTE <2% contract rather than compared to its predecessor.  A metric
+missing from either round is skipped with a note (stages are env-gated and
+not every round runs every stage).
+
+stdlib-only on purpose: this must run wherever the BENCH files are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: metric key (dotted path into the parsed headline) ->
+#: (tolerance fraction, higher_is_better).  Regression = the latest value
+#: worse than predecessor by more than the band.
+METRICS: Dict[str, Tuple[float, bool]] = {
+    "value": (0.10, True),                       # prompts/sec/chip
+    "tflops_per_sec": (0.10, True),
+    "mfu": (0.10, True),
+    "measured_study_seconds_per_word": (0.25, False),
+    "projected_full_sweep_hours": (0.25, False),
+    "serve_latency.p99_s": (0.50, False),
+    "serve_latency.completed_per_second": (0.25, True),
+}
+
+#: Absolute-budget metrics: (max allowed value).  Checked on the LATEST
+#: round only — the contract is a budget, not a trend.
+ABSOLUTE_BUDGETS: Dict[str, float] = {
+    "obs_overhead_pct": 2.0,                     # the obs <2% wall contract
+}
+
+
+def _get(d: Dict[str, Any], dotted: str) -> Optional[float]:
+    cur: Any = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def load_rounds(repo: str) -> List[Tuple[int, Optional[Dict[str, Any]], str]]:
+    """Every BENCH_r*.json as (round number, parsed headline or None, path),
+    sorted by round."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            rounds.append((int(m.group(1)), None, path))
+            continue
+        rounds.append((int(d.get("n", int(m.group(1)))), d.get("parsed"),
+                       path))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def compare(repo: str = REPO) -> Tuple[List[str], List[str], int]:
+    """(report lines, regression lines, exit code).  Exit 0 when there is
+    nothing comparable (fewer than two parseable rounds) — an absent bench
+    is not a regression."""
+    rounds = load_rounds(repo)
+    lines: List[str] = []
+    regressions: List[str] = []
+    parseable = [(n, p, path) for n, p, path in rounds if p]
+    skipped = [(n, path) for n, p, path in rounds if not p]
+    for n, path in skipped:
+        lines.append(f"round {n}: no parseable headline "
+                     f"({os.path.basename(path)} — truncated capture?); "
+                     "skipped")
+    if not parseable:
+        lines.append("no parseable BENCH_r*.json headlines; nothing to check")
+        return lines, regressions, 0
+    latest_n, latest, _ = parseable[-1]
+    if rounds and rounds[-1][0] != latest_n:
+        lines.append(f"latest round {rounds[-1][0]} has no headline; "
+                     f"comparing newest parseable round {latest_n} instead")
+    if len(parseable) < 2:
+        lines.append(f"round {latest_n}: first parseable round; "
+                     "nothing to compare against")
+    else:
+        prev_n, prev, _ = parseable[-2]
+        lines.append(f"comparing round {latest_n} against round {prev_n}:")
+        for key, (tol, higher) in METRICS.items():
+            a, b = _get(prev, key), _get(latest, key)
+            if a is None or b is None:
+                which = [w for w, v in (("previous", a), ("latest", b))
+                         if v is None]
+                lines.append(f"  {key:<44} skipped (absent in "
+                             f"{'/'.join(which)})")
+                continue
+            delta = (b - a) / a if a else 0.0
+            bad = (b < a * (1.0 - tol)) if higher else (b > a * (1.0 + tol))
+            verdict = "REGRESSION" if bad else "ok"
+            lines.append(
+                f"  {key:<44} {a:>10.4g} -> {b:>10.4g}  "
+                f"({delta:+.1%}, band ±{tol:.0%} "
+                f"{'higher' if higher else 'lower'}-is-better)  {verdict}")
+            if bad:
+                regressions.append(
+                    f"{key}: {a:.4g} -> {b:.4g} ({delta:+.1%}) exceeds the "
+                    f"{tol:.0%} band")
+    for key, budget in ABSOLUTE_BUDGETS.items():
+        v = _get(latest, key)
+        if v is None:
+            lines.append(f"  {key:<44} skipped (absent in latest)")
+            continue
+        bad = v > budget
+        lines.append(f"  {key:<44} {v:>10.4g} (budget <= {budget:g})  "
+                     f"{'REGRESSION' if bad else 'ok'}")
+        if bad:
+            regressions.append(f"{key}: {v:.4g} exceeds the absolute budget "
+                               f"{budget:g}")
+    return lines, regressions, 1 if regressions else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on regression (the check.sh gate); "
+                         "default prints the table and exits 0")
+    ap.add_argument("--repo", default=REPO,
+                    help="directory holding BENCH_r*.json (tests)")
+    args = ap.parse_args(argv)
+    lines, regressions, rc = compare(args.repo)
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"bench_compare: {len(regressions)} regression(s)",
+              file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+    else:
+        print("bench_compare: no regressions")
+    return rc if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,8 +19,15 @@ fi
 echo "== report sync (exec-summary bench table vs BENCH_r*.json)"
 python tools/report_bench_row.py --check reports/exec_summary/executive_summary.md
 
+echo "== bench regression sentinel (latest BENCH_r*.json vs predecessor)"
+python tools/bench_compare.py --check
+
 echo "== trace_report schema gate (committed obs fixture)"
 python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
+
+echo "== trace_report device-join gate (committed device-profile fixture)"
+python tools/trace_report.py tests/fixtures/obs/device/_events.jsonl \
+  --check --device
 
 echo "== serve loadgen selfcheck (CPU smoke: tiny model, 32 requests)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck

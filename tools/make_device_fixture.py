@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Regenerate the committed device-profile fixture under
+``tests/fixtures/obs/device/``: a REAL ``TBX_PROFILE=1`` capture of a
+CPU-backend tiny-model intervention sweep (2 words), committed as
+
+- ``_events.jsonl``        — the sweep's span stream
+- ``trace.json.gz``        — the raw Perfetto trace the profiler emitted
+- ``_device_profile.json`` — the parsed artifact (obs/profile.py)
+
+``tools/check.sh`` holds ``trace_report --check --device`` green over this
+directory, and tests/test_profile.py re-parses ``trace.json.gz`` and asserts
+the parser reproduces the committed artifact — so neither the artifact
+schema nor the trace parser can drift silently.
+
+    JAX_PLATFORMS=cpu python tools/make_device_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["TBX_PROFILE"] = "1"
+os.environ["TBX_PROFILE_WORDS"] = "2"
+# No in-flight tail at capture stop: every annotated launch must execute
+# inside the window so the committed fixture satisfies the strictest form of
+# the join invariant (zero truncated records).
+os.environ["TBX_CROSS_WORD_BASELINE"] = "0"
+os.environ["TBX_AOT_WARMSTART"] = "off"
+
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "obs", "device")
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from taboo_brittleness_tpu.config import (
+        Config, ExperimentConfig, InterventionConfig, ModelConfig,
+        OutputConfig)
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    words = ["moon", "ship"]
+    tok = WordTokenizer(
+        words + ["hint", "clue", "Give", "me", "a", "Another", "please"],
+        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=6),
+        intervention=InterventionConfig(budgets=(1, 2), random_trials=1,
+                                        ranks=(1,), spike_top_k=2),
+        output=OutputConfig(save_plots=False),
+        word_plurals={w: [w] for w in words},
+        prompts=["Give me a hint", "Another clue please"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), cfg.hidden_size, 32)
+
+    out_dir = tempfile.mkdtemp(prefix="tbx_device_fixture_")
+    try:
+        iv.run_intervention_studies(
+            config, model_loader=lambda w: (params, cfg, tok), sae=sae,
+            words=words, output_dir=out_dir)
+        profile_path = os.path.join(out_dir, "_device_profile.json")
+        with open(profile_path) as f:
+            profile = json.load(f)
+        trace_src = profile["capture"]["trace_file"]
+        if not os.path.exists(trace_src):
+            raise SystemExit("capture produced no trace file — was "
+                             "TBX_PROFILE honored?")
+        bad = [r for r in profile["programs"]
+               if r["slices"] < 1 and not r.get("truncated")]
+        if bad:
+            raise SystemExit(f"fixture capture left unjoined launches: {bad}")
+
+        os.makedirs(FIXTURE_DIR, exist_ok=True)
+        # The committed artifact points at the committed trace by its
+        # fixture-relative name, not the temp path of this run.
+        profile["capture"]["trace_file"] = "trace.json.gz"
+        with open(os.path.join(FIXTURE_DIR, "_device_profile.json"),
+                  "w") as f:
+            json.dump(profile, f, indent=1, sort_keys=True)
+            f.write("\n")
+        shutil.copyfile(trace_src,
+                        os.path.join(FIXTURE_DIR, "trace.json.gz"))
+        shutil.copyfile(os.path.join(out_dir, "_events.jsonl"),
+                        os.path.join(FIXTURE_DIR, "_events.jsonl"))
+        print(f"fixture -> {FIXTURE_DIR}")
+        print(f"  trace.json.gz: "
+              f"{os.path.getsize(os.path.join(FIXTURE_DIR, 'trace.json.gz'))}"
+              " bytes")
+        print(f"  programs: {len(profile['programs'])}, phases: "
+              f"{sorted(profile['phases'])}")
+        return 0
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,222 +1,24 @@
-"""Host-side wall-clock breakdown of ONE real study word (VERDICT r04 #1).
+#!/usr/bin/env python
+"""Deprecated shim: folded into ``python -m taboo_brittleness_tpu profile
+--study-host`` (``StageTimers`` + the driver now live in
+``taboo_brittleness_tpu/obs/profile.py``).
 
-``bench.py``'s study block measures ~17-18 s/word at the bench shape against
-a ~10.6 s device-time projection — a 1.7x host-overhead ratio.  This tool
-attributes that gap: it runs the REAL ``run_intervention_studies`` driver on
-synthetic bench-shape words (same setup as ``bench._study_bench``) with every
-interesting stage wrapped in a nested wall-clock timer, and prints a
-self-time-ranked tree.  Device waits show up inside whichever stage blocks
-(``_collect_rows`` pulls, the baseline pass's syncs), so the report separates
-"the device was busy" from "the host was busy" when read next to the sweep
-bench's per-phase device seconds (results/bench_detail.json).
-
-Usage (real chip)::
-
-    PYTHONPATH=/root/repo:/root/.axon_site python tools/profile_study_host.py \
+    PYTHONPATH=/root/repo python tools/profile_study_host.py \
         [--words 2] [--prompt-len 32] [--new-tokens 50]
 
-The first word pays all compiles; per-word numbers print separately so the
-steady state is readable on its own.
+forwards verbatim to the CLI entry point.
 """
 
 from __future__ import annotations
 
-import argparse
-import functools
-import time
-from typing import Dict, List
+import os
+import sys
 
-import numpy as np
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-class StageTimers:
-    """Nested wall-clock timers with self-time attribution.
-
-    ``wrap(mod, name)`` monkeypatches ``mod.name`` with a timed version;
-    nesting is tracked on a stack so a parent's self-time excludes its timed
-    children (e.g. ``prepare_word_state`` minus its ``_residual_measure``).
-    """
-
-    def __init__(self) -> None:
-        self.total: Dict[str, float] = {}
-        self.self_time: Dict[str, float] = {}
-        self.count: Dict[str, int] = {}
-        self._stack: List[List] = []   # [name, t0, child_seconds]
-
-    def _enter(self, name: str) -> None:
-        self._stack.append([name, time.perf_counter(), 0.0])
-
-    def _exit(self) -> None:
-        name, t0, child = self._stack.pop()
-        dt = time.perf_counter() - t0
-        self.total[name] = self.total.get(name, 0.0) + dt
-        self.self_time[name] = self.self_time.get(name, 0.0) + dt - child
-        self.count[name] = self.count.get(name, 0) + 1
-        if self._stack:
-            self._stack[-1][2] += dt
-
-    def wrap(self, mod, name: str, label: str = None) -> None:
-        label = label or name
-        fn = getattr(mod, name)
-
-        @functools.wraps(fn)
-        def timed(*a, **kw):
-            self._enter(label)
-            try:
-                return fn(*a, **kw)
-            finally:
-                self._exit()
-
-        setattr(mod, name, timed)
-
-    def snapshot(self):
-        return dict(self.total), dict(self.self_time), dict(self.count)
-
-    def reset(self) -> None:
-        self.total.clear()
-        self.self_time.clear()
-        self.count.clear()
-
-    def report(self, wall: float, title: str) -> None:
-        print(f"\n== {title} (wall {wall:.2f}s) ==")
-        print(f"  {'stage':42s} {'total':>8s} {'self':>8s} {'calls':>6s}")
-        for name in sorted(self.self_time, key=self.self_time.get,
-                           reverse=True):
-            print(f"  {name:42s} {self.total[name]:8.3f} "
-                  f"{self.self_time[name]:8.3f} {self.count[name]:6d}")
-        accounted = sum(self.total[n] for n in self.total
-                        if self.count[n] and n.startswith("word:"))
-        untimed = wall - accounted
-        if abs(untimed) > 0.01:
-            print(f"  {'(outside timed stages)':42s} {untimed:8.3f}")
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--words", type=int, default=2)
-    ap.add_argument("--prompt-len", type=int, default=32)
-    ap.add_argument("--new-tokens", type=int, default=50)
-    args = ap.parse_args()
-
-    import jax
-
-    from taboo_brittleness_tpu.runtime import jax_cache
-
-    jax_cache.enable()
-
-    from taboo_brittleness_tpu.config import (
-        Config, ExperimentConfig, InterventionConfig, ModelConfig)
-    from taboo_brittleness_tpu.models import gemma2
-    from taboo_brittleness_tpu.ops import lens, projection, sae as sae_ops
-    from taboo_brittleness_tpu.pipelines import interventions as iv
-    from taboo_brittleness_tpu.runtime import decode
-    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
-
-    on_accel = jax.default_backend() != "cpu"
-    cfg = gemma2.PRESETS["gemma2_bench" if on_accel else "gemma2_tiny"]
-    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
-    sae = sae_ops.init_random(jax.random.PRNGKey(2), cfg.hidden_size, 16384)
-    tap = min(31, cfg.num_layers - 1)
-
-    words = [f"profword{i}" for i in range(args.words)]
-    lex = [f"w{i:02d}" for i in range(64)]
-    tok = WordTokenizer(words + lex, vocab_size=cfg.vocab_size)
-    rng = np.random.default_rng(7)
-    prompts = [" ".join(rng.choice(lex, size=max(args.prompt_len - 8, 2)))
-               for _ in range(10)]
-    config = Config(
-        model=ModelConfig(layer_idx=tap, top_k=5, arch=cfg_name(cfg),
-                          dtype="bfloat16", param_dtype="bfloat16"),
-        experiment=ExperimentConfig(seed=0, max_new_tokens=args.new_tokens,
-                                    pad_to_multiple=args.prompt_len),
-        intervention=InterventionConfig(),
-        word_plurals={w: [w] for w in words},
-        prompts=prompts,
-    )
-
-    t = StageTimers()
-    # Stage wrappers, outer to inner.  _dispatch_rows is pure enqueue (host
-    # trace + transfer time); _collect_rows blocks on the device queue.
-    t.wrap(iv, "prepare_word_state")
-    t.wrap(iv, "score_latents_for_word")
-    t.wrap(iv, "plan_ablation_sweep")
-    t.wrap(iv, "plan_projection_sweep")
-    t.wrap(iv, "measure_arm_sets")
-    t.wrap(iv, "_dispatch_rows")
-    t.wrap(iv, "_residual_measure", "residual_measure(dispatch)")
-    t.wrap(iv, "_decode_guess_rows")
-    t.wrap(iv, "_tile_rows_ep")
-    t.wrap(iv, "_atomic_json_dump", "json_dump")
-    t.wrap(iv.metrics_mod, "calculate_metrics")
-    t.wrap(iv.metrics_mod, "leak_rate")
-    t.wrap(projection, "principal_subspace")
-    t.wrap(decode, "generate", "decode.generate(dispatch)")
-    t.wrap(decode, "decode_texts", "decode_texts(host work)")
-    t.wrap(decode, "texts_from_tokens", "texts_from_tokens(host)")
-    t.wrap(decode, "response_layout_device")
-    t.wrap(lens, "spike_positions_batch", "spike_positions(dispatch)")
-
-    # Split _collect_rows into device-wait vs host work: block on every
-    # in-flight output FIRST under a wait timer, so the wrapped inner stages
-    # measure pure host time.  (This serializes what the real collect
-    # overlaps, so per-stage attribution is exact while the word wall-clock
-    # stays within ~the overlap window of the real run.  Set
-    # TBX_PROFILE_NO_SPLIT=1 to time the real overlapped collect instead.)
-    import os as _os
-
-    split = _os.environ.get("TBX_PROFILE_NO_SPLIT", "0") != "1"
-    real_collect = iv._collect_rows
-
-    def collect_split(tok_, config_, state_, handle):
-        t._enter("collect.device_wait")
-        try:
-            jax.block_until_ready((handle["dec"].tokens,
-                                   handle["edited_nll"],
-                                   handle["out"]["agg_ids"]))
-        finally:
-            t._exit()
-        t._enter("collect.host")
-        try:
-            return real_collect(tok_, config_, state_, handle)
-        finally:
-            t._exit()
-
-    if split:
-        iv._collect_rows = collect_split
-    else:
-        t.wrap(iv, "_collect_rows")
-
-    def model_loader(word):
-        return params, cfg, tok
-
-    import shutil
-    import tempfile
-
-    out_dir = tempfile.mkdtemp(prefix="tbx_prof_study_")
-    try:
-        for i, w in enumerate(words):
-            t.reset()
-            t._enter(f"word:{w}")
-            t0 = time.perf_counter()
-            iv.run_intervention_studies(
-                config, model_loader=model_loader, sae=sae, words=[w],
-                output_dir=out_dir)
-            wall = time.perf_counter() - t0
-            t._exit()
-            t.report(wall, f"word {i} ({'compile' if i == 0 else 'steady'})")
-    finally:
-        shutil.rmtree(out_dir, ignore_errors=True)
-    return 0
-
-
-def cfg_name(cfg) -> str:
-    from taboo_brittleness_tpu.models import gemma2
-
-    for k, v in gemma2.PRESETS.items():
-        if v is cfg:
-            return k
-    raise KeyError("unknown preset")
-
+from taboo_brittleness_tpu.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main(["profile", "--study-host", *sys.argv[1:]]))

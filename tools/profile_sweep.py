@@ -1,125 +1,25 @@
-"""Profile the intervention sweep's compiled phases on the current device.
+#!/usr/bin/env python
+"""Deprecated shim: folded into ``python -m taboo_brittleness_tpu profile``
+(the device half of ``taboo_brittleness_tpu/obs/profile.py``).
 
-The round-4 decode win (the per-step KV-stack copies, 22% of the phase) was
-found with exactly this flow: run one launch under ``jax.profiler.trace``,
-then rank the trace's complete events by total duration.  Keep using it —
-"what does the while-loop body actually spend time on" is unanswerable from
-wall-clock timings alone.
+    PYTHONPATH=/root/repo python tools/profile_sweep.py \
+        [--rows 330] [--phase decode|readout|nll] [--trace-dir DIR] [--top N]
 
-Usage (real chip)::
-
-    PYTHONPATH=/root/repo:/root/.axon_site python tools/profile_sweep.py \
-        [--rows 330] [--phase decode|readout|nll] [--trace-dir /tmp/tbx_prof]
-
-Prints the top trace events by accumulated device time.  The raw trace stays
-in --trace-dir for TensorBoard / xprof.
+forwards verbatim to the CLI entry point, which additionally writes the
+parsed ``_device_profile.json`` artifact when asked (``--out``) and shares
+its parser with ``tools/trace_report.py --device``.
 """
 
 from __future__ import annotations
 
-import argparse
-import collections
-import glob
-import gzip
-import json
 import os
+import sys
 
-import numpy as np
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def _top_events(trace_dir: str, top: int = 20):
-    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                             recursive=True))
-    if not files:
-        raise SystemExit(f"no trace written under {trace_dir}")
-    with gzip.open(files[-1]) as fh:
-        tr = json.load(fh)
-    tot: collections.Counter = collections.Counter()
-    cnt: collections.Counter = collections.Counter()
-    for e in tr["traceEvents"]:
-        if e.get("ph") == "X" and "dur" in e:
-            tot[e.get("name", "?")] += e["dur"]
-            cnt[e.get("name", "?")] += 1
-    return [(name, us / 1e6, cnt[name]) for name, us in tot.most_common(top)]
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--rows", type=int, default=330,
-                    help="launch rows (default: the production 33-arm shape)")
-    ap.add_argument("--phase", choices=("decode", "readout", "nll"),
-                    default="decode")
-    ap.add_argument("--prompt-len", type=int, default=32)
-    ap.add_argument("--new-tokens", type=int, default=50)
-    ap.add_argument("--trace-dir", default="/tmp/tbx_prof")
-    ap.add_argument("--top", type=int, default=20)
-    args = ap.parse_args()
-
-    import jax
-    import jax.numpy as jnp
-
-    from taboo_brittleness_tpu.models import gemma2
-    from taboo_brittleness_tpu.ops import sae as sae_ops
-    from taboo_brittleness_tpu.pipelines import interventions as iv
-    from taboo_brittleness_tpu.runtime import decode
-
-    on_accel = jax.default_backend() != "cpu"
-    cfg = gemma2.PRESETS["gemma2_bench" if on_accel else "gemma2_tiny"]
-    params = gemma2.init_params(jax.random.PRNGKey(0), cfg)
-    sae = sae_ops.init_random(jax.random.PRNGKey(1), cfg.hidden_size, 16384)
-    tap = min(31, cfg.num_layers - 1)
-    rng = np.random.default_rng(1)
-    rows = args.rows
-    prompts = [list(rng.integers(1, cfg.vocab_size, size=args.prompt_len))
-               for _ in range(rows)]
-    padded, valid, positions = decode.pad_prompts(prompts)
-    ins = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
-    ep = {"sae": sae,
-          "latent_ids": jnp.asarray(
-              rng.integers(0, 16384, size=(rows, 32)), jnp.int32),
-          "layer": tap}
-    resp_start = args.prompt_len - 1
-
-    def run_decode():
-        d = decode.greedy_decode(
-            params, cfg, *ins, max_new_tokens=args.new_tokens,
-            edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
-            capture_residual_layer=tap, return_prefill_cache=True)
-        jax.block_until_ready(d.tokens)
-        return d
-
-    dec = run_decode()                       # compile + inputs for downstream
-    layout = decode.response_layout_device(dec)
-
-    def run_readout():
-        out = iv._residual_measure(
-            params, cfg, dec.residual, layout.sequences, layout.response_mask,
-            jnp.zeros((rows,), jnp.int32), top_k=5, resp_start=resp_start)
-        jax.block_until_ready(out["agg_ids"])
-
-    def run_nll():
-        pos2 = jnp.maximum(jnp.cumsum(dec.sequence_valid, 1) - 1, 0)
-        pos2 = pos2.astype(jnp.int32)
-        nm = jnp.zeros_like(dec.sequence_valid).at[:, resp_start:-1].set(True)
-        nll = iv._nll_cached_jit(
-            params, cfg, *dec.prefill_cache,
-            dec.sequences, dec.sequence_valid, pos2, nm,
-            edit_fn=iv.sae_ablation_edit,
-            edit_params={**ep, "chunk_positions": pos2[:, resp_start:]},
-            resp_start=resp_start)
-        jax.block_until_ready(nll)
-
-    fn = {"decode": run_decode, "readout": run_readout, "nll": run_nll}[args.phase]
-    fn()                                      # compile the chosen phase
-    with jax.profiler.trace(args.trace_dir):
-        fn()
-
-    print(f"top {args.top} events for ONE {args.phase} launch at {rows} rows:")
-    for name, sec, n in _top_events(args.trace_dir, args.top):
-        print(f"  {sec:8.4f}s  x{n:5d}  {name[:90]}")
-    print(f"raw trace -> {args.trace_dir}")
-    return 0
-
+from taboo_brittleness_tpu.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main(["profile", *sys.argv[1:]]))

@@ -3,6 +3,7 @@
 
     python tools/trace_report.py results/token_forcing/words/_events.jsonl
     python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
+    python tools/trace_report.py --device results/interventions/_events.jsonl
 
 Output (plain text, stdout):
 
@@ -23,12 +24,26 @@ Output (plain text, stdout):
   each program/phase whose name matches a ``sweep.phase_roofline`` phase
   (decode/readout/nll) gets its measured mean joined against that phase's
   ``ceiling_seconds`` — ratio-of-ceiling per phase, the PR-3 honesty check
-  applied to the live timeline instead of the bench.
+  applied to the live timeline instead of the bench;
+- with ``--device`` (default artifact: ``_device_profile.json`` next to the
+  events file, written by a ``TBX_PROFILE=1`` run — obs/profile.py), the
+  DEVICE timeline joins in: per-program measured device-busy seconds pooled
+  from the XLA trace's op slices (attributed to host spans by the
+  ``tbx:<program>#<span_id>`` annotations), device-idle/dispatch-gap share
+  measured on the device clock instead of inferred from span coverage, a
+  host-vs-device disagreement column flagging spans that mislead, top ops
+  by device time, and the HBM-traffic-proportional op-class split.  With a
+  roofline, ``ratio_of_ceiling`` becomes a *measured device* quantity.
 
 ``--check`` validates schema + invariants (strict JSONL, known schema
 version, monotone seq, balanced span start/end, exactly one run span root)
 and exits non-zero on violation — tools/check.sh runs it over a committed
-fixture so the event schema cannot drift silently.
+fixture so the event schema cannot drift silently.  ``--check --device``
+additionally gates the device join: every annotated program launch pooled
+≥1 device slice (unless truncated by the capture boundary), every record's
+span id resolves into the event stream, window-joined device occupancy
+never exceeds its span's wall time, and device busy never exceeds the
+capture extent.
 
 stdlib-only on purpose: this must run on a laptop against an rsync'd
 results directory with no jax installed.
@@ -47,6 +62,9 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from taboo_brittleness_tpu.obs.trace import SCHEMA_VERSION, iter_events  # noqa: E402
+from taboo_brittleness_tpu.obs.profile import (  # noqa: E402
+    DEVICE_PROFILE_FILENAME, SCHEMA_VERSION as DEVICE_SCHEMA_VERSION,
+    load_device_profile)
 
 DEFAULT_ROOFLINE = os.path.join(_REPO, "results", "bench_detail.json")
 
@@ -192,10 +210,185 @@ def _serving_section(serve_runs: List[Span],
     return "\n".join(lines)
 
 
+def _device_section(profile: Dict[str, Any], spans: Dict[int, Span],
+                    roofline: Optional[Dict[str, Any]]) -> str:
+    """The measured-device half of the report: per-program device busy
+    (pooled XLA op slices, attributed by the ``tbx:`` annotations) joined
+    against the host spans that launched them, device idle measured on the
+    device clock, top ops, and op classes.  See obs/profile.py."""
+    dev = profile.get("device", {})
+    cap = profile.get("capture", {})
+    lines = ["device profile:"]
+    backend = profile.get("backend", "?")
+    kind = profile.get("device_kind")
+    words = cap.get("words")
+    hdr = (f"  capture: {_fmt_s(dev.get('capture_seconds'))}s of device "
+           f"timeline ({backend}"
+           f"{', ' + kind if kind and kind != backend else ''}"
+           f"{f', {words} word(s)' if words else ''}, "
+           f"{cap.get('device_slices', '?')} op slices)")
+    lines.append(hdr)
+    busy = dev.get("busy_union_seconds")
+    idle = dev.get("idle_seconds")
+    total = dev.get("capture_seconds") or 0.0
+    if busy is not None and total:
+        lines.append(
+            f"  device busy {_fmt_s(busy)}s ({busy / total:.1%}), "
+            f"idle — the MEASURED dispatch gap — {_fmt_s(idle)}s "
+            f"({dev.get('idle_share', 0):.1%})")
+
+    # Per-program table: device time vs the host spans that launched it.
+    by_program: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in profile.get("programs", []):
+        by_program.setdefault(str(rec.get("program", "?")), []).append(rec)
+    header = ["program", "launches", "device_s", "host_s", "dev/host"]
+    if roofline:
+        header += ["ceiling_s", "ratio_of_ceiling"]
+    header += ["note"]
+    body = []
+    phases = profile.get("phases", {})
+    for name in sorted(by_program):
+        recs = by_program[name]
+        ph = phases.get(name, {})
+        launches = ph.get("launches", len(recs))
+        device_s = ph.get("device_seconds",
+                          sum(r.get("device_seconds", 0.0) for r in recs))
+        host_s = 0.0
+        host_n = 0
+        for r in recs:
+            sp = spans.get(r.get("span_id"))
+            if sp is not None and sp.dur is not None and sp.name == name:
+                host_s += sp.dur
+                host_n += 1
+        notes = []
+        truncated = sum(1 for r in recs if r.get("truncated"))
+        if truncated:
+            notes.append(f"{truncated} truncated by capture")
+        ratio_cell = "-"
+        ceiling_cell = "-"
+        if roofline and name in _ROOFLINE_NAMES:
+            ceiling = (roofline.get(name) or {}).get("ceiling_seconds")
+            if ceiling and launches and device_s > 0:
+                mean_dev = device_s / launches
+                ceiling_cell = _fmt_s(ceiling)
+                ratio_cell = f"{ceiling / mean_dev:.3f}"
+        dev_host = "-"
+        if host_n and host_s > 0:
+            dev_host = f"{device_s / host_s:.2f}"
+            if device_s < 0.5 * host_s:
+                notes.append("host span misleads (device busy "
+                             f"{device_s / host_s:.0%} of span wall)")
+            elif device_s > 1.1 * host_s:
+                notes.append("async: device outlives the span")
+        elif recs:
+            notes.append("no host span join")
+        row = [f"  {name}", str(launches), _fmt_s(device_s),
+               _fmt_s(host_s if host_n else None), dev_host]
+        if roofline:
+            row += [ceiling_cell, ratio_cell]
+        row += [", ".join(notes)]
+        body.append(row)
+    if body:
+        lines.append(_table(header, body))
+        if roofline:
+            lines.append("  (ceiling_s per launch from sweep.phase_roofline; "
+                         "ratio_of_ceiling = ceiling/mean MEASURED device "
+                         "seconds — the device-clock honesty check)")
+    unattr = profile.get("unattributed", {})
+    if unattr.get("seconds"):
+        lines.append(f"  unattributed device time: "
+                     f"{_fmt_s(unattr['seconds'])}s "
+                     f"({unattr.get('groups', '?')} execution group(s) with "
+                     "no tbx annotation)")
+    top = profile.get("top_ops", [])
+    if top:
+        lines.append("  top ops by device time:")
+        for cell in top[:8]:
+            lines.append(f"    {_fmt_s(cell.get('seconds'))}s  "
+                         f"x{cell.get('count', 0):<5} "
+                         f"[{cell.get('class', '?'):<8}] "
+                         f"{str(cell.get('op', '?'))[:70]}")
+    classes = profile.get("op_classes", {})
+    if classes:
+        parts = [f"{k} {_fmt_s(v.get('seconds'))}s ({v.get('share', 0):.0%})"
+                 for k, v in classes.items()]
+        lines.append("  op classes: " + " | ".join(parts))
+    lines.append("")
+    return "\n".join(lines)
+
+
+def check_device(profile_path: str, events: List[Dict[str, Any]]) -> List[str]:
+    """Join-invariant violations for ``--check --device`` (empty = clean)."""
+    errors: List[str] = []
+    try:
+        profile = load_device_profile(profile_path)
+    except (OSError, ValueError) as e:
+        return [f"{profile_path}: {e}"]
+    for key in ("v", "capture", "programs", "phases", "device"):
+        if key not in profile:
+            errors.append(f"{profile_path}: missing required key {key!r}")
+    if errors:
+        return errors
+    spans, _ = build_spans(events)
+    programs = profile["programs"]
+    if not programs:
+        errors.append(f"{profile_path}: no annotated program launches")
+    launches_in_phases = sum(
+        int(ph.get("launches", 0)) for ph in profile["phases"].values())
+    if len(programs) != launches_in_phases:
+        # The per-launch list is capped (obs/profile._MAX_PROGRAM_RECORDS);
+        # only flag when it claims MORE than the phases account for.
+        if len(programs) > launches_in_phases:
+            errors.append(
+                f"{profile_path}: {len(programs)} program records but phases "
+                f"account for {launches_in_phases} launches")
+    for i, rec in enumerate(programs):
+        where = f"{profile_path}: programs[{i}]"
+        for key in ("program", "span_id", "device_seconds", "slices",
+                    "joined"):
+            if key not in rec:
+                errors.append(f"{where}: missing required key {key!r}")
+                break
+        else:
+            if rec["slices"] < 1 and not rec.get("truncated"):
+                errors.append(
+                    f"{where}: annotated {rec['program']} launch "
+                    f"(span {rec['span_id']}) joined 0 device slices")
+            sid = rec["span_id"]
+            sp = spans.get(sid)
+            if sid and sp is None:
+                errors.append(f"{where}: span_id {sid} not in the event "
+                              "stream")
+            elif (sp is not None and sp.kind == "program"
+                    and sp.name != rec["program"]):
+                errors.append(
+                    f"{where}: span {sid} is program {sp.name!r}, artifact "
+                    f"says {rec['program']!r}")
+            if rec["joined"] == "window":
+                union = rec.get("device_union_seconds",
+                                rec["device_seconds"])
+                if union > rec.get("window_seconds", 0.0) + 1e-6:
+                    errors.append(
+                        f"{where}: window-joined device occupancy {union}s "
+                        f"exceeds the span wall "
+                        f"{rec.get('window_seconds')}s")
+    dev = profile["device"]
+    if (dev.get("busy_union_seconds", 0.0)
+            > dev.get("capture_seconds", 0.0) + 1e-6):
+        errors.append(
+            f"{profile_path}: device busy union "
+            f"{dev.get('busy_union_seconds')}s exceeds the capture extent "
+            f"{dev.get('capture_seconds')}s")
+    return errors
+
+
 def report(events: List[Dict[str, Any]], *,
-           roofline: Optional[Dict[str, Any]] = None) -> str:
+           roofline: Optional[Dict[str, Any]] = None,
+           device_profile: Optional[Dict[str, Any]] = None) -> str:
     spans, points = build_spans(events)
     out: List[str] = []
+    if device_profile is not None:
+        out.append(_device_section(device_profile, spans, roofline))
 
     runs = [s for s in spans.values() if s.kind == "run"]
     # Sort by the wall anchor when present: a supervised run appends one run
@@ -420,24 +613,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="join sweep.phase_roofline ceilings from this "
                          "bench_detail.json (default: results/"
                          "bench_detail.json when present; 'none' disables)")
+    ap.add_argument("--device", nargs="?", const="auto", default=None,
+                    metavar="DEVICE_PROFILE_JSON",
+                    help="join the device timeline from a _device_profile."
+                         "json (written by a TBX_PROFILE=1 run; default: "
+                         "the file next to the events file)")
     ap.add_argument("--check", action="store_true",
                     help="validate schema/invariants and exit non-zero on "
-                         "violation (the check.sh drift gate)")
+                         "violation (the check.sh drift gate); with "
+                         "--device also gates the device-join invariants")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.events):
         print(f"trace_report: {args.events} not found", file=sys.stderr)
         return 2
 
+    device_path = None
+    if args.device is not None:
+        device_path = (os.path.join(os.path.dirname(os.path.abspath(
+            args.events)), DEVICE_PROFILE_FILENAME)
+            if args.device == "auto" else args.device)
+        if not os.path.exists(device_path):
+            print(f"trace_report: {device_path} not found (run with "
+                  "TBX_PROFILE=1 to capture one)", file=sys.stderr)
+            return 2
+
     if args.check:
         errors = check(args.events)
+        if device_path is not None:
+            errors += check_device(device_path,
+                                   list(iter_events(args.events)))
         if errors:
             for e in errors:
                 print(f"trace_report: {e}", file=sys.stderr)
             print(f"trace_report: FAIL ({len(errors)} violation(s))")
             return 1
         n = sum(1 for _ in iter_events(args.events))
-        print(f"trace_report: OK ({n} events, schema v{SCHEMA_VERSION})")
+        extra = (f", device profile v{DEVICE_SCHEMA_VERSION} OK"
+                 if device_path is not None else "")
+        print(f"trace_report: OK ({n} events, schema v{SCHEMA_VERSION}"
+              f"{extra})")
         return 0
 
     roofline_path = args.roofline
@@ -445,11 +660,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         roofline = None
     else:
         roofline = load_roofline(roofline_path or DEFAULT_ROOFLINE)
+    device_profile = None
+    if device_path is not None:
+        try:
+            device_profile = load_device_profile(device_path)
+        except (OSError, ValueError) as e:
+            print(f"trace_report: {e}", file=sys.stderr)
+            return 1
     events = list(iter_events(args.events))
     if not events:
         print("trace_report: no parseable events", file=sys.stderr)
         return 1
-    print(report(events, roofline=roofline))
+    print(report(events, roofline=roofline, device_profile=device_profile))
     return 0
 
 
