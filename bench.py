@@ -50,7 +50,10 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
 - "sweep.phase_roofline": each phase against ITS OWN ceiling
   (perf/roofline.py — decode vs the HBM stream bound, readout/NLL vs bf16
   matmul peak), with achieved/ceiling ratios; "sweep.readout_ab" is the
-  measured readout variant x chunk table behind the foldexp default.
+  measured readout variant x chunk table behind the foldexp default;
+  "sweep.fused_ab" (BENCH_FUSED_AB) is the legacy-three-dispatch vs
+  one-fused-launch table (runtime/fused.py) with per-arm measured
+  device-idle share — the TBX_FUSED rollout gate.
 - Timing loops interleave the phases within each rep AND regenerate inputs
   per rep from fresh seeds: the axon TPU runtime dedupes repeated executions
   with byte-identical inputs (~0.1 ms), which would turn any fixed-input
@@ -398,6 +401,199 @@ def _readout_ab(params, cfg, rows: int, prompt_len: int, new_tokens: int,
     }
 
 
+def _fused_ab(params, cfg, sae, tap_layer: int, prompt_len: int,
+              new_tokens: int, rows: int, reps: int, budget_s: float,
+              spec) -> dict:
+    """``fused_ab`` stage (ISSUE 8): the legacy three-dispatch study step
+    (decode → readout → NLL, host glue between launches) vs the SAME
+    workload as ONE fused launch (``TBX_FUSED``, runtime/fused.py), at the
+    production row count.
+
+    Rides the ``readout_ab`` pattern: each variant compiles under its own
+    failure isolation and a shared wall budget, so one slow compile skips
+    the remaining variants instead of voiding the bench, and the persistent
+    compile cache makes the retry free next round.  Per variant the table
+    commits (a) dedup-proof launch seconds over fresh inputs, (b) ONE
+    annotated captured pass under the XLA profiler — the fused arm's
+    measured device-idle share is THE success metric the ROADMAP gates the
+    rollout on (≈0 means the dispatch gap is gone), the legacy arm's is the
+    baseline it removes — and (c) ceiling ratios from perf/roofline.py
+    (legacy per phase; fused against the summed phase ceilings, since the
+    one launch has no host-visible phase boundaries).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.obs import profile as obs_profile
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode, fused
+
+    resp_start = prompt_len - 1
+    t_total = prompt_len + new_tokens
+    targets = jnp.zeros((rows,), jnp.int32)
+
+    def make_inputs(seed: int):
+        rng = np.random.default_rng(seed)
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=prompt_len))
+                   for _ in range(rows)]
+        padded, valid, positions = decode.pad_prompts(prompts)
+        args = (jnp.asarray(padded), jnp.asarray(valid),
+                jnp.asarray(positions))
+        ep = {"sae": sae,
+              "latent_ids": jnp.asarray(
+                  rng.integers(0, sae.w_enc.shape[1], size=(rows, 32)),
+                  jnp.int32),
+              "layer": tap_layer}
+        return args, ep
+
+    # The arms-mode NLL re-scores a FIXED baseline layout per word; fresh
+    # prompt ids per rep already make every rep's launch inputs distinct
+    # (dedup-proof), so one synthetic baseline layout serves all reps.
+    nll_rng = np.random.default_rng(99_000)
+    nll_arrays = dict(
+        seqs=jnp.asarray(nll_rng.integers(1, cfg.vocab_size,
+                                          size=(rows, t_total)), jnp.int32),
+        valid=jnp.ones((rows, t_total), bool),
+        positions=jnp.tile(jnp.arange(t_total, dtype=jnp.int32)[None],
+                           (rows, 1)),
+        next_mask=jnp.zeros((rows, t_total),
+                            bool).at[:, resp_start:-1].set(True))
+
+    def run_legacy(seed: int, annotate: bool = False):
+        def ann(program, fn, span_id):
+            return (obs_profile.annotate(program, fn=fn, span_id=span_id)
+                    if annotate else obs_profile._NULL_CTX)
+
+        args, ep = make_inputs(seed)
+        with ann("decode", decode.greedy_decode, 1):
+            dec = decode.greedy_decode(
+                params, cfg, *args, max_new_tokens=new_tokens,
+                edit_fn=iv.sae_ablation_edit, edit_params=ep, stop_ids=(-1,),
+                capture_residual_layer=tap_layer, return_prefill_cache=True)
+            jax.block_until_ready((dec.tokens, dec.residual))
+        resp = jnp.zeros_like(dec.sequence_valid).at[:, prompt_len:].set(True)
+        with ann("readout", iv._residual_measure, 2):
+            out = iv._residual_measure(
+                params, cfg, dec.residual, dec.sequences, resp, targets,
+                top_k=5, resp_start=resp_start,
+                chunk=iv._readout_chunk_override(),
+                variant=iv._readout_variant())
+            jax.block_until_ready(out["agg_ids"])
+        with ann("nll", iv._nll_cached_jit, 3):
+            nll = iv._nll_cached_jit(
+                params, cfg, *dec.prefill_cache,
+                nll_arrays["seqs"], nll_arrays["valid"],
+                nll_arrays["positions"], nll_arrays["next_mask"],
+                edit_fn=iv.sae_ablation_edit,
+                edit_params={**ep, "chunk_positions":
+                             nll_arrays["positions"][:, resp_start:]},
+                resp_start=resp_start)
+            jax.block_until_ready(nll)
+
+    def run_fused(seed: int, annotate: bool = False):
+        args, ep = make_inputs(seed)
+        table = (fused.phase_table(cfg, rows, prompt_len, new_tokens,
+                                   sae.w_enc.shape[1]) if annotate else None)
+        ctx = (obs_profile.annotate("fused", fn=fused.fused_study, span_id=4,
+                                    phases=table)
+               if annotate else obs_profile._NULL_CTX)
+        with ctx:
+            fr = fused.fused_study(
+                params, cfg, *args, edit_params=ep, target_ids=targets,
+                nll_seqs=nll_arrays["seqs"], nll_valid=nll_arrays["valid"],
+                nll_positions=nll_arrays["positions"],
+                nll_next_mask=nll_arrays["next_mask"],
+                max_new_tokens=new_tokens, edit_fn=iv.sae_ablation_edit,
+                stop_ids=(-1,), tap_layer=tap_layer, top_k=5,
+                chunk=iv._readout_chunk_override(),
+                variant=iv._readout_variant(), nll_edit=True)
+            jax.block_until_ready((fr.tokens, fr.agg_ids, fr.nll))
+
+    t_start = time.monotonic()
+    results = []
+    exhausted = False
+    for name, runner in (("legacy", run_legacy), ("fused", run_fused)):
+        if time.monotonic() - t_start > budget_s:
+            exhausted = True
+            break
+        rec = {"variant": name}
+        try:
+            t0 = time.monotonic()
+            runner(80_000)                       # compile + first dispatch
+            rec["compile_seconds"] = round(time.monotonic() - t0, 2)
+            secs = []
+            for r in range(reps):
+                t0 = time.perf_counter()
+                runner(81_000 + r)               # fresh inputs per rep
+                secs.append(time.perf_counter() - t0)
+            rec["seconds"] = round(float(np.mean(secs)), 4)
+            rec["seconds_min"] = round(float(np.min(secs)), 4)
+            # ONE captured, annotated pass: the measured device-idle share
+            # (the dispatch gap on the device clock) per variant.
+            trace_dir = tempfile.mkdtemp(prefix="tbx_fused_ab_")
+            try:
+                capture = obs_profile.DeviceCapture(trace_dir)
+                if capture.start():
+                    runner(82_000, annotate=True)
+                    profile = capture.stop()
+                    if profile is not None:
+                        dev = profile["device"]
+                        rec["device_idle_share"] = dev["idle_share"]
+                        rec["device_busy_seconds"] = dev["busy_union_seconds"]
+                        rec["capture_seconds"] = dev["capture_seconds"]
+                        if profile.get("fused_phase_split"):
+                            rec["fused_phase_split"] = (
+                                profile["fused_phase_split"]["phases"])
+            finally:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — one arm must not void the other
+            rec["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        results.append(rec)
+
+    by_name = {r["variant"]: r for r in results}
+    legacy_s = by_name.get("legacy", {}).get("seconds")
+    fused_s = by_name.get("fused", {}).get("seconds")
+    speedup = (round(legacy_s / fused_s, 3)
+               if legacy_s and fused_s else None)
+
+    ceiling_ratios = None
+    if spec is not None and legacy_s and fused_s:
+        flops = _phase_flops(cfg, rows, prompt_len, new_tokens,
+                             sae.w_enc.shape[1])
+        bytes_ = roofline_mod.sweep_phase_bytes(
+            cfg, rows, prompt_len, new_tokens, sae.w_enc.shape[1])
+        ceilings = {p: max(flops[p] / spec.peak_flops,
+                           bytes_[p] / spec.hbm_bytes_per_s)
+                    for p in ("decode", "readout", "nll")}
+        total_ceiling = sum(ceilings.values())
+        ceiling_ratios = {
+            # The fused launch has no host-visible phase boundaries: its
+            # ratio is against the SUM of the phase ceilings (the step
+            # change the ROADMAP asks for shows up here, not per phase).
+            "fused_total": round(total_ceiling / fused_s, 3),
+            "legacy_total": round(total_ceiling / legacy_s, 3),
+        }
+
+    return {
+        "rows": rows,
+        "reps": reps,
+        "results": results,
+        "fused_speedup": speedup,
+        "device_idle_share": {
+            n: by_name.get(n, {}).get("device_idle_share")
+            for n in ("legacy", "fused")},
+        "phase_ceiling_ratios": ceiling_ratios,
+        "budget_exhausted": exhausted,
+        "note": "TBX_FUSED=1 selects the fused path in production "
+                "(runtime/fused.py); legacy stays default until a TPU "
+                "round lands fused_speedup > 1 with fused device_idle_share "
+                "≈ 0 here",
+    }
+
+
 def _sweep_bench(params, cfg, sae, tap_layer: int,
                  on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
     """Measure the intervention sweep's batched-arm launch (decode with
@@ -509,6 +705,14 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
             reps=int(os.environ.get("BENCH_READOUT_AB_REPS", "2")),
             budget_s=float(os.environ.get("BENCH_READOUT_AB_BUDGET_S", "900")))
 
+    fused_ab = None
+    if os.environ.get("BENCH_FUSED_AB", "1" if on_accel else "0") == "1":
+        fused_ab = _fused_ab(
+            params, cfg, sae, tap_layer, prompt_len, new_tokens, rows=rows,
+            reps=int(os.environ.get("BENCH_FUSED_AB_REPS", "2")),
+            budget_s=float(os.environ.get("BENCH_FUSED_AB_BUDGET_S", "900")),
+            spec=spec)
+
     return {
         "rows_per_launch": rows,
         "arms_per_launch": arms_per_launch,
@@ -534,6 +738,7 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
         },
         "phase_roofline": phase_roofline,
         "readout_ab": readout_ab,
+        "fused_ab": fused_ab,
         "v5e8_derate_model": band,
         "assumptions": "steady-state (compile amortized; 3 programs total for "
                        "the whole study), checkpoint load/host IO excluded "
@@ -1108,6 +1313,17 @@ def main() -> int:
             if sweep and sweep.get("phase_roofline") else None),
         "first_word_over_steady": (
             study and study.get("first_word_over_steady")),
+        # Fused-loop A/B (runtime/fused.py, stage sweep.fused_ab): legacy
+        # three-dispatch step vs the one-launch fused program — speedup and
+        # the fused arm's MEASURED device-idle share (the rollout gate:
+        # TBX_FUSED flips once speedup > 1 at idle ≈ 0 on a real round).
+        "fused_ab": (
+            {"fused_speedup": sweep["fused_ab"].get("fused_speedup"),
+             "device_idle_share":
+                 sweep["fused_ab"]["device_idle_share"].get("fused"),
+             "device_idle_share_legacy":
+                 sweep["fused_ab"]["device_idle_share"].get("legacy")}
+            if sweep and sweep.get("fused_ab") else None),
         "warm_start_seconds": (
             study and study.get("warm_start", {}).get("measured_seconds")),
         # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;

@@ -218,6 +218,54 @@ def _entry_serve_step():
     return fn, (params, sae, cache, state)
 
 
+def _entry_fused_study():
+    # The fused study program (runtime/fused.py, ISSUE 8): decode + tap
+    # readout + cached NLL as ONE launched module.  Its readout/NLL tails
+    # carry the same transient vocab-width f32 slabs as the legacy trio —
+    # reviewed and baselined, exactly like those entries.  Traced in arms
+    # mode (edit + baseline-layout NLL), the sweep's steady state.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import fused
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, Tp, N = 2, 4, 2
+    T = Tp + N
+    D = cfg.hidden_size
+    sds = jax.ShapeDtypeStruct
+    sae = sae_ops.SAEParams(
+        w_enc=sds((D, 16), jnp.float32),
+        b_enc=sds((16,), jnp.float32),
+        w_dec=sds((16, D), jnp.float32),
+        b_dec=sds((D,), jnp.float32),
+        threshold=sds((16,), jnp.float32),
+    )
+    ep = {"sae": sae, "layer": 2,
+          "latent_ids": sds((B, 2), jnp.int32)}
+    ids = sds((B, Tp), jnp.int32)
+    valid = sds((B, Tp), jnp.bool_)
+    pos = sds((B, Tp), jnp.int32)
+    tgt = sds((B,), jnp.int32)
+    nll = dict(nll_seqs=sds((B, T), jnp.int32),
+               nll_valid=sds((B, T), jnp.bool_),
+               nll_positions=sds((B, T), jnp.int32),
+               nll_next_mask=sds((B, T), jnp.bool_))
+
+    def fn(p, e, i, v, q, t, ns, nv, np_, nm):
+        return fused.fused_study(
+            p, cfg, i, v, q, e, t, ns, nv, np_, nm,
+            max_new_tokens=N, edit_fn=iv.sae_ablation_edit,
+            tap_layer=2, top_k=3, nll_edit=True)
+
+    return fn, (params, ep, ids, valid, pos, tgt,
+                nll["nll_seqs"], nll["nll_valid"], nll["nll_positions"],
+                nll["nll_next_mask"])
+
+
 ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
     ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
@@ -225,6 +273,7 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("pipelines.interventions._residual_measure", _entry_residual_measure),
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),
+    ("runtime.fused.fused_study", _entry_fused_study),
 ]
 
 

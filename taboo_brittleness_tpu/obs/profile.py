@@ -75,9 +75,17 @@ SCHEMA_VERSION = 1
 DEVICE_PROFILE_FILENAME = "_device_profile.json"
 PROFILE_DIRNAME = "_profile"
 
-#: Annotation wire format: ``tbx:<program>#<span_id>[@<fn_name>]``.
+#: Annotation wire format:
+#: ``tbx:<program>#<span_id>[@<fn_name>][!<phase>=<w>[+<phase>=<w>...]]``.
+#: The optional ``!`` suffix is the FUSED launch's phase table (runtime/
+#: fused.py): ordered sub-phases with analytic device-cost weights at the
+#: launch shapes — in-graph program structure riding the launch record, so
+#: a single launch carrying multiple phase markers splits its measured
+#: device seconds per phase without any host timestamp.
 _ANNOT_PREFIX = "tbx:"
-_ANNOT_RE = re.compile(r"^tbx:(?P<program>[^#]+)#(?P<span>\d+)(?:@(?P<fn>.+))?$")
+_ANNOT_RE = re.compile(
+    r"^tbx:(?P<program>[^#]+)#(?P<span>\d+)"
+    r"(?:@(?P<fn>[^!]+))?(?:!(?P<phases>.+))?$")
 
 #: Gap (microseconds) that splits two slices of the same HLO module into
 #: separate execution groups.  Intra-program thunk gaps are microseconds;
@@ -129,15 +137,44 @@ _NULL_CTX = _NullCtx()
 
 
 def annotation_name(program: str, span_id: Optional[int],
-                    fn_name: Optional[str]) -> str:
+                    fn_name: Optional[str],
+                    phases: Optional[Dict[str, float]] = None) -> str:
     name = f"{_ANNOT_PREFIX}{program}#{int(span_id or 0)}"
     if fn_name:
         name += f"@{fn_name}"
+    if phases:
+        name += "!" + "+".join(f"{p}={w:g}" for p, w in phases.items())
     return name
 
 
+def parse_phase_table(text: Optional[str]) -> Optional[Dict[str, float]]:
+    """``decode=0.62+readout=0.21+nll=0.17`` → ordered {phase: weight};
+    None for absent/unparseable (a malformed table degrades to a plain
+    single-phase annotation, never an error)."""
+    if not text:
+        return None
+    table: Dict[str, float] = {}
+    for part in text.split("+"):
+        name, sep, w = part.partition("=")
+        if not sep or not name:
+            return None
+        try:
+            table[name] = float(w)
+        except ValueError:
+            return None
+    return table or None
+
+
+def capturing() -> bool:
+    """True while a capture started by this module is live — call sites use
+    it to skip work (e.g. the fused launch's phase-table arithmetic) that
+    only exists for the trace parser."""
+    return _ACTIVE
+
+
 def annotate(program: str, *, fn: Any = None,
-             span_id: Optional[int] = None):
+             span_id: Optional[int] = None,
+             phases: Optional[Dict[str, float]] = None):
     """Context manager marking one program launch on the profiler timeline.
 
     ``fn`` (the jitted callable, or its name as a string) rides along so the
@@ -145,6 +182,11 @@ def annotate(program: str, *, fn: Any = None,
     when an async dispatch's execution outlives the annotation window.
     ``span_id`` defaults to the innermost active obs span — the id the
     artifact is later joined back to ``_events.jsonl`` with.
+
+    ``phases`` attaches a fused launch's phase table (ordered sub-phase →
+    analytic weight, ``runtime.fused.phase_table``): the parser splits the
+    launch's measured device seconds across the listed phases instead of
+    treating the launch as one opaque program.
 
     A shared null context when no capture is active: call sites wrap every
     dispatch unconditionally and pay ~nothing in the common case.
@@ -163,7 +205,7 @@ def annotate(program: str, *, fn: Any = None,
         fn_name = fn if isinstance(fn, str) else (
             getattr(fn, "__name__", None) if fn is not None else None)
         return jax.profiler.TraceAnnotation(
-            annotation_name(program, span_id, fn_name))
+            annotation_name(program, span_id, fn_name, phases=phases))
     except Exception:  # noqa: BLE001 — profiling must never poison a dispatch
         return _NULL_CTX
 
@@ -361,12 +403,16 @@ def parse_trace_file(path: str) -> Tuple[List[Dict[str, Any]],
         if name.startswith(_ANNOT_PREFIX):
             m = _ANNOT_RE.match(name)
             if m:
-                annotations.append({
+                ann = {
                     "program": m.group("program"),
                     "span_id": int(m.group("span")),
                     "fn": m.group("fn"),
                     "t0": float(ts), "t1": float(ts) + dur,
-                })
+                }
+                table = parse_phase_table(m.group("phases"))
+                if table:
+                    ann["phases"] = table
+                annotations.append(ann)
             continue
         args = ev.get("args") or {}
         on_device_lane = ev.get("pid") in device_pids
@@ -564,6 +610,13 @@ def build_profile(annotations: List[Dict[str, Any]],
 
     programs: List[Dict[str, Any]] = []
     phases: Dict[str, Dict[str, Any]] = {}
+    # Fused launches (annotations carrying a phase table) additionally split
+    # their measured device seconds across the listed sub-phases — the
+    # single multi-phase launch does NOT collapse into one opaque row, and
+    # does not double-count either: the launch still appears exactly once
+    # under its own program in `phases` (the --check launch-count invariant).
+    fused_split: Dict[str, Dict[str, float]] = {}
+    fused_split_source_s = 0.0
     for i, a in enumerate(annotations):
         window_s = max(0.0, (a["t1"] - a["t0"]) / 1e6)
         got = assigned.get(i, [])
@@ -604,6 +657,16 @@ def build_profile(annotations: List[Dict[str, Any]],
             "slices": n_slices,
             "joined": how,
         }
+        table = a.get("phases")
+        if table:
+            rec["phases_in_launch"] = list(table)
+            total_w = sum(table.values()) or 1.0
+            for pname, w in table.items():
+                cell = fused_split.setdefault(
+                    pname, {"device_seconds": 0.0, "launches": 0})
+                cell["device_seconds"] += (device_us / 1e6) * (w / total_w)
+                cell["launches"] += 1
+            fused_split_source_s += device_us / 1e6
         if how == "unjoined" and a["t0"] >= last_slice_end:
             # Dispatched inside the capture window but executed after it
             # closed (an in-flight tail, e.g. the next word's pre-dispatched
@@ -654,6 +717,19 @@ def build_profile(annotations: List[Dict[str, Any]],
             "share": round(v / busy_sum, 4) if busy_sum > 0 else 0.0}
         for k, v in sorted(op_classes.items(), key=lambda kv: -kv[1])}
 
+    if fused_split:
+        for cell in fused_split.values():
+            cell["device_seconds"] = round(cell["device_seconds"], 6)
+        fused_section = {
+            "phases": fused_split,
+            "source_device_seconds": round(fused_split_source_s, 6),
+            "note": "single fused launches split per sub-phase by the "
+                    "in-graph phase table riding each launch's annotation "
+                    "(runtime/fused.py; analytic weights at launch shapes)",
+        }
+    else:
+        fused_section = None
+
     unattr_s = sum(s["dur"] for g in unattributed for s in g["slices"]) / 1e6
     capture_meta = {
         "annotations": len(annotations),
@@ -665,7 +741,7 @@ def build_profile(annotations: List[Dict[str, Any]],
     capture_meta.update(
         {k: meta.pop(k) for k in list(meta)
          if k in ("capture_wall_seconds", "words")})
-    return {
+    out = {
         "v": SCHEMA_VERSION,
         "generated_by": "taboo_brittleness_tpu.obs.profile",
         **meta,
@@ -686,6 +762,9 @@ def build_profile(annotations: List[Dict[str, Any]],
             "groups": len(unattributed),
         },
     }
+    if fused_section is not None:
+        out["fused_phase_split"] = fused_section
+    return out
 
 
 def load_device_profile(path: str) -> Dict[str, Any]:

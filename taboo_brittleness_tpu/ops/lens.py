@@ -286,14 +286,22 @@ def make_full_probs_tap(params: Params, cfg: Gemma2Config,
 
 def residual_carry_tap(batch: int, seq: int, hidden: int, tap_layer: int):
     """(init, update) carry tap capturing resid_post at ``tap_layer`` in f32 —
-    O(1) in layers: one [B, T, D] accumulator masked-added per scan step, so
-    the stacked [L, B, T, D] tensor never materializes.  Shared by the dense
-    lens paths and the sequence-parallel forward (parallel/sp.py)."""
+    O(1) in layers: one [B, T, D] accumulator carried per scan step, so the
+    stacked [L, B, T, D] tensor never materializes.  Shared by the dense
+    lens paths and the sequence-parallel forward (parallel/sp.py).
+
+    The per-layer update is a SELECT, not a masked multiply-add: the old
+    ``acc + h * keep`` form left XLA free to contract the multiply into an
+    FMA — or not — depending on the surrounding fusion context, so the
+    captured residual's last bits differed between a standalone decode
+    launch and the same decode inlined into the fused study program
+    (runtime/fused.py).  A select carries the exact ``h`` bits through,
+    making the capture bit-stable across compilation contexts (the fused
+    parity gate in tests/test_fused.py depends on it)."""
     acc0 = jnp.zeros((batch, seq, hidden), jnp.float32)
 
     def accumulate(acc, h, layer_idx):
-        keep = (layer_idx == tap_layer).astype(jnp.float32)
-        return acc + h.astype(jnp.float32) * keep
+        return jnp.where(layer_idx == tap_layer, h.astype(jnp.float32), acc)
 
     return acc0, accumulate
 

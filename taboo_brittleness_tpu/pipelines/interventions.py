@@ -304,6 +304,21 @@ def _place_rows(x, mesh):
     return arr if sh is None else jax.device_put(arr, sh)
 
 
+def _use_fused(mesh: Any = None) -> bool:
+    """Whether this dispatch takes the FUSED study program (``TBX_FUSED=1``,
+    ``runtime.fused``): decode + readout + NLL (+ baseline spikes) as ONE
+    launched XLA program instead of three dispatches with host glue between
+    them.  Mesh-sharded launches always take the legacy path — the fused
+    program rides the single-device AOT registry, exactly like the rest of
+    the warm-start story.  Legacy stays the default until a TPU round lands
+    the ``fused_ab`` win (the ``readout_ab`` rollout playbook)."""
+    if mesh is not None:
+        return False
+    from taboo_brittleness_tpu.runtime import fused
+
+    return fused.enabled()
+
+
 def _readout_variant() -> str:
     """Production readout normalization (see ``_residual_measure``):
     ``foldexp`` default, ``TBX_READOUT_VARIANT=softmax`` restores the
@@ -509,6 +524,8 @@ def prepare_word_dispatch(
     chunk, so the device crosses word boundaries without idling through the
     host's collect/JSON/planning tail (~1 s/word of idle baseline latency
     otherwise)."""
+    if _use_fused(mesh):
+        return _prepare_word_dispatch_fused(params, cfg, tok, config, word)
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     B = len(config.prompts)
@@ -553,6 +570,53 @@ def prepare_word_dispatch(
     return {"word": word, "tok": tok, "dec": dec, "layout_d": layout_d,
             "out": out, "nll_d": nll_d, "spike_d": spike_d, "resp_d": resp_d,
             "tid": tid, "resp_start": resp_start, "B": B}
+
+
+def _prepare_word_dispatch_fused(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    word: str,
+) -> Dict[str, Any]:
+    """:func:`prepare_word_dispatch` under ``TBX_FUSED=1``: the baseline
+    pass's decode, tap readout, cached-NLL continuation AND spike finding
+    dispatch as ONE launched program (``runtime.fused.fused_study`` in
+    baseline mode — NLL layout derived in-graph from the decode's own
+    output, residual returned for the host-side scoring/PCA).  The handle
+    is shaped exactly like the legacy one, so :func:`prepare_word_collect`
+    serves both paths unchanged."""
+    from taboo_brittleness_tpu.runtime import fused, resilience
+
+    B = len(config.prompts)
+    resilience.fire("decode.launch", rows=B)
+    padded, valid, positions, _ = decode.encode_prompts(
+        tok, list(config.prompts),
+        pad_to_multiple=config.experiment.pad_to_multiple)
+    tid = target_token_id(tok, word)
+    fr = fused.dispatch_fused(
+        params, cfg,
+        prompt_ids=padded, prompt_valid=valid, prompt_positions=positions,
+        target_ids=np.full((B,), tid, np.int32),
+        max_new_tokens=config.experiment.max_new_tokens,
+        tap_layer=config.model.layer_idx, top_k=config.model.top_k,
+        spike_top_k=config.intervention.spike_top_k)
+    # The prefill-KV outputs exist for loop-codegen bit-parity with the
+    # legacy launch (see runtime.fused.FusedResult); the baseline pass has
+    # no further use for them — drop the references so the buffers free as
+    # soon as the launch completes.
+    fr = fr._replace(prefill_k=None, prefill_v=None, prefill_valid=None)
+    layout_d = decode.ResponseLayout(
+        sequences=fr.sequences, valid=fr.sequence_valid,
+        positions=fr.positions, prompt_len=int(padded.shape[1]),
+        response_mask=fr.response_mask)
+    out = {"tap_prob": fr.tap_prob, "row_prob_sum": fr.row_prob_sum,
+           "row_resp": fr.row_resp, "agg_ids": fr.agg_ids,
+           "agg_probs": fr.agg_probs}
+    return {"word": word, "tok": tok, "dec": fr, "layout_d": layout_d,
+            "out": out, "nll_d": fr.nll, "spike_d": fr.spike_pos,
+            "resp_d": fr.response_mask, "tid": tid,
+            "resp_start": max(int(padded.shape[1]) - 1, 0), "B": B}
 
 
 def prepare_word_collect(handle: Dict[str, Any]) -> WordState:
@@ -776,6 +840,9 @@ def _dispatch_rows(
     dp-sharded), bounded by the fixed pipeline depth.  Execution-time
     transients (KV cache, [chunk, T, V] readout slabs) never overlap — the
     device runs one program at a time."""
+    if _use_fused(mesh):
+        return _dispatch_rows_fused(params, cfg, tok, config, state,
+                                    edit_fn, rows_ep, n_arms)
     layer_idx = config.model.layer_idx
     top_k = config.model.top_k
     A, B = n_arms, state.sequences.shape[0]
@@ -851,6 +918,59 @@ def _dispatch_rows(
     # All three programs are now in the device queue; hand the in-flight
     # values to the collect half.
     return {"dec": dec, "out": out, "edited_nll": edited_nll_dev,
+            "next_mask": next_mask, "n_arms": A}
+
+
+def _dispatch_rows_fused(
+    params: Params,
+    cfg: Gemma2Config,
+    tok: TokenizerLike,
+    config: Config,
+    state: WordState,
+    edit_fn: Callable,
+    rows_ep: Any,
+    n_arms: int,
+) -> Dict[str, Any]:
+    """:func:`_dispatch_rows` under ``TBX_FUSED=1``: the arm chunk's decode
+    (with the in-graph edit and residual capture), tap-layer readout, and
+    baseline-continuation ΔNLL run as ONE launched program — the captured
+    residual and the prefill KV cache live and die *inside* the launch
+    (never program outputs), and there is zero host glue between the three
+    phases.  The returned handle is shaped like the legacy one so
+    :func:`_collect_rows` serves both paths."""
+    from taboo_brittleness_tpu.runtime import fused, resilience
+
+    A, B = n_arms, state.sequences.shape[0]
+    prompts = list(config.prompts) * A
+    resilience.fire("decode.launch", rows=len(prompts))
+    padded, valid, positions, _ = decode.encode_prompts(
+        tok, prompts, pad_to_multiple=config.experiment.pad_to_multiple)
+    next_mask = np.zeros_like(state.response_mask)
+    next_mask[:, :-1] = state.response_mask[:, 1:]
+    sae = rows_ep.get("sae") if isinstance(rows_ep, dict) else None
+    fr = fused.dispatch_fused(
+        params, cfg,
+        prompt_ids=padded, prompt_valid=valid, prompt_positions=positions,
+        edit_fn=edit_fn, edit_params=rows_ep,
+        target_ids=np.full((A * B,), state.target_id, np.int32),
+        nll_inputs=dict(
+            seqs=np.tile(state.sequences, (A, 1)),
+            valid=np.tile(state.valid, (A, 1)),
+            positions=np.tile(state.positions, (A, 1)),
+            next_mask=np.tile(next_mask, (A, 1))),
+        max_new_tokens=config.experiment.max_new_tokens,
+        tap_layer=config.model.layer_idx, top_k=config.model.top_k,
+        sae_width=int(sae.w_enc.shape[1]) if sae is not None else 0)
+    # Residual + prefill KV are outputs only as the legacy launch's
+    # bit-parity anchors (runtime.fused.FusedResult); the arm path consumes
+    # both in-graph — drop the references immediately, mirroring legacy's
+    # dec._replace(residual=None) / (prefill_cache=None).
+    fr = fr._replace(residual=None, prefill_k=None, prefill_v=None,
+                     prefill_valid=None)
+    out = {"tap_prob": fr.tap_prob, "row_prob_sum": fr.row_prob_sum,
+           "row_resp": fr.row_resp, "agg_ids": fr.agg_ids,
+           "agg_probs": fr.agg_probs}
+    return {"dec": fr, "out": out, "edited_nll": fr.nll,
             "next_mask": next_mask, "n_arms": A}
 
 
@@ -1108,7 +1228,43 @@ def study_program_specs(
         return {"spike_positions": jnp.zeros((rows, iv_cfg.spike_top_k),
                                              jnp.int32)}
 
+    def fused_spec(tag: str, arms: int, edit_fn, rows_ep) -> Dict[str, Any]:
+        """The ONE fused program a ``TBX_FUSED=1`` study launches where the
+        legacy path launches the trio — same jit entry, same statics, same
+        argument pytrees as ``runtime.fused.dispatch_fused`` builds, so the
+        warm start covers the fused path exactly (zero-miss-gated like the
+        legacy mirror)."""
+        from taboo_brittleness_tpu.runtime import fused as fused_mod
+
+        rows = arms * B
+        dynamic = dict(
+            params=params, **prompt_rows(arms), edit_params=rows_ep,
+            target_ids=jnp.zeros((rows,), jnp.int32),
+            nll_seqs=None, nll_valid=None, nll_positions=None,
+            nll_next_mask=None)
+        static = dict(
+            cfg=cfg, max_new_tokens=N, edit_fn=edit_fn, decode_edit=True,
+            stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+            tap_layer=layer_idx, top_k=top_k,
+            chunk=_readout_chunk_override(), variant=_readout_variant())
+        if edit_fn is None:
+            # Baseline mode: in-graph NLL layout + spike finding.
+            static.update(spike_top_k=iv_cfg.spike_top_k, nll_edit=False)
+        else:
+            # Arms mode: NLL over the (host-tiled) baseline layout, edited.
+            dynamic.update(
+                nll_seqs=jnp.zeros((rows, t_total), jnp.int32),
+                nll_valid=jnp.zeros((rows, t_total), bool),
+                nll_positions=jnp.zeros((rows, t_total), jnp.int32),
+                nll_next_mask=jnp.zeros((rows, t_total), bool))
+            static.update(spike_top_k=None, nll_edit=True)
+        return {"label": f"fused[{tag}x{rows}]", "entry": "fused",
+                "jit_fn": fused_mod.fused_study, "dynamic": dynamic,
+                "static": static}
+
     def trio(tag: str, arms: int, edit_fn, rows_ep) -> List[Dict[str, Any]]:
+        if _use_fused():
+            return [fused_spec(tag, arms, edit_fn, rows_ep)]
         rows = arms * B
         kv_shape = (cfg.num_layers, rows, s, cfg.num_kv_heads, cfg.head_dim)
         nll_ep = (None if rows_ep is None else

@@ -198,15 +198,17 @@ def greedy_decode(
     )
     use_step_edit = edit_fn is not None and decode_edit
 
-    prefill_kv = None
-    if return_prefill_cache:
-        # Columns [0, T-1): the ΔNLL continuation re-computes the LAST prompt
-        # column itself (its hidden state predicts the first response token),
-        # so only the strictly-preceding columns are reusable as-is.
-        keep = max(T - 1, 0)
-        prefill_kv = (prefill.cache.k[:, :, :keep],
-                      prefill.cache.v[:, :, :keep],
-                      prefill.cache.valid[:, :keep])
+    # return_prefill_cache: columns [0, T-1) — the ΔNLL continuation
+    # re-computes the LAST prompt column itself (its hidden state predicts
+    # the first response token), so only the strictly-preceding columns are
+    # reusable as-is.  Sliced from the FINAL cache after the decode loop
+    # (see below), not from `prefill.cache` here: the values are identical
+    # (decode steps write only columns >= T), but slicing the pre-loop cache
+    # as a program output gives it a second consumer next to the while-loop
+    # carry, which changes XLA's aliasing/layout choice for the KV block and
+    # with it the step attention's last-bit rounding — the decode then stops
+    # being bit-reproducible across compilation contexts (standalone launch
+    # vs inlined into runtime/fused.py's one-program study step).
 
     prompt_len = jnp.sum(prompt_valid, axis=1)           # [B] real prompt lengths
     last_logits = unembed(params, cfg, prefill.last_hidden[:, -1:])[:, 0]
@@ -269,6 +271,13 @@ def greedy_decode(
          toks0, emit0, resid0),
     )
     lengths = jnp.sum(emitted, axis=1)
+
+    prefill_kv = None
+    if return_prefill_cache:
+        keep = max(T - 1, 0)
+        prefill_kv = (final_cache.k[:, :, :keep],
+                      final_cache.v[:, :, :keep],
+                      final_cache.valid[:, :keep])
 
     sequences = jnp.concatenate([prompt_ids, tokens], axis=1)
     sequence_valid = jnp.concatenate([prompt_valid, emitted], axis=1)
@@ -362,6 +371,31 @@ def decode_texts(
     return texts_from_tokens(tok, tokens, lengths)
 
 
+def encode_prompts(
+    tok,
+    prompts: Sequence[str],
+    *,
+    prefills: Optional[Sequence[Optional[str]]] = None,
+    pad_to_multiple: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[List[int]]]:
+    """Chat-format + tokenize + left-pad a prompt batch: the host-side prep
+    half of :func:`generate`, shared with the fused study launch
+    (``runtime.fused``) which builds the same [B, T] layout but dispatches
+    decode+readout+NLL as one program.  Returns (ids, valid, positions,
+    per-row token id lists)."""
+    rendered = []
+    for i, p in enumerate(prompts):
+        prefill = prefills[i] if prefills is not None else None
+        rendered.append(
+            chat.render_chat([chat.Turn("user", p)], prefill=prefill)
+            if prefill is not None
+            else chat.user_prompt(p)
+        )
+    ids = [tok.encode(r) for r in rendered]
+    padded, valid, positions = pad_prompts(ids, pad_to_multiple=pad_to_multiple)
+    return padded, valid, positions, ids
+
+
 def generate(
     params: Params,
     cfg: Gemma2Config,
@@ -407,16 +441,8 @@ def generate(
 
     resilience.fire("decode.launch", rows=len(prompts))
 
-    rendered = []
-    for i, p in enumerate(prompts):
-        prefill = prefills[i] if prefills is not None else None
-        rendered.append(
-            chat.render_chat([chat.Turn("user", p)], prefill=prefill)
-            if prefill is not None
-            else chat.user_prompt(p)
-        )
-    ids = [tok.encode(r) for r in rendered]
-    padded, valid, positions = pad_prompts(ids, pad_to_multiple=pad_to_multiple)
+    padded, valid, positions, ids = encode_prompts(
+        tok, prompts, prefills=prefills, pad_to_multiple=pad_to_multiple)
 
     def place(x):
         """With ``input_sharding`` (e.g. NamedSharding over the mesh's dp

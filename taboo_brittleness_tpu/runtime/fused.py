@@ -1,0 +1,351 @@
+"""Fused decode→readout→NLL: the study's inner loop as ONE resident program.
+
+Why (ROADMAP top item; Kernel Looping, arXiv:2410.23668): the legacy study
+step is three XLA dispatches per arm chunk — ``greedy_decode`` (prefill +
+K-token ``lax.while_loop``), the 256k-vocab tap-layer readout
+(``interventions._residual_measure``), and the cached-NLL continuation
+(``interventions._nll_cached_jit``) — with host-side glue between each
+launch.  PR 7's device-timeline profiler measures exactly that glue as
+device-idle dispatch-gap share; this module removes the synchronization
+boundaries by compiling all three phases (plus the baseline pass's spike
+finding) into ONE launched XLA program.  The KV cache, the readout
+accumulation slabs, and the per-step P(secret)/NLL taps are all values
+*inside* the one program — nothing round-trips to the host until the block's
+outputs are pulled (M2R2's keep-the-taps-in-the-loop stance,
+arXiv:2502.02040).
+
+The fused body deliberately CALLS the same jitted building blocks the legacy
+path dispatches (``decode.greedy_decode``, ``_residual_measure``,
+``_nll_cached_jit``): under an enclosing trace they inline, so the fused
+program computes bit-identical tokens, lens probabilities, and NLLs (gated
+by tests/test_fused.py) while XLA sees one module with no launch boundaries.
+
+Phase markers are IN-GRAPH, not host timestamps — host clocks are
+meaningless inside one launch:
+
+- each phase's ops trace under a ``jax.named_scope("tbx_fused_<phase>")``,
+  so the compiled HLO's op metadata carries the phase structure;
+- the launch's annotation (obs/profile.py) carries a *phase table* —
+  ordered phases with analytic device-cost weights computed from
+  ``perf.roofline`` at the exact launch shapes — which the trace parser
+  uses to split the single launch's MEASURED device seconds per phase
+  (``_device_profile.json:fused_phase_split``);
+- :class:`FusedResult` returns ``decode_steps``, the in-graph count of
+  executed decode steps (the step-index boundary between the decode phase
+  and the readout/NLL tail of the program).
+
+Rollout contract (the ``readout_ab`` playbook): **legacy stays the default**
+until a TPU round confirms the win — ``TBX_FUSED=1`` opts in, and
+``bench.py``'s ``fused_ab`` stage commits the fused-vs-legacy throughput,
+measured device-idle share, and ceiling ratios side by side every round.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.models.gemma2 import Gemma2Config, Params
+from taboo_brittleness_tpu.runtime import chat
+
+#: Sub-phase order inside one fused launch — the phase table's key order and
+#: the named_scope suffixes in the compiled HLO.
+FUSED_PHASES: Tuple[str, ...] = ("decode", "readout", "nll")
+
+
+def enabled() -> bool:
+    """Opt-in gate: ``TBX_FUSED=1`` routes the study's per-chunk trio through
+    the fused program.  Default OFF — legacy per-launch dispatch stays the
+    production path until a TPU round lands the ``fused_ab`` table."""
+    return os.environ.get("TBX_FUSED", "0") == "1"
+
+
+class FusedResult(NamedTuple):
+    """Everything the study consumes from one fused launch.
+
+    Decode block (``decode.DecodeResult`` fields the collects read):
+    ``tokens``/``lengths``/``sequences``/``sequence_valid``.  Layout block
+    (``decode.ResponseLayout`` computed in-graph): ``positions`` and
+    ``response_mask``.  Readout block (``_residual_measure``'s dict, split
+    into fields): ``tap_prob``/``row_prob_sum``/``row_resp``/``agg_ids``/
+    ``agg_probs``.  ``nll`` is the cached-NLL continuation's [B, T] output.
+
+    ``residual`` and the ``prefill_*`` KV slices are ALWAYS program
+    outputs, deliberately: the legacy decode launch materializes exactly
+    these buffers, and XLA's codegen for the decode while-loop is sensitive
+    to which loop-derived values stay live (dead outputs change fusion and
+    with it last-bit rounding).  Keeping the fused program's decode output
+    surface identical to the legacy launch is what makes the bit-exactness
+    gate hold; the fusion win is the REMOVED LAUNCH BOUNDARIES (no host
+    glue, no dispatch gap), not removed buffers — callers drop the
+    residual/prefill references right after dispatch, exactly like the
+    legacy pipeline does.  ``spike_pos``/``spike_probs`` ride only in
+    baseline mode (``spike_top_k``).
+
+    ``decode_steps`` is the in-graph phase marker: the number of decode
+    steps that emitted at least one token (the while-loop's early exit
+    index, up to the fixed +1 step that latches the last stop row) — the
+    step-index boundary between the fused program's decode phase and its
+    readout/NLL tail, emitted with the launch record instead of any host
+    timestamp.
+    """
+
+    tokens: jax.Array            # [B, N]
+    lengths: jax.Array           # [B]
+    sequences: jax.Array         # [B, T]
+    sequence_valid: jax.Array    # [B, T] bool
+    positions: jax.Array         # [B, T]
+    response_mask: jax.Array     # [B, T] bool
+    tap_prob: jax.Array          # [B, T]
+    row_prob_sum: jax.Array      # [B]
+    row_resp: jax.Array          # [B]
+    agg_ids: jax.Array           # [B, K]
+    agg_probs: jax.Array         # [B, K]
+    nll: jax.Array               # [B, T]
+    decode_steps: jax.Array      # [] int32 — in-graph phase marker
+    residual: jax.Array = None       # [B, T, D] f32 at the tap layer
+    prefill_k: jax.Array = None      # [L, B, s, Kh, Dh] (bit-parity anchor)
+    prefill_v: jax.Array = None
+    prefill_valid: jax.Array = None  # [B, s]
+    spike_pos: Optional[jax.Array] = None    # [B, K_spike] (baseline only)
+    spike_probs: Optional[jax.Array] = None  # [B, K_spike] (baseline only)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "edit_fn", "decode_edit",
+                     "stop_ids", "tap_layer", "top_k", "chunk", "variant",
+                     "spike_top_k", "nll_edit"),
+)
+def fused_study(
+    params: Params,
+    cfg: Gemma2Config,
+    prompt_ids: jax.Array,        # [B, Tp] left-padded
+    prompt_valid: jax.Array,      # [B, Tp] bool
+    prompt_positions: jax.Array,  # [B, Tp]
+    edit_params: Any = None,
+    target_ids: jax.Array = None,  # [B]
+    # Arms mode: the ΔNLL re-scores the BASELINE continuation (host-tiled
+    # layout arrays) under this launch's edited model.  All None = baseline
+    # mode, where the NLL layout derives in-graph from the decode's own
+    # output (the study's unedited first pass).
+    nll_seqs: Optional[jax.Array] = None,       # [B, T]
+    nll_valid: Optional[jax.Array] = None,      # [B, T] bool
+    nll_positions: Optional[jax.Array] = None,  # [B, T]
+    nll_next_mask: Optional[jax.Array] = None,  # [B, T] bool
+    *,
+    max_new_tokens: int,
+    edit_fn: Any = None,
+    decode_edit: bool = True,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    tap_layer: int,
+    top_k: int,
+    chunk: Optional[int] = None,
+    variant: str = "foldexp",
+    spike_top_k: Optional[int] = None,
+    nll_edit: bool = False,
+) -> FusedResult:
+    """ONE launched program: decode (prefill + K-token while_loop with the
+    in-graph intervention edit), tap-layer lens readout, cached-NLL
+    continuation, and (baseline mode) spike finding.
+
+    The body inlines the SAME jitted callables the legacy path launches one
+    by one — and keeps the decode's legacy output surface live (see
+    :class:`FusedResult`) — so per-value results are bit-identical to the
+    three-dispatch path; the fusion win is the removed launch boundaries
+    (no host sync, no dispatch gap between the three phases).
+
+    ``nll_edit=True`` applies ``edit_fn``/``edit_params`` to the NLL
+    continuation too (the arm path; ``chunk_positions`` for the continuation
+    columns is derived in-graph).  Baseline mode scores un-edited.
+    """
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+    from taboo_brittleness_tpu.runtime import decode as decode_mod
+
+    with jax.named_scope("tbx_fused_decode"):
+        dec = decode_mod.greedy_decode(
+            params, cfg, prompt_ids, prompt_valid, prompt_positions,
+            max_new_tokens=max_new_tokens,
+            edit_fn=edit_fn, edit_params=edit_params,
+            decode_edit=decode_edit, stop_ids=stop_ids,
+            capture_residual_layer=tap_layer,
+            return_prefill_cache=True)
+    layout = decode_mod.response_layout_device(dec, stop_ids=stop_ids)
+    s = max(layout.prompt_len - 1, 0)
+
+    with jax.named_scope("tbx_fused_readout"):
+        out = iv._residual_measure(
+            params, cfg, dec.residual, layout.sequences,
+            layout.response_mask, target_ids,
+            top_k=top_k, resp_start=s, chunk=chunk, variant=variant)
+
+    if nll_seqs is None:
+        seqs, valid, positions = (layout.sequences, layout.valid,
+                                  layout.positions)
+        resp = layout.response_mask
+        next_mask = jnp.zeros_like(resp).at[:, :-1].set(resp[:, 1:])
+    else:
+        seqs, valid = nll_seqs, nll_valid
+        positions, next_mask = nll_positions, nll_next_mask
+    if nll_edit and edit_fn is not None:
+        ep_nll = iv._with_chunk_positions(edit_params, positions[:, s:])
+        nll_edit_fn = edit_fn
+    else:
+        ep_nll, nll_edit_fn = None, None
+    with jax.named_scope("tbx_fused_nll"):
+        nll = iv._nll_cached_jit(
+            params, cfg, *dec.prefill_cache,
+            seqs, valid, positions, next_mask,
+            edit_fn=nll_edit_fn, edit_params=ep_nll, resp_start=s)
+
+    spike_pos = spike_probs = None
+    if spike_top_k is not None:
+        from taboo_brittleness_tpu.ops import lens
+
+        with jax.named_scope("tbx_fused_spikes"):
+            spike_pos, spike_probs = lens.spike_positions_batch(
+                out["tap_prob"], layout.response_mask, top_k=spike_top_k)
+
+    return FusedResult(
+        tokens=dec.tokens, lengths=dec.lengths,
+        sequences=layout.sequences, sequence_valid=layout.valid,
+        positions=layout.positions, response_mask=layout.response_mask,
+        tap_prob=out["tap_prob"], row_prob_sum=out["row_prob_sum"],
+        row_resp=out["row_resp"], agg_ids=out["agg_ids"],
+        agg_probs=out["agg_probs"], nll=nll,
+        decode_steps=jnp.max(dec.lengths).astype(jnp.int32),
+        residual=dec.residual,
+        prefill_k=dec.prefill_cache[0], prefill_v=dec.prefill_cache[1],
+        prefill_valid=dec.prefill_cache[2],
+        spike_pos=spike_pos, spike_probs=spike_probs,
+    )
+
+
+def phase_table(cfg: Gemma2Config, rows: int, prompt_len: int,
+                new_tokens: int, sae_width: int) -> Dict[str, float]:
+    """The launch record's step-index → phase table: ordered fused phases
+    with analytic device-cost WEIGHTS (normalized shares) at the exact
+    launch shapes, from ``perf.roofline``.
+
+    On a device with a known roofline spec the weight is each phase's
+    ceiling time (max of compute/memory bound — the best predictor of its
+    share of the fused launch); otherwise the analytic FLOPs share.  The
+    table rides in the profiler annotation so the trace parser can split
+    the fused launch's MEASURED device seconds per phase without any host
+    timestamp — fail-open to equal weights (attribution degrades, capture
+    never breaks)."""
+    try:
+        from taboo_brittleness_tpu.perf import roofline
+
+        flops = roofline.phase_flops(cfg, rows, prompt_len, new_tokens,
+                                     sae_width)
+        spec = None
+        try:
+            kind = jax.devices()[0].device_kind
+            spec = roofline.device_spec(kind)
+        except Exception:  # noqa: BLE001 — backend probing is best-effort
+            spec = None
+        if spec is not None:
+            bytes_ = roofline.sweep_phase_bytes(
+                cfg, rows, prompt_len, new_tokens, sae_width)
+            pred = {p: max(flops[p] / spec.peak_flops,
+                           bytes_[p] / spec.hbm_bytes_per_s)
+                    for p in FUSED_PHASES}
+        else:
+            pred = {p: flops[p] for p in FUSED_PHASES}
+        total = sum(pred.values()) or 1.0
+        return {p: round(pred[p] / total, 4) for p in FUSED_PHASES}
+    except Exception:  # noqa: BLE001 — a table failure must not block dispatch
+        w = round(1.0 / len(FUSED_PHASES), 4)
+        return {p: w for p in FUSED_PHASES}
+
+
+def dispatch_fused(
+    params: Params,
+    cfg: Gemma2Config,
+    *,
+    prompt_ids,
+    prompt_valid,
+    prompt_positions,
+    edit_params: Any = None,
+    target_ids,
+    nll_inputs: Optional[Dict[str, Any]] = None,
+    max_new_tokens: int,
+    edit_fn: Any = None,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    tap_layer: int,
+    top_k: int,
+    spike_top_k: Optional[int] = None,
+    sae_width: int = 0,
+    route: bool = True,
+) -> FusedResult:
+    """One fused launch through the AOT program registry, under a ``fused``
+    program span and a phase-table profiler annotation.
+
+    ``nll_inputs`` (dict with ``seqs``/``valid``/``positions``/``next_mask``)
+    selects arms mode (NLL over the baseline layout, edited); None selects
+    baseline mode (NLL from the decode's own layout, un-edited).  The span /
+    annotation contract matches the legacy per-program call sites
+    (obs/profile.py TBX010), except the single annotation carries ALL THREE
+    phase markers — ``tools/trace_report.py --check --device`` accepts one
+    launch with a multi-phase table.
+    """
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+    from taboo_brittleness_tpu.runtime import aot
+
+    rows, cols = prompt_ids.shape
+    dynamic = dict(
+        params=params,
+        prompt_ids=jnp.asarray(prompt_ids),
+        prompt_valid=jnp.asarray(prompt_valid),
+        prompt_positions=jnp.asarray(prompt_positions),
+        edit_params=edit_params,
+        target_ids=jnp.asarray(target_ids),
+        nll_seqs=None, nll_valid=None, nll_positions=None,
+        nll_next_mask=None,
+    )
+    if nll_inputs is not None:
+        dynamic.update(
+            nll_seqs=jnp.asarray(nll_inputs["seqs"]),
+            nll_valid=jnp.asarray(nll_inputs["valid"]).astype(bool),
+            nll_positions=jnp.asarray(nll_inputs["positions"]),
+            nll_next_mask=jnp.asarray(nll_inputs["next_mask"]).astype(bool))
+    static = dict(
+        cfg=cfg, max_new_tokens=max_new_tokens, edit_fn=edit_fn,
+        decode_edit=True, stop_ids=stop_ids, tap_layer=tap_layer,
+        top_k=top_k, chunk=_readout_chunk_override(),
+        variant=_readout_variant(), spike_top_k=spike_top_k,
+        nll_edit=nll_inputs is not None and edit_fn is not None)
+
+    obs_metrics.counter("fused.launches").inc()
+    obs_metrics.counter("fused.rows").inc(rows)
+    # The phase table costs a little host arithmetic; compute it only when a
+    # device capture is live (it exists for the trace parser's split).
+    table = None
+    if obs.profile.capturing():
+        table = phase_table(cfg, rows, cols, max_new_tokens, sae_width)
+    with obs.span("fused", kind="program", rows=rows, cols=int(cols),
+                  new_tokens=max_new_tokens, fn="fused_study",
+                  phases=",".join(FUSED_PHASES)) as sp:
+        with obs.profile.annotate("fused", fn=fused_study,
+                                  span_id=getattr(sp, "span_id", None),
+                                  phases=table):
+            return aot.dispatch("fused", fused_study,
+                                dynamic=dynamic, static=static, route=route)
+
+
+def _readout_variant() -> str:
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+
+    return iv._readout_variant()
+
+
+def _readout_chunk_override() -> Optional[int]:
+    from taboo_brittleness_tpu.pipelines import interventions as iv
+
+    return iv._readout_chunk_override()

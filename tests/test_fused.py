@@ -1,0 +1,584 @@
+"""Fused decode→readout→NLL study program (runtime/fused.py, ISSUE 8).
+
+The contract under test, in order of importance:
+
+1. **Bit-exactness** — the fused one-launch program's greedy tokens, lens
+   probabilities, and NLLs are IDENTICAL (``np.array_equal``, not allclose)
+   to the legacy three-dispatch path, for all three study programs and all
+   intervention scenarios: unedited baseline, SAE ablation, projection
+   removal, spike-masked edits, early-stop rows, and padded/ragged arm
+   chunks.  Two compiled-codegen hazards had to be fixed to make this hold
+   and are pinned by regression tests here: the residual carry tap is a
+   select (no FMA-contractible multiply-add), and the prefill-KV output
+   slices from the FINAL cache so the decode while-loop's live-output
+   surface is identical across compilation contexts.
+2. **AOT coverage** — ``study_program_specs`` mirrors the fused launch
+   signatures exactly: a warm-started ``TBX_FUSED=1`` study records zero
+   registry misses (the same drift gate the legacy trio has).
+3. **Observability** — the fused launch is ONE annotated program carrying a
+   multi-phase in-graph phase table: wire-format round trip, the parser's
+   ``fused_phase_split``, and ``trace_report --check --device`` accepting a
+   single launch with multiple phase markers (and flagging a
+   non-conserving split).
+4. **Bench** — the ``fused_ab`` stage and its regression-gated headline
+   metrics (``fused_ab.fused_speedup`` / ``fused_ab.device_idle_share``).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import (
+    Config, ExperimentConfig, InterventionConfig, ModelConfig)
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.obs import profile as prof
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.pipelines import interventions as iv
+from taboo_brittleness_tpu.runtime import aot, decode, fused
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    tok = WordTokenizer([WORD, "hint", "clue", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=5),
+        intervention=InterventionConfig(
+            budgets=(1, 2), random_trials=2, ranks=(1, 2), spike_top_k=2),
+        word_plurals={WORD: [WORD, WORD + "s"]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), d_model=cfg.hidden_size,
+                              d_sae=32)
+    return params, cfg, tok, config, sae
+
+
+@pytest.fixture()
+def fresh_registry():
+    aot.reset()
+    yield
+    aot.reset()
+
+
+# ---------------------------------------------------------------------------
+# Gate + routing.
+# ---------------------------------------------------------------------------
+
+def test_fused_off_by_default(setup, monkeypatch, fresh_registry):
+    monkeypatch.delenv("TBX_FUSED", raising=False)
+    assert fused.enabled() is False
+    assert iv._use_fused() is False
+    params, cfg, tok, config, sae = setup
+    handle = iv.prepare_word_dispatch(params, cfg, tok, config, WORD)
+    # Legacy handle: the decode result still carries a prefill_cache field
+    # (the fused handle is a FusedResult and has none).
+    assert hasattr(handle["dec"], "prefill_cache")
+    assert "fused" not in aot.stats()
+
+
+def test_fused_never_engages_under_a_mesh(monkeypatch):
+    monkeypatch.setenv("TBX_FUSED", "1")
+    assert iv._use_fused() is True
+    assert iv._use_fused(mesh=object()) is False
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: direct program vs the legacy trio, per scenario.
+# ---------------------------------------------------------------------------
+
+def _legacy_trio(params, cfg, args, ep, edit_fn, *, new_tokens, tap, top_k,
+                 stop_ids, nll_arrays=None, nll_edit=False):
+    """The legacy three-dispatch study step at one chunk's shapes."""
+    dec = decode.greedy_decode(
+        params, cfg, *args, max_new_tokens=new_tokens,
+        edit_fn=edit_fn, edit_params=ep, stop_ids=stop_ids,
+        capture_residual_layer=tap, return_prefill_cache=True)
+    layout = decode.response_layout_device(dec, stop_ids=stop_ids)
+    s = max(layout.prompt_len - 1, 0)
+    rows = layout.sequences.shape[0]
+    out = iv._residual_measure(
+        params, cfg, dec.residual, layout.sequences, layout.response_mask,
+        jnp.zeros((rows,), jnp.int32), top_k=top_k, resp_start=s)
+    if nll_arrays is None:
+        resp = layout.response_mask
+        next_mask = jnp.zeros_like(resp).at[:, :-1].set(resp[:, 1:])
+        seqs, valid, positions = (layout.sequences, layout.valid,
+                                  layout.positions)
+    else:
+        seqs, valid, positions, next_mask = nll_arrays
+    nll = iv._nll_cached_jit(
+        params, cfg, *dec.prefill_cache, seqs, valid, positions, next_mask,
+        edit_fn=edit_fn if nll_edit else None,
+        edit_params=(iv._with_chunk_positions(ep, positions[:, s:])
+                     if nll_edit and ep is not None else None),
+        resp_start=s)
+    return dec, out, nll
+
+
+def _scenario(name, cfg, sae, rows):
+    rng = np.random.default_rng(17)
+    if name == "none":
+        return None, None
+    if name == "sae":
+        return iv.sae_ablation_edit, {
+            "sae": sae, "layer": 2,
+            "latent_ids": jnp.asarray(
+                rng.integers(0, sae.w_enc.shape[1], size=(rows, 3)),
+                jnp.int32)}
+    if name == "sae_spike_masked":
+        return iv.sae_ablation_edit, {
+            "sae": sae, "layer": 2,
+            "latent_ids": jnp.asarray(
+                rng.integers(0, sae.w_enc.shape[1], size=(rows, 3)),
+                jnp.int32),
+            "spike_positions": jnp.asarray(
+                rng.integers(0, 6, size=(rows, 2)), jnp.int32)}
+    if name == "projection":
+        basis, _ = np.linalg.qr(rng.standard_normal((cfg.hidden_size, 2)))
+        return iv.projection_edit, {
+            "layer": 2,
+            "basis": jnp.tile(jnp.asarray(basis, jnp.float32)[None],
+                              (rows, 1, 1))}
+    raise AssertionError(name)
+
+
+@pytest.mark.parametrize("scenario", ["none", "sae", "sae_spike_masked",
+                                      "projection"])
+def test_fused_program_bit_exact_per_scenario(setup, scenario):
+    """Tokens, lens probs, and NLLs of ONE fused launch match the legacy
+    three-dispatch path bitwise, per intervention scenario (arms mode:
+    NLL over a fixed baseline layout, edited when the decode is)."""
+    params, cfg, tok, config, sae = setup
+    rows, new_tokens, tap, top_k = 4, 4, 2, 3
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=6))
+               for _ in range(rows)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    Tp = padded.shape[1]
+    T = Tp + new_tokens
+    edit_fn, ep = _scenario(scenario, cfg, sae, rows)
+    nll_arrays = (
+        jnp.asarray(rng.integers(1, cfg.vocab_size, size=(rows, T)),
+                    jnp.int32),
+        jnp.ones((rows, T), bool),
+        jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (rows, 1)),
+        jnp.zeros((rows, T), bool).at[:, Tp - 1:-1].set(True))
+    nll_edit = edit_fn is not None
+
+    dec, out, nll = _legacy_trio(
+        params, cfg, args, ep, edit_fn, new_tokens=new_tokens, tap=tap,
+        top_k=top_k, stop_ids=(-1,), nll_arrays=nll_arrays,
+        nll_edit=nll_edit)
+    fr = fused.fused_study(
+        params, cfg, *args, edit_params=ep,
+        target_ids=jnp.zeros((rows,), jnp.int32),
+        nll_seqs=nll_arrays[0], nll_valid=nll_arrays[1],
+        nll_positions=nll_arrays[2], nll_next_mask=nll_arrays[3],
+        max_new_tokens=new_tokens, edit_fn=edit_fn, stop_ids=(-1,),
+        tap_layer=tap, top_k=top_k, nll_edit=nll_edit)
+
+    np.testing.assert_array_equal(np.asarray(dec.tokens),
+                                  np.asarray(fr.tokens))
+    np.testing.assert_array_equal(np.asarray(dec.residual),
+                                  np.asarray(fr.residual))
+    for key, field in (("tap_prob", fr.tap_prob),
+                       ("row_prob_sum", fr.row_prob_sum),
+                       ("agg_ids", fr.agg_ids),
+                       ("agg_probs", fr.agg_probs)):
+        assert np.array_equal(np.asarray(out[key]), np.asarray(field)), key
+    np.testing.assert_array_equal(np.asarray(nll), np.asarray(fr.nll))
+
+
+def test_fused_program_bit_exact_with_early_stop_rows(setup):
+    """Early-exit parity: pick a stop id the tiny model actually emits so
+    some rows stop early while others run the budget out — tokens, lengths,
+    lens probs, and the in-graph baseline-mode NLL must still match the
+    legacy path bitwise."""
+    params, cfg, tok, config, sae = setup
+    rows, new_tokens, tap = 4, 5, 2
+    rng = np.random.default_rng(9)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=6))
+               for _ in range(rows)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    probe = decode.greedy_decode(params, cfg, *args,
+                                 max_new_tokens=new_tokens, stop_ids=(-1,))
+    # A token some row emits mid-stream becomes the stop id: that row (at
+    # least) stops early in the gated runs below.
+    stop_ids = (int(np.asarray(probe.tokens)[0, 1]),)
+
+    dec, out, nll = _legacy_trio(
+        params, cfg, args, None, None, new_tokens=new_tokens, tap=tap,
+        top_k=3, stop_ids=stop_ids)
+    fr = fused.fused_study(
+        params, cfg, *args, edit_params=None,
+        target_ids=jnp.zeros((rows,), jnp.int32),
+        max_new_tokens=new_tokens, stop_ids=stop_ids, tap_layer=tap,
+        top_k=3, spike_top_k=2)
+    lengths = np.asarray(dec.lengths)
+    assert lengths.min() < new_tokens, "no row stopped early; probe invalid"
+    np.testing.assert_array_equal(lengths, np.asarray(fr.lengths))
+    np.testing.assert_array_equal(np.asarray(dec.tokens),
+                                  np.asarray(fr.tokens))
+    assert np.array_equal(np.asarray(out["tap_prob"]),
+                          np.asarray(fr.tap_prob))
+    assert np.array_equal(np.asarray(out["agg_probs"]),
+                          np.asarray(fr.agg_probs))
+    np.testing.assert_array_equal(np.asarray(nll), np.asarray(fr.nll))
+    # Baseline-mode extras: in-graph spike finding matches the legacy op.
+    spike_pos, spike_probs = iv.lens.spike_positions_batch(
+        out["tap_prob"], decode.response_layout_device(
+            dec, stop_ids=stop_ids).response_mask, top_k=2)
+    np.testing.assert_array_equal(np.asarray(spike_pos),
+                                  np.asarray(fr.spike_pos))
+    np.testing.assert_array_equal(np.asarray(spike_probs),
+                                  np.asarray(fr.spike_probs))
+
+
+def test_decode_bit_stable_across_compilation_contexts(setup):
+    """The two codegen hazards that broke fused parity, pinned: a standalone
+    greedy_decode launch and the same call inlined into an enclosing jit
+    (with its full output surface kept live) produce bit-identical captured
+    residuals — at the bucketed prompt widths where the drift appeared."""
+    params, cfg, tok, config, sae = setup
+    padded, valid, positions, _ = decode.encode_prompts(
+        tok, ["Give me a hint", "a clue"], pad_to_multiple=32)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+    kw = dict(max_new_tokens=5, capture_residual_layer=2,
+              return_prefill_cache=True)
+    d1 = decode.greedy_decode(params, cfg, *args, **kw)
+
+    @jax.jit
+    def nested(p, a, b, c):
+        return decode.greedy_decode(p, cfg, a, b, c, **kw)
+
+    d2 = nested(params, *args)
+    np.testing.assert_array_equal(np.asarray(d1.residual),
+                                  np.asarray(d2.residual))
+    for part1, part2 in zip(d1.prefill_cache, d2.prefill_cache):
+        np.testing.assert_array_equal(np.asarray(part1), np.asarray(part2))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end study parity (all scenarios, padded arms, resumable driver).
+# ---------------------------------------------------------------------------
+
+def test_study_results_identical_fused_vs_legacy(setup, monkeypatch,
+                                                 fresh_registry):
+    """The whole-word study — baseline pass, ablation + projection sweeps
+    with random controls — produces byte-identical JSON under TBX_FUSED=1."""
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_FUSED", "0")
+    legacy = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    monkeypatch.setenv("TBX_FUSED", "1")
+    fusedr = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert (json.dumps(legacy, sort_keys=True, default=float)
+            == json.dumps(fusedr, sort_keys=True, default=float))
+
+
+def test_study_parity_with_padded_ragged_arm_chunks(setup, monkeypatch,
+                                                    fresh_registry):
+    """A 5-arm stack at arm_chunk=3 balances to 3+2 with the ragged tail
+    padded back to 3 (duplicate arms discarded) — the fused path must chunk
+    and pad identically to legacy, bit for bit."""
+    params, cfg, tok, _, sae = setup
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        intervention=InterventionConfig(
+            budgets=(1,), random_trials=4, ranks=(1,), spike_top_k=2,
+            arm_chunk=3),
+        word_plurals={WORD: [WORD]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    monkeypatch.setenv("TBX_FUSED", "0")
+    legacy = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    monkeypatch.setenv("TBX_FUSED", "1")
+    fusedr = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert (json.dumps(legacy, sort_keys=True, default=float)
+            == json.dumps(fusedr, sort_keys=True, default=float))
+
+
+def test_study_parity_spike_masked(setup, monkeypatch, fresh_registry):
+    params, cfg, tok, _, sae = setup
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=4),
+        intervention=InterventionConfig(
+            budgets=(1, 2), random_trials=1, ranks=(1,), spike_top_k=2,
+            spike_masked=True),
+        word_plurals={WORD: [WORD]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    monkeypatch.setenv("TBX_FUSED", "0")
+    legacy = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    monkeypatch.setenv("TBX_FUSED", "1")
+    fusedr = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert (json.dumps(legacy, sort_keys=True, default=float)
+            == json.dumps(fusedr, sort_keys=True, default=float))
+
+
+# ---------------------------------------------------------------------------
+# AOT warm start covers the fused program (zero-miss drift gate).
+# ---------------------------------------------------------------------------
+
+def test_fused_warm_start_then_study_zero_misses(setup, monkeypatch,
+                                                 fresh_registry):
+    """Mirror of test_aot.test_warm_start_then_study_zero_misses under
+    TBX_FUSED=1: study_program_specs' fused mirror must match the real
+    launch signatures exactly, or the first word silently loses its warm
+    start — this fails loudly instead."""
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_FUSED", "1")
+    rep = iv.warm_start_study(params, cfg, tok, config, sae, store=None)
+    assert rep["errors"] == 0
+    fused_labels = [r["label"] for r in rep["programs"]
+                    if r["label"].startswith("fused[")]
+    assert len(fused_labels) == 3           # baseline + ablation + projection
+    res = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert set(res["ablation"]["budgets"]) == {"1", "2"}
+    s = aot.stats()
+    assert s["fused"]["misses"] == 0, s
+    assert s["fused"]["fallbacks"] == 0, s
+    assert s["fused"]["hits"] > 0, s
+    # The legacy trio entries never dispatched.
+    for name in ("decode", "readout", "nll"):
+        assert s.get(name, {}).get("hits", 0) == 0, s
+
+
+# ---------------------------------------------------------------------------
+# Phase markers: wire format, parser split, --check --device acceptance.
+# ---------------------------------------------------------------------------
+
+def test_phase_table_annotation_wire_format_round_trip():
+    table = {"decode": 0.62, "readout": 0.21, "nll": 0.17}
+    name = prof.annotation_name("fused", 42, "fused_study", phases=table)
+    assert name == "tbx:fused#42@fused_study!decode=0.62+readout=0.21+nll=0.17"
+    m = prof._ANNOT_RE.match(name)
+    assert m.group("program") == "fused"
+    assert int(m.group("span")) == 42
+    assert m.group("fn") == "fused_study"
+    assert prof.parse_phase_table(m.group("phases")) == table
+    # Phase-less names still parse exactly as before.
+    bare = prof.annotation_name("decode", 7, "greedy_decode")
+    m2 = prof._ANNOT_RE.match(bare)
+    assert m2.group("fn") == "greedy_decode" and m2.group("phases") is None
+    assert prof.parse_phase_table(None) is None
+    assert prof.parse_phase_table("garbage") is None
+
+
+def test_phase_table_weights_normalized(setup):
+    params, cfg, tok, config, sae = setup
+    table = fused.phase_table(cfg, rows=4, prompt_len=8, new_tokens=4,
+                              sae_width=32)
+    assert tuple(table) == fused.FUSED_PHASES
+    assert abs(sum(table.values()) - 1.0) < 1e-2
+    assert all(w > 0 for w in table.values())
+
+
+def _ann(program, span_id, fn, t0, t1, phases=None):
+    a = {"program": program, "span_id": span_id, "fn": fn,
+         "t0": float(t0), "t1": float(t1)}
+    if phases:
+        a["phases"] = phases
+    return a
+
+
+def _slice(name, module, t0, dur, tid=1):
+    return {"name": name, "module": module, "t0": float(t0),
+            "dur": float(dur), "tid": tid}
+
+
+def test_build_profile_splits_fused_launch_per_phase():
+    table = {"decode": 0.5, "readout": 0.3, "nll": 0.2}
+    anns = [_ann("fused", 5, "fused_study", 1000, 9000, phases=table)]
+    slices = [_slice("dot.1", "jit_fused_study", 1500, 4000),
+              _slice("fusion.2", "jit_fused_study", 5600, 4000)]
+    p = prof.build_profile(anns, slices)
+    rec = p["programs"][0]
+    assert rec["joined"] == "window"
+    assert rec["phases_in_launch"] == ["decode", "readout", "nll"]
+    # One launch under its own program phase — not three.
+    assert p["phases"]["fused"]["launches"] == 1
+    split = p["fused_phase_split"]
+    total_dev = rec["device_seconds"]
+    assert split["source_device_seconds"] == pytest.approx(total_dev)
+    got = {k: v["device_seconds"] for k, v in split["phases"].items()}
+    for name, w in table.items():
+        assert got[name] == pytest.approx(total_dev * w, rel=1e-3)
+
+
+def test_check_device_accepts_multi_phase_fused_launch(tmp_path):
+    """One launch carrying multiple phase markers must pass the device-join
+    gate; a non-conserving split or an orphan marker must fail it."""
+    table = {"decode": 0.5, "readout": 0.3, "nll": 0.2}
+    anns = [_ann("fused", 0, "fused_study", 1000, 9000, phases=table)]
+    slices = [_slice("dot.1", "jit_fused_study", 1500, 5000)]
+    p = prof.build_profile(anns, slices)
+
+    def run_check(mutate=None):
+        d = json.loads(json.dumps(p))
+        if mutate:
+            mutate(d)
+        path = tmp_path / "_device_profile.json"
+        path.write_text(json.dumps(d))
+        # span_id 0 = "no obs span": the span-resolution check is skipped
+        # for it (matches annotate()'s default when no tracer is active).
+        return trace_report.check_device(str(path), [])
+
+    assert run_check() == []
+
+    def break_conservation(d):
+        d["fused_phase_split"]["phases"]["decode"]["device_seconds"] += 1.0
+
+    assert any("do not conserve" in e for e in run_check(break_conservation))
+
+    def drop_split(d):
+        del d["fused_phase_split"]
+
+    assert any("no fused_phase_split" in e for e in run_check(drop_split))
+
+    def orphan_marker(d):
+        d["programs"][0]["phases_in_launch"] = ["decode", "mystery"]
+
+    assert any("absent from fused_phase_split" in e
+               for e in run_check(orphan_marker))
+
+
+def test_device_report_renders_fused_phase_split(capsys):
+    table = {"decode": 0.5, "readout": 0.3, "nll": 0.2}
+    anns = [_ann("fused", 0, "fused_study", 1000, 9000, phases=table)]
+    slices = [_slice("dot.1", "jit_fused_study", 1500, 5000)]
+    p = prof.build_profile(anns, slices)
+    out = trace_report._device_section(p, {}, None)
+    assert "fused launch phase split" in out
+    for name in ("fused:decode", "fused:readout", "fused:nll"):
+        assert name in out
+
+
+def test_fused_dispatch_emits_phased_annotation_under_capture(setup,
+                                                             monkeypatch):
+    """dispatch_fused attaches the phase table only while a capture is
+    live (the not-capturing fast path stays the shared null context)."""
+    params, cfg, tok, config, sae = setup
+    captured = []
+
+    class FakeAnnotation:
+        def __init__(self, name):
+            captured.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    monkeypatch.setattr(prof, "_ACTIVE", True)
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", FakeAnnotation)
+    try:
+        padded, valid, positions, _ = decode.encode_prompts(
+            tok, ["Give me a hint", "a clue"])
+        fused.dispatch_fused(
+            params, cfg, prompt_ids=padded, prompt_valid=valid,
+            prompt_positions=positions,
+            target_ids=np.zeros((2,), np.int32),
+            max_new_tokens=4, tap_layer=2, top_k=3, spike_top_k=2,
+            route=False)
+    finally:
+        monkeypatch.setattr(prof, "_ACTIVE", False)
+    assert len(captured) == 1
+    m = prof._ANNOT_RE.match(captured[0])
+    assert m and m.group("program") == "fused"
+    table = prof.parse_phase_table(m.group("phases"))
+    assert table is not None and tuple(table) == fused.FUSED_PHASES
+
+
+# ---------------------------------------------------------------------------
+# Bench stage + regression sentinel.
+# ---------------------------------------------------------------------------
+
+def test_bench_fused_ab_smoke(setup):
+    import bench
+
+    params, cfg, tok, config, sae = setup
+    out = bench._fused_ab(params, cfg, sae, tap_layer=2, prompt_len=8,
+                          new_tokens=3, rows=2, reps=1, budget_s=600,
+                          spec=None)
+    by_name = {r["variant"]: r for r in out["results"]}
+    assert set(by_name) == {"legacy", "fused"}
+    assert all("error" not in r for r in out["results"]), out["results"]
+    assert out["fused_speedup"] is not None
+    assert set(out["device_idle_share"]) == {"legacy", "fused"}
+    # The fused arm's captured pass rode the phase table through the parser.
+    assert "fused_phase_split" in by_name["fused"]
+
+
+def _write_round(tmp_path, n, parsed):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "parsed": parsed}))
+
+
+def test_bench_compare_gates_fused_speedup(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.5,
+                                            "device_idle_share": 0.01}})
+    _write_round(tmp_path, 2, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.0,
+                                            "device_idle_share": 0.01}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any(r.startswith("fused_ab.fused_speedup") for r in regressions)
+
+
+def test_bench_compare_idle_share_slack_absorbs_near_zero_noise(tmp_path):
+    # 0.01 -> 0.02 is +100% relative but within the absolute slack: ok.
+    _write_round(tmp_path, 1, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.5,
+                                            "device_idle_share": 0.01}})
+    _write_round(tmp_path, 2, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.5,
+                                            "device_idle_share": 0.02}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0, regressions
+    # A real idle blow-up still fails.
+    _write_round(tmp_path, 3, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.5,
+                                            "device_idle_share": 0.4}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("fused_ab.device_idle_share" in r for r in regressions)
+
+
+def test_bench_compare_round_without_fused_stage_skips_with_note(tmp_path):
+    _write_round(tmp_path, 1, {"value": 20.0,
+                               "fused_ab": {"fused_speedup": 1.5,
+                                            "device_idle_share": 0.01}})
+    _write_round(tmp_path, 2, {"value": 20.0})      # stage not run (r04-style)
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0, regressions
+    assert any("fused_ab.fused_speedup" in line and "skipped" in line
+               for line in lines)

@@ -8,8 +8,11 @@ Layers:
   occupancy clipping, FIFO matching of async dispatches by HLO module,
   capture-truncated tails, op classes, device busy/idle accounting;
 - the committed fixture (tests/fixtures/obs/device/): re-parsing the REAL
-  captured ``trace.json.gz`` reproduces the committed artifact, and
-  ``trace_report --check --device`` holds its join invariants green;
+  captured ``trace.json.gz`` (a TBX_FUSED=1 sweep — every launch one fused
+  program carrying the multi-phase in-graph table, runtime/fused.py)
+  reproduces the committed artifact, and ``trace_report --check --device``
+  holds its join invariants — including the fused_phase_split conservation
+  gate — green;
 - an end-to-end CPU capture: ``TBX_PROFILE=1`` on a small sweep writes a
   ``_device_profile.json`` whose annotated launches all join device slices;
 - the bench regression sentinel (tools/bench_compare.py).
@@ -180,11 +183,18 @@ def test_fixture_trace_reparse_reproduces_artifact(fixture_profile):
 
 
 def test_fixture_every_launch_joined(fixture_profile):
+    # The fixture sweep runs under TBX_FUSED=1 (tools/make_device_fixture.py):
+    # per word one fused baseline launch + one per arm chunk, each a SINGLE
+    # annotated program carrying the multi-phase in-graph table.
     programs = fixture_profile["programs"]
-    assert len(programs) >= 12          # 2 words x 3 programs x >=2 launches
-    assert {r["program"] for r in programs} == {"decode", "readout", "nll"}
+    assert len(programs) >= 6           # 2 words x (baseline + 2 arm chunks)
+    assert {r["program"] for r in programs} == {"fused"}
     assert all(r["slices"] >= 1 for r in programs)
     assert all(r["joined"] in ("window", "fifo", "order") for r in programs)
+    assert all(r.get("phases_in_launch") == ["decode", "readout", "nll"]
+               for r in programs)
+    split = fixture_profile["fused_phase_split"]["phases"]
+    assert set(split) == {"decode", "readout", "nll"}
 
 
 def test_fixture_device_check_is_green(capsys):
@@ -205,7 +215,9 @@ def test_device_report_renders(fixture_profile, capsys):
     assert rc == 0
     assert "device profile:" in out
     assert "MEASURED dispatch gap" in out
-    for program in ("decode", "readout", "nll"):
+    assert "fused" in out
+    assert "fused launch phase split" in out
+    for program in ("fused:decode", "fused:readout", "fused:nll"):
         assert program in out
     assert "top ops by device time:" in out
     assert "op classes:" in out

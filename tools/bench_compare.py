@@ -35,16 +35,24 @@ from typing import Any, Dict, List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: metric key (dotted path into the parsed headline) ->
-#: (tolerance fraction, higher_is_better).  Regression = the latest value
-#: worse than predecessor by more than the band.
-METRICS: Dict[str, Tuple[float, bool]] = {
-    "value": (0.10, True),                       # prompts/sec/chip
-    "tflops_per_sec": (0.10, True),
-    "mfu": (0.10, True),
-    "measured_study_seconds_per_word": (0.25, False),
-    "projected_full_sweep_hours": (0.25, False),
-    "serve_latency.p99_s": (0.50, False),
-    "serve_latency.completed_per_second": (0.25, True),
+#: (tolerance fraction, higher_is_better, absolute slack).  Regression =
+#: the latest value worse than predecessor by more than the band AND by
+#: more than the absolute slack — the slack exists for share-type metrics
+#: whose healthy value sits near zero (a device-idle share moving
+#: 0.01 -> 0.02 is +100% relative but still noise-level idle).
+METRICS: Dict[str, Tuple[float, bool, float]] = {
+    "value": (0.10, True, 0.0),                  # prompts/sec/chip
+    "tflops_per_sec": (0.10, True, 0.0),
+    "mfu": (0.10, True, 0.0),
+    "measured_study_seconds_per_word": (0.25, False, 0.0),
+    "projected_full_sweep_hours": (0.25, False, 0.0),
+    "serve_latency.p99_s": (0.50, False, 0.0),
+    "serve_latency.completed_per_second": (0.25, True, 0.0),
+    # Fused-loop rollout metrics (bench.py sweep.fused_ab, ISSUE 8):
+    # fused-over-legacy launch speedup must not slide back, and the fused
+    # arm's measured device-idle (dispatch-gap) share must stay ≈0.
+    "fused_ab.fused_speedup": (0.25, True, 0.0),
+    "fused_ab.device_idle_share": (0.50, False, 0.02),
 }
 
 #: Absolute-budget metrics: (max allowed value).  Checked on the LATEST
@@ -111,7 +119,7 @@ def compare(repo: str = REPO) -> Tuple[List[str], List[str], int]:
     else:
         prev_n, prev, _ = parseable[-2]
         lines.append(f"comparing round {latest_n} against round {prev_n}:")
-        for key, (tol, higher) in METRICS.items():
+        for key, (tol, higher, slack) in METRICS.items():
             a, b = _get(prev, key), _get(latest, key)
             if a is None or b is None:
                 which = [w for w, v in (("previous", a), ("latest", b))
@@ -120,7 +128,8 @@ def compare(repo: str = REPO) -> Tuple[List[str], List[str], int]:
                              f"{'/'.join(which)})")
                 continue
             delta = (b - a) / a if a else 0.0
-            bad = (b < a * (1.0 - tol)) if higher else (b > a * (1.0 + tol))
+            bad = ((b < a * (1.0 - tol) - slack) if higher
+                   else (b > a * (1.0 + tol) + slack))
             verdict = "REGRESSION" if bad else "ok"
             lines.append(
                 f"  {key:<44} {a:>10.4g} -> {b:>10.4g}  "

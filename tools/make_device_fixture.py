@@ -12,6 +12,14 @@ directory, and tests/test_profile.py re-parses ``trace.json.gz`` and asserts
 the parser reproduces the committed artifact — so neither the artifact
 schema nor the trace parser can drift silently.
 
+The sweep runs under ``TBX_FUSED=1`` (override with ``TBX_FUSED=0``): every
+study launch is one FUSED program carrying the multi-phase in-graph phase
+table (runtime/fused.py), so the committed fixture holds the join cascade's
+acceptance of a single launch with multiple phase markers — and the
+``fused_phase_split`` conservation invariant — green in check.sh.  Legacy
+single-phase joins stay covered by tests/test_profile.py's synthetic
+timelines and its end-to-end sweep capture.
+
     JAX_PLATFORMS=cpu python tools/make_device_fixture.py
 """
 
@@ -33,6 +41,7 @@ os.environ["TBX_PROFILE_WORDS"] = "2"
 # the join invariant (zero truncated records).
 os.environ["TBX_CROSS_WORD_BASELINE"] = "0"
 os.environ["TBX_AOT_WARMSTART"] = "off"
+os.environ.setdefault("TBX_FUSED", "1")
 
 FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "obs", "device")
 

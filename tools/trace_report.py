@@ -43,7 +43,10 @@ additionally gates the device join: every annotated program launch pooled
 ≥1 device slice (unless truncated by the capture boundary), every record's
 span id resolves into the event stream, window-joined device occupancy
 never exceeds its span's wall time, and device busy never exceeds the
-capture extent.
+capture extent.  A FUSED launch (``TBX_FUSED=1``, runtime/fused.py) is one
+dispatch legitimately carrying multiple phase markers — accepted, with its
+``fused_phase_split`` gated for conservation (per-phase seconds must
+redistribute the fused launches' measured device seconds exactly).
 
 stdlib-only on purpose: this must run on a laptop against an rsync'd
 results directory with no jax installed.
@@ -294,6 +297,31 @@ def _device_section(profile: Dict[str, Any], spans: Dict[int, Span],
             lines.append("  (ceiling_s per launch from sweep.phase_roofline; "
                          "ratio_of_ceiling = ceiling/mean MEASURED device "
                          "seconds — the device-clock honesty check)")
+    split = profile.get("fused_phase_split")
+    if split and split.get("phases"):
+        # A fused launch (runtime/fused.py) is ONE dispatch carrying a
+        # multi-phase table: render its per-phase device attribution so the
+        # device section doesn't collapse decode/readout/nll into one opaque
+        # row.  The split is the in-graph phase table's (analytic weights at
+        # launch shapes), applied to MEASURED launch device seconds.
+        src = split.get("source_device_seconds") or 0.0
+        lines.append(f"  fused launch phase split "
+                     f"({_fmt_s(src)}s of fused device time, in-graph "
+                     "phase table):")
+        for pname, cell in split["phases"].items():
+            dev_s = cell.get("device_seconds", 0.0)
+            launches = cell.get("launches", 0)
+            extra = ""
+            if roofline and pname in _ROOFLINE_NAMES and launches:
+                ceiling = (roofline.get(pname) or {}).get("ceiling_seconds")
+                if ceiling and dev_s > 0:
+                    extra = (f"  ceiling {_fmt_s(ceiling)}s/launch, "
+                             f"ratio_of_ceiling "
+                             f"{ceiling / (dev_s / launches):.3f}")
+            share = (dev_s / src) if src else 0.0
+            lines.append(f"    fused:{pname:<10} {_fmt_s(dev_s)}s "
+                         f"({share:.0%} of fused, {launches} launch(es))"
+                         f"{extra}")
     unattr = profile.get("unattributed", {})
     if unattr.get("seconds"):
         lines.append(f"  unattributed device time: "
@@ -379,6 +407,34 @@ def check_device(profile_path: str, events: List[Dict[str, Any]]) -> List[str]:
             f"{profile_path}: device busy union "
             f"{dev.get('busy_union_seconds')}s exceeds the capture extent "
             f"{dev.get('capture_seconds')}s")
+    # Fused launches: one launch legitimately carries MULTIPLE phase markers
+    # (runtime/fused.py phase table) — never a one-program-per-span
+    # violation.  The join cascade accepts them; what IS gated is the
+    # split's conservation: the per-phase attribution must redistribute the
+    # fused launches' measured device seconds, not invent or lose any.
+    split = profile.get("fused_phase_split")
+    if split is not None:
+        cells = split.get("phases") or {}
+        if not cells:
+            errors.append(f"{profile_path}: fused_phase_split has no phases")
+        total = sum(c.get("device_seconds", 0.0) for c in cells.values())
+        src = split.get("source_device_seconds", 0.0)
+        if abs(total - src) > max(1e-3, 0.01 * src):
+            errors.append(
+                f"{profile_path}: fused_phase_split seconds {total:.6f} do "
+                f"not conserve the fused launches' device seconds "
+                f"{src:.6f}")
+        for i, rec in enumerate(programs):
+            for pname in rec.get("phases_in_launch", ()):
+                if pname not in cells:
+                    errors.append(
+                        f"{profile_path}: programs[{i}] carries phase "
+                        f"marker {pname!r} absent from fused_phase_split")
+    else:
+        if any(rec.get("phases_in_launch") for rec in programs):
+            errors.append(
+                f"{profile_path}: launches carry phase markers but there "
+                "is no fused_phase_split section")
     return errors
 
 
