@@ -53,7 +53,11 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
   measured readout variant x chunk table behind the foldexp default;
   "sweep.fused_ab" (BENCH_FUSED_AB) is the legacy-three-dispatch vs
   one-fused-launch table (runtime/fused.py) with per-arm measured
-  device-idle share — the TBX_FUSED rollout gate.
+  device-idle share — the TBX_FUSED rollout gate; "sweep.spec_ab"
+  (BENCH_SPEC_AB, default-on even on CPU smoke) is the vanilla-greedy vs
+  lens-head-speculative table (runtime/speculate.py) — per-word accept
+  rate, mean emitted tokens/verify, end-to-end spec_speedup, and the
+  re-proven token-stream exactness bit — the TBX_SPECULATE rollout gate.
 - Timing loops interleave the phases within each rep AND regenerate inputs
   per rep from fresh seeds: the axon TPU runtime dedupes repeated executions
   with byte-identical inputs (~0.1 ms), which would turn any fixed-input
@@ -594,6 +598,114 @@ def _fused_ab(params, cfg, sae, tap_layer: int, prompt_len: int,
     }
 
 
+def _spec_ab(params, cfg, rows: int, prompt_len: int, new_tokens: int,
+             reps: int, budget_s: float, n_words: int) -> dict:
+    """``spec_ab`` stage (ISSUE 9): vanilla greedy decode vs the lens-head
+    self-speculative decoder (``TBX_SPECULATE``, runtime/speculate.py) at
+    the per-word decode shape.
+
+    Rides the ``readout_ab``/``fused_ab`` pattern (per-variant failure
+    isolation + wall budget); each synthetic "word" is a fresh seeded prompt
+    batch, and the table commits per word what the rollout decision needs:
+    measured accept_rate, mean accepted tokens per verify launch, the
+    end-to-end spec_speedup over vanilla greedy — and the EXACTNESS bit
+    (token streams ``array_equal``), re-proven on the bench shape every
+    round, not just in tier-1.  The (k, G) plan resolves exactly as
+    production does (env → calibration artifact → heuristic default).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.perf import spec_calibrate
+    from taboo_brittleness_tpu.runtime import decode, speculate
+
+    plan = speculate.resolve_plan(cfg)
+    t_start = time.monotonic()
+    per_word = []
+    exhausted = False
+    for w in range(n_words):
+        if time.monotonic() - t_start > budget_s:
+            exhausted = True
+            break
+        rec = {"word": f"w{w:02d}"}
+        try:
+            def make_inputs(seed):
+                r = np.random.default_rng(seed)
+                prompts = [list(r.integers(1, cfg.vocab_size,
+                                           size=prompt_len))
+                           for _ in range(rows)]
+                padded, valid, positions = decode.pad_prompts(prompts)
+                return (jnp.asarray(padded), jnp.asarray(valid),
+                        jnp.asarray(positions))
+
+            def run_vanilla(seed):
+                out = decode.greedy_decode(
+                    params, cfg, *make_inputs(seed),
+                    max_new_tokens=new_tokens, stop_ids=(-1,))
+                jax.block_until_ready(out.tokens)
+                return out
+
+            def run_spec(seed):
+                out, st = speculate.speculative_decode(
+                    params, cfg, *make_inputs(seed),
+                    max_new_tokens=new_tokens,
+                    draft_layer=plan.draft_layer,
+                    block_size=plan.block_size, stop_ids=(-1,))
+                jax.block_until_ready(out.tokens)
+                return out, st
+
+            base_seed = 91_000 + w * 100
+            van = run_vanilla(base_seed)        # compile + first dispatch
+            spec_out, _ = run_spec(base_seed)
+            rec["exact"] = bool(np.array_equal(np.asarray(van.tokens),
+                                               np.asarray(spec_out.tokens)))
+            v_secs, s_secs = [], []
+            stats = None
+            for rep in range(reps):
+                seed = base_seed + 1 + rep      # fresh inputs per rep
+                t0 = time.perf_counter()
+                run_vanilla(seed)
+                v_secs.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                _, stats = run_spec(seed)
+                s_secs.append(time.perf_counter() - t0)
+            v_s, s_s = float(np.mean(v_secs)), float(np.mean(s_secs))
+            rec.update(
+                vanilla_seconds=round(v_s, 4),
+                spec_seconds=round(s_s, 4),
+                spec_speedup=round(v_s / s_s, 3) if s_s else None,
+                accept_rate=round(stats.accept_rate, 4),
+                tokens_per_verify=round(stats.tokens_per_verify, 3),
+                blocks=stats.blocks,
+                model_suggests=spec_calibrate.geometric_accept_stats(
+                    stats.accepted, stats.drafted),
+            )
+        except Exception as e:  # noqa: BLE001 — one word must not void the rest
+            rec["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        per_word.append(rec)
+
+    timed = [r for r in per_word if "spec_speedup" in r]
+    mean = (lambda key: round(float(np.mean([r[key] for r in timed])), 4)
+            if timed else None)
+    return {
+        "rows": rows,
+        "reps": reps,
+        "plan": {"draft_layer": plan.draft_layer,
+                 "block_size": plan.block_size, "source": plan.source},
+        "results": per_word,
+        "spec_speedup": mean("spec_speedup"),
+        "accept_rate": mean("accept_rate"),
+        "tokens_per_verify": mean("tokens_per_verify"),
+        "all_exact": bool(timed) and all(r.get("exact") for r in timed),
+        "budget_exhausted": exhausted,
+        "note": "TBX_SPECULATE=1 selects the speculative path in production "
+                "(runtime/speculate.py; TBX_SPECULATE_CAPTURE=1 extends it "
+                "to the study's residual-capturing decodes); vanilla stays "
+                "default until a TPU round lands spec_speedup > 1 here with "
+                "all_exact true",
+    }
+
+
 def _sweep_bench(params, cfg, sae, tap_layer: int,
                  on_accel: bool, prompt_len: int, new_tokens: int) -> dict:
     """Measure the intervention sweep's batched-arm launch (decode with
@@ -713,6 +825,22 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
             budget_s=float(os.environ.get("BENCH_FUSED_AB_BUDGET_S", "900")),
             spec=spec)
 
+    spec_ab = None
+    # Default-ON everywhere (the acceptance contract runs it on CPU smoke
+    # too — the exactness bit must land every round, accelerator or not).
+    if os.environ.get("BENCH_SPEC_AB", "1") == "1":
+        spec_ab = _spec_ab(
+            params, cfg,
+            rows=int(os.environ.get("BENCH_SPEC_AB_ROWS",
+                                    str(prompts_per_word if on_accel
+                                        else 2))),
+            prompt_len=prompt_len, new_tokens=new_tokens,
+            reps=int(os.environ.get("BENCH_SPEC_AB_REPS",
+                                    "2" if on_accel else "1")),
+            budget_s=float(os.environ.get("BENCH_SPEC_AB_BUDGET_S", "900")),
+            n_words=int(os.environ.get("BENCH_SPEC_AB_WORDS",
+                                       "3" if on_accel else "2")))
+
     return {
         "rows_per_launch": rows,
         "arms_per_launch": arms_per_launch,
@@ -739,6 +867,7 @@ def _sweep_bench(params, cfg, sae, tap_layer: int,
         "phase_roofline": phase_roofline,
         "readout_ab": readout_ab,
         "fused_ab": fused_ab,
+        "spec_ab": spec_ab,
         "v5e8_derate_model": band,
         "assumptions": "steady-state (compile amortized; 3 programs total for "
                        "the whole study), checkpoint load/host IO excluded "
@@ -1324,6 +1453,17 @@ def main() -> int:
              "device_idle_share_legacy":
                  sweep["fused_ab"]["device_idle_share"].get("legacy")}
             if sweep and sweep.get("fused_ab") else None),
+        # Speculative-decoding A/B (runtime/speculate.py, stage
+        # sweep.spec_ab): lens-head draft + full-verify vs vanilla greedy —
+        # accept rate x speedup, plus the per-round re-proof that the token
+        # streams are exact (the rollout gate: TBX_SPECULATE flips once
+        # spec_speedup > 1 with all_exact on a real round).
+        "spec_ab": (
+            {"spec_speedup": sweep["spec_ab"].get("spec_speedup"),
+             "accept_rate": sweep["spec_ab"].get("accept_rate"),
+             "tokens_per_verify": sweep["spec_ab"].get("tokens_per_verify"),
+             "all_exact": sweep["spec_ab"].get("all_exact")}
+            if sweep and sweep.get("spec_ab") else None),
         "warm_start_seconds": (
             study and study.get("warm_start", {}).get("measured_seconds")),
         # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;

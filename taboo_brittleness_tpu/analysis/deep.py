@@ -266,6 +266,72 @@ def _entry_fused_study():
                 nll["nll_next_mask"])
 
 
+def _spec_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _tiny_cfg()
+    B, Tp, N, G, k = 2, 4, 3, 2, 1
+    S = Tp + N + G + 1
+    sds = jax.ShapeDtypeStruct
+
+    def kv(layers):
+        return sds((layers, B, S, cfg.num_kv_heads, cfg.head_dim),
+                   jnp.bfloat16)
+
+    return cfg, B, Tp, N, G, k, S, sds, kv
+
+
+def _entry_spec_draft_step():
+    # The speculative decoder's draft program (runtime/speculate.py, ISSUE
+    # 9): G single-token forwards over layers 0..k inside one launch, each
+    # step's lens argmax over a transient [B, 1, V] f32 logits row — the
+    # same reviewed-and-baselined readout class as the decode/serve heads.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import speculate
+
+    cfg, B, Tp, N, G, k, S, sds, kv = _spec_shapes()
+    params = _abstract_params(cfg)
+
+    def fn(p, dk, dv, pv, last, n, done, plen):
+        return speculate.draft_step(p, cfg, dk, dv, pv, last, n, done, plen,
+                                    draft_layer=k, block_size=G)
+
+    return fn, (params, kv(k + 1), kv(k + 1),
+                sds((B, Tp), jnp.bool_), sds((B,), jnp.int32),
+                sds((B,), jnp.int32), sds((B,), jnp.bool_),
+                sds((B,), jnp.int32))
+
+
+def _entry_spec_verify_block():
+    # The speculative decoder's verify program: ONE full-depth forward over
+    # the G+1 teacher-forced chunk with a transient [B, G+1, V] f32 logits
+    # slab (argmax fused into the unembed epilogue) + in-graph acceptance.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import speculate
+
+    cfg, B, Tp, N, G, k, S, sds, kv = _spec_shapes()
+    params = _abstract_params(cfg)
+
+    def fn(p, mk, mv, pv, toks, emit, resid, last, n, done, plen, drafts):
+        return speculate.verify_block(
+            p, cfg, mk, mv, pv, toks, emit, resid, last, n, done, plen,
+            drafts, max_new_tokens=N, block_size=G,
+            capture_residual_layer=2)
+
+    return fn, (params, kv(cfg.num_layers), kv(cfg.num_layers),
+                sds((B, Tp), jnp.bool_),
+                sds((B, N + 1), jnp.int32), sds((B, N + 1), jnp.bool_),
+                sds((B, S, cfg.hidden_size), jnp.float32),
+                sds((B,), jnp.int32), sds((B,), jnp.int32),
+                sds((B,), jnp.bool_), sds((B,), jnp.int32),
+                sds((B, G), jnp.int32))
+
+
 ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
     ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
@@ -274,6 +340,8 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),
     ("runtime.fused.fused_study", _entry_fused_study),
+    ("runtime.speculate.draft_step", _entry_spec_draft_step),
+    ("runtime.speculate.verify_block", _entry_spec_verify_block),
 ]
 
 

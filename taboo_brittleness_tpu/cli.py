@@ -616,6 +616,54 @@ def cmd_supervise(args) -> int:
     return res.exit_code
 
 
+def cmd_chat(args) -> int:
+    """Interactive greedy chat REPL over one word's checkpoint
+    (``runtime.chat.run_chat``).  Honors ``TBX_SPECULATE=1`` — the
+    interactive path rides ``decode.generate``'s speculative dispatch, so
+    replies stream in lens-draft/full-verify blocks with exactly the
+    vanilla greedy text."""
+    from taboo_brittleness_tpu.runtime import chat as chat_mod
+    from taboo_brittleness_tpu.runtime import speculate
+
+    config = _load(args)
+    word = args.word or (config.words[0] if config.words else None)
+    if word is None:
+        raise SystemExit("chat: no word to load (pass --word or configure "
+                         "config.words)")
+    speculate.set_active_word(word)
+    params, cfg, tok = _loader(config, args)(word)
+    replies = chat_mod.run_chat(params, cfg, tok,
+                                max_new_tokens=args.max_new_tokens)
+    # tbx: TBX009-ok — CLI stdout contract (session summary)
+    print(f"[chat] session closed after {replies} repl(ies)")
+    return 0
+
+
+def cmd_spec_calibrate(args) -> int:
+    """Host-side (k, G) speculation calibration from the cached lens sweeps
+    (``perf.spec_calibrate``): reads per-layer lens agreement-with-final
+    rates out of the existing summary / all_probs artifacts and writes the
+    ``TBX_SPEC_CALIBRATION`` artifact.  No model launch, no accelerator."""
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.perf import spec_calibrate
+
+    config = _load(args)
+    cfg = gemma2.config_for(config.model.arch, dtype=config.model.dtype,
+                            param_dtype=config.model.param_dtype)
+    processed = args.processed_dir or config.output.processed_dir
+    words = list(args.words if args.words else config.words)
+    artifact = spec_calibrate.calibrate_words(
+        processed, words, cfg, max_block=args.max_block,
+        rows=args.rows)
+    spec_calibrate.write_calibration(args.out, artifact)
+    # tbx: TBX009-ok — CLI stdout contract (calibration summary JSON)
+    print(json.dumps({"out": args.out,
+                      "calibrated": sorted(artifact["words"]),
+                      "uncalibrated": artifact["uncalibrated"],
+                      "default": artifact["default"]}, indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="taboo_brittleness_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -795,6 +843,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="the pipeline subcommand (and its args) to run "
                          "supervised, after a literal --")
     sv.set_defaults(fn=cmd_supervise)
+
+    ch = sub.add_parser(
+        "chat",
+        help="interactive greedy chat REPL over one word's checkpoint "
+             "(TBX_SPECULATE=1 → lens-draft speculative decoding)")
+    _common(ch)
+    ch.add_argument("--word", default=None,
+                    help="taboo word whose checkpoint to load "
+                         "(default: first configured word)")
+    ch.add_argument("--max-new-tokens", type=int, default=128)
+    ch.set_defaults(fn=cmd_chat)
+
+    sc = sub.add_parser(
+        "spec-calibrate",
+        help="calibrate per-word speculative-decoding (draft layer, block "
+             "size) from the cached lens sweeps (host-side, no model)")
+    _common(sc)
+    sc.add_argument("--out", default=os.path.join("results",
+                                                  "spec_calibration.json"),
+                    help="calibration artifact path (point "
+                         "TBX_SPEC_CALIBRATION here)")
+    sc.add_argument("--max-block", type=int, default=8,
+                    help="largest draft block size the chooser searches")
+    sc.add_argument("--rows", type=int, default=10,
+                    help="batch rows assumed by the roofline cost model")
+    sc.set_defaults(fn=cmd_spec_calibrate)
     return p
 
 

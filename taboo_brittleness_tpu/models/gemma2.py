@@ -259,11 +259,17 @@ def _layer(
     k = apply_rope(k, cos, sin)
 
     if cache_k is not None and cache_positions is not None:
-        # Serve mode (T=1): each row writes its own column — continuous-
-        # batching slots at different sequence lengths share one program.
+        # Per-row write columns: serve mode ([B], T=1 — continuous-batching
+        # slots at different lengths share one program) or a multi-token
+        # chunk ([B, T] — the speculative verify block writes G+1 columns at
+        # per-row offsets, since rows accept different draft counts).
         rows = jnp.arange(B)
-        k_all = cache_k.at[rows, cache_positions].set(k[:, 0])
-        v_all = cache_v.at[rows, cache_positions].set(v[:, 0])
+        if cache_positions.ndim == 1:
+            k_all = cache_k.at[rows, cache_positions].set(k[:, 0])
+            v_all = cache_v.at[rows, cache_positions].set(v[:, 0])
+        else:
+            k_all = cache_k.at[rows[:, None], cache_positions].set(k)
+            v_all = cache_v.at[rows[:, None], cache_positions].set(v)
     elif cache_k is not None:
         k_all = lax.dynamic_update_slice(cache_k, k, (0, cache_index, 0, 0))
         v_all = lax.dynamic_update_slice(cache_v, v, (0, cache_index, 0, 0))
@@ -359,11 +365,16 @@ def forward(
     ring path (``parallel.sp.forward_sp``) passes a closure over ring
     attention here.  Mutually exclusive with ``cache``.
 
-    ``cache_positions`` ([B] int32, requires ``cache`` and T=1) writes each
-    row's new key/value at its OWN column instead of the shared
-    ``cache.length`` pointer: the continuous-batching serve engine
-    (``serve.engine``) keeps slots at different sequence lengths in one
-    batch, each slot owning columns ``[0, its length)`` of its cache row.
+    ``cache_positions`` (requires ``cache``) writes each row's new key/value
+    at its OWN column instead of the shared ``cache.length`` pointer: [B]
+    int32 with T=1 is the continuous-batching serve engine's form
+    (``serve.engine`` keeps slots at different sequence lengths in one
+    batch, each slot owning columns ``[0, its length)`` of its cache row);
+    [B, T] int32 maps every chunk position to its own column — the
+    speculative verify block (``runtime.speculate``) teacher-forces G+1
+    tokens per row at per-row offsets, since rows accept different draft
+    counts.  Columns must be written in increasing per-row order (masking
+    reconstructs KV positions from the validity cumsum).
     ``cache.length`` is neither read nor meaningfully advanced in this mode —
     per-slot lengths live with the caller; masking already derives KV
     positions from ``valid`` alone.
@@ -372,9 +383,16 @@ def forward(
         raise ValueError("attend_fn does not support the KV-cache decode path")
     if cache_positions is not None and cache is None:
         raise ValueError("cache_positions requires the KV-cache decode path")
-    if cache_positions is not None and input_ids.shape[1] != 1:
-        raise ValueError("cache_positions supports single-token chunks only "
-                         f"(got T={input_ids.shape[1]})")
+    if (cache_positions is not None and cache_positions.ndim == 1
+            and input_ids.shape[1] != 1):
+        raise ValueError("[B] cache_positions supports single-token chunks "
+                         f"only (got T={input_ids.shape[1]}); pass a [B, T] "
+                         "column map for multi-token chunks")
+    if (cache_positions is not None and cache_positions.ndim == 2
+            and cache_positions.shape != input_ids.shape):
+        raise ValueError(
+            f"[B, T] cache_positions {cache_positions.shape} must match "
+            f"input_ids {input_ids.shape}")
     B, T = input_ids.shape
     cdt = cfg.compute_dtype
 
@@ -403,7 +421,10 @@ def forward(
         S = cache.k.shape[2]
         # The new chunk's slot validity lands at [length, length+T) — or, in
         # serve mode, at each row's own column.
-        if cache_positions is not None:
+        if cache_positions is not None and cache_positions.ndim == 2:
+            new_valid = cache.valid.at[
+                jnp.arange(B)[:, None], cache_positions].set(attn_validity)
+        elif cache_positions is not None:
             new_valid = cache.valid.at[
                 jnp.arange(B), cache_positions].set(attn_validity[:, 0])
         else:
@@ -443,7 +464,13 @@ def forward(
                 h, lp, idx, cfg, cos, sin, mask_global, mask_sliding,
                 ck, cv, cache.length, cache_positions=cache_positions,
             )
-            if cache_positions is not None:
+            if cache_positions is not None and cache_positions.ndim == 2:
+                rows = jnp.arange(B)
+                k_stack = k_stack.at[idx, rows[:, None], cache_positions].set(
+                    new_k)
+                v_stack = v_stack.at[idx, rows[:, None], cache_positions].set(
+                    new_v)
+            elif cache_positions is not None:
                 rows = jnp.arange(B)
                 k_stack = k_stack.at[idx, rows, cache_positions].set(
                     new_k[:, 0])

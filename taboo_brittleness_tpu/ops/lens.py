@@ -118,6 +118,24 @@ def lens_probs_foldexp(
     return jnp.exp(logits - lse)
 
 
+def lens_argmax(
+    params: Params,
+    cfg: Gemma2Config,
+    h: jax.Array,
+) -> jax.Array:
+    """Greedy lens readout: argmax of the layer-h lens logits, int32.
+
+    The draft head of the self-speculative decoder (``runtime.speculate``):
+    an early layer's unembedded residual IS a free draft model living inside
+    the target network, and drafting only needs its argmax.  Softcapping is
+    skipped deliberately — ``tanh(x/c)*c`` is strictly monotone, so the
+    argmax is identical with or without the cap and the elementwise pass
+    over the [*, V] logits is saved.  The [*, V] f32 logits stay transient
+    inside the enclosing program (XLA fuses the argmax into the unembed
+    epilogue, the same argument as the lens taps above)."""
+    return jnp.argmax(_lens_logits(params, cfg, h), axis=-1).astype(jnp.int32)
+
+
 def make_lens_tap(
     params: Params,
     cfg: Gemma2Config,

@@ -1,0 +1,270 @@
+"""Per-word (draft layer, block size) calibration for speculative decoding.
+
+The speculative decoder (``runtime.speculate``) drafts from a layer-k lens
+head and verifies with the full forward; its throughput is governed by the
+probability that the layer-k lens ARGMAX agrees with the final head's.  That
+agreement is already sitting on disk: every cached lens sweep artifact
+carries per-layer argmax ids — the compact summary's ``argmax_id [L, T]``
+(``runtime.cache.save_summary``) or, in parity mode, the reference-schema
+``all_probs [L, T, V]`` dump — so calibration is a pure host-side read, no
+model launch.
+
+The objective is Sequoia's expected-throughput form (arXiv:2402.12374), not a
+fixed heuristic: with per-position acceptance modeled i.i.d. at the measured
+agreement rate α(k), a block of G drafts emits ``E[tokens] = Σ_{i=0..G} α^i``
+per verify (accepted prefix + the guaranteed bonus), and the chooser
+maximizes ``E[tokens] / (G·c_draft(k) + c_verify(G))`` where both costs come
+from the roofline's decode-step HBM model (``perf.roofline``): decode is
+memory-bound, so a draft step costs the layers-0..k weight stream plus the
+lens unembed stream, and a verify block costs ONE full weight stream
+amortized over its G+1 positions.  Everything here is numpy + stdlib — like
+the rest of ``perf/``, importable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Calibration artifact schema version (README "Speculative decoding").
+SCHEMA_VERSION = 1
+
+#: Largest block size the chooser searches.  Deep blocks pay G draft steps
+#: for exponentially-discounted acceptance (α^G), so the optimum is small
+#: unless agreement is extreme.
+DEFAULT_MAX_BLOCK = 8
+
+
+# ---------------------------------------------------------------------------
+# Agreement extraction from cached artifacts.
+# ---------------------------------------------------------------------------
+
+def layer_agreement(argmax_id: np.ndarray,
+                    response_start: int = 0) -> np.ndarray:
+    """[L] agreement-with-final rates from a per-layer argmax table.
+
+    ``argmax_id`` is [L, T] lens argmax ids (summary schema); the final
+    layer's row IS the model's greedy head (the lens at the last layer
+    unembeds the same residual the logits do, and softcapping is monotone),
+    so row agreement with it estimates the draft acceptance rate.  Only
+    columns from ``response_start`` on count — drafting happens in the
+    response region, and prompt columns would dilute the estimate."""
+    arr = np.asarray(argmax_id)
+    if arr.ndim != 2:
+        raise ValueError(f"argmax_id must be [L, T], got {arr.shape}")
+    window = arr[:, response_start:]
+    if window.shape[1] == 0:
+        window = arr
+    return (window == window[-1:]).mean(axis=1)
+
+
+def agreement_from_summary(path: str) -> Optional[np.ndarray]:
+    """[L] agreement rates from one compact summary npz, or None when the
+    file is unreadable (calibration is best-effort; a torn cell costs one
+    prompt's evidence, not the word)."""
+    try:
+        with np.load(path) as data:
+            if "argmax_id" not in data.files:
+                return None
+            arr = data["argmax_id"]
+            start = 0
+            if "__meta__" in data.files:
+                meta = json.loads(bytes(data["__meta__"]).decode())
+                start = int(meta.get("response_start", 0))
+        return layer_agreement(arr, response_start=start)
+    except Exception:  # noqa: BLE001 — unreadable cells are skipped
+        return None
+
+
+def agreement_from_pair(npz_path: str,
+                        json_path: Optional[str] = None) -> Optional[np.ndarray]:
+    """[L] agreement rates from a reference-schema ``all_probs`` dump.
+
+    The argmax over the [L, T, V] probability tensor reduces it to the
+    summary's argmax table; the response window comes from the sidecar's
+    ``input_words`` via the chat template's response-start convention."""
+    try:
+        with np.load(npz_path) as data:
+            if "all_probs" not in data.files:
+                return None
+            argmax = np.argmax(data["all_probs"], axis=-1)  # [L, T]
+        start = 0
+        if json_path and os.path.exists(json_path):
+            with open(json_path) as f:
+                meta = json.load(f)
+            words = meta.get("input_words")
+            if words:
+                from taboo_brittleness_tpu.runtime import chat
+
+                start = chat.find_model_response_start(words)
+        return layer_agreement(argmax, response_start=start)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def word_agreement(processed_dir: str, word: str) -> Optional[np.ndarray]:
+    """Mean [L] agreement over every readable cached prompt of ``word`` —
+    compact summaries preferred, parity pairs as fallback.  None when the
+    word has no cache (the caller falls back to the heuristic default)."""
+    word_dir = os.path.join(processed_dir, word)
+    if not os.path.isdir(word_dir):
+        return None
+    rates: List[np.ndarray] = []
+    for name in sorted(os.listdir(word_dir)):
+        path = os.path.join(word_dir, name)
+        if name.endswith(".summary.npz"):
+            got = agreement_from_summary(path)
+        elif name.endswith(".npz"):
+            got = agreement_from_pair(path, path[:-4] + ".json")
+        else:
+            continue
+        if got is not None:
+            rates.append(got)
+    if not rates:
+        return None
+    L = min(r.shape[0] for r in rates)
+    return np.mean([r[:L] for r in rates], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Expected-throughput objective.
+# ---------------------------------------------------------------------------
+
+def expected_tokens(alpha: float, block: int) -> float:
+    """E[tokens emitted per verify] under i.i.d. acceptance at rate α:
+    ``Σ_{i=0..G} α^i`` — the accepted prefix plus the guaranteed bonus."""
+    a = min(max(float(alpha), 0.0), 1.0)
+    if a >= 1.0:
+        return float(block + 1)
+    return (1.0 - a ** (block + 1)) / (1.0 - a)
+
+
+def _decode_step_bytes(cfg, rows: int) -> Dict[str, float]:
+    """Memory-bound per-step byte costs the objective weighs: the full
+    weight stream, the per-layer slice of it, the lens-unembed stream, and
+    the per-step KV re-read (rows-dependent).  Uses the same accounting as
+    ``perf.roofline`` (weights dominate at sweep batch sizes)."""
+    from taboo_brittleness_tpu.perf import roofline
+
+    wb = roofline._dtype_bytes(getattr(cfg, "param_dtype", "bfloat16"))
+    cb = roofline._dtype_bytes(getattr(cfg, "dtype", "bfloat16"))
+    embed_b = float(cfg.vocab_size * cfg.hidden_size) * wb
+    total_b = float(roofline.param_count(cfg)) * wb
+    layer_b = (total_b - embed_b) / max(cfg.num_layers, 1)
+    kv_row = float(2 * cfg.num_kv_heads * cfg.head_dim) * cb
+    return {"embed": embed_b, "layer": layer_b, "total": total_b,
+            "kv_per_row_col": kv_row}
+
+
+def block_cost(cfg, draft_layer: int, block: int, *, rows: int = 1,
+               seq_len: int = 128) -> Tuple[float, float, float]:
+    """(draft_step_cost, verify_cost, vanilla_step_cost) in relative HBM
+    bytes for one block at ``rows`` batch rows and ~``seq_len`` live KV
+    columns.  The verify block streams the weights ONCE for its G+1
+    positions — the whole point of speculating on a memory-bound decode."""
+    b = _decode_step_bytes(cfg, rows)
+    kv_slab = b["kv_per_row_col"] * rows * seq_len
+    draft_frac = (draft_layer + 1) / max(cfg.num_layers, 1)
+    draft = (b["layer"] * (draft_layer + 1)   # layers-0..k weight stream
+             + b["embed"]                     # lens head unembed stream
+             + kv_slab * draft_frac)          # draft KV pages re-read
+    verify = b["total"] + kv_slab             # one full stream for G+1 cols
+    vanilla = b["total"] + kv_slab            # one full stream for ONE col
+    return draft, verify, vanilla
+
+
+def calibrate_word(agreement: Sequence[float], cfg, *,
+                   max_block: int = DEFAULT_MAX_BLOCK,
+                   rows: int = 1, seq_len: int = 128,
+                   layer_grid: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+    """Pick (k, G) maximizing expected tokens per byte-cost for one word.
+
+    ``agreement`` is the [L] per-layer agreement-with-final vector (the
+    last layer is the target itself and is excluded — a draft needs at
+    least one target-only layer).  Returns the chosen plan plus the
+    evidence: the agreement at k, the expected tokens/verify, and the
+    modeled speedup over vanilla greedy."""
+    agreement = np.asarray(agreement, dtype=float)
+    L = agreement.shape[0]
+    ks = [k for k in (layer_grid if layer_grid is not None else range(L - 1))
+          if 0 <= k <= L - 2]
+    if not ks:
+        raise ValueError(f"no admissible draft layers for L={L}")
+    best: Optional[Dict[str, Any]] = None
+    for k in ks:
+        alpha = float(agreement[k])
+        draft_c, verify_c, vanilla_c = block_cost(
+            cfg, k, 1, rows=rows, seq_len=seq_len)
+        for g in range(1, max_block + 1):
+            toks = expected_tokens(alpha, g)
+            cost = g * draft_c + verify_c
+            rate = toks / cost
+            speedup = rate * vanilla_c  # tokens/cost ÷ (1 token / vanilla)
+            if best is None or rate > best["_rate"]:
+                best = {"draft_layer": int(k), "block_size": int(g),
+                        "agreement": round(alpha, 4),
+                        "expected_tokens_per_verify": round(toks, 3),
+                        "expected_speedup": round(speedup, 3),
+                        "_rate": rate}
+    assert best is not None
+    best.pop("_rate")
+    return best
+
+
+def calibrate_words(processed_dir: str, words: Sequence[str], cfg, *,
+                    max_block: int = DEFAULT_MAX_BLOCK, rows: int = 1,
+                    seq_len: int = 128) -> Dict[str, Any]:
+    """The calibration artifact (``TBX_SPEC_CALIBRATION`` schema): one plan
+    per word with cached lens evidence, plus a ``default`` block (the
+    median plan) for words without cache and for callers that resolve
+    without a word.  Words with no readable cache are listed under
+    ``uncalibrated`` and fall through to the default at dispatch time."""
+    plans: Dict[str, Any] = {}
+    uncalibrated: List[str] = []
+    for w in words:
+        agr = word_agreement(processed_dir, w)
+        if agr is None:
+            uncalibrated.append(w)
+            continue
+        plans[w] = calibrate_word(agr, cfg, max_block=max_block,
+                                  rows=rows, seq_len=seq_len)
+    default: Dict[str, Any] = {}
+    if plans:
+        ks = sorted(p["draft_layer"] for p in plans.values())
+        gs = sorted(p["block_size"] for p in plans.values())
+        default = {"draft_layer": ks[len(ks) // 2],
+                   "block_size": gs[len(gs) // 2]}
+    return {
+        "schema": SCHEMA_VERSION,
+        "arch": {"num_layers": int(cfg.num_layers),
+                 "hidden_size": int(cfg.hidden_size),
+                 "vocab_size": int(cfg.vocab_size)},
+        "objective": "expected_tokens_per_verify / hbm_byte_cost "
+                     "(Sequoia arXiv:2402.12374; roofline decode model)",
+        "max_block": int(max_block),
+        "words": plans,
+        "default": default,
+        "uncalibrated": uncalibrated,
+    }
+
+
+def write_calibration(path: str, artifact: Dict[str, Any]) -> None:
+    """Atomic write (the dispatcher may read mid-calibration on a shared
+    filesystem)."""
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+    atomic_json_dump(artifact, path)
+
+
+def geometric_accept_stats(accepted: int, drafted: int) -> Dict[str, float]:
+    """Convenience for reports: the i.i.d.-model α implied by measured
+    accept counts, and the G that model would pick as ``log``-scale
+    guidance (``spec_ab`` prints it next to the measured table)."""
+    alpha = accepted / drafted if drafted else 0.0
+    g_star = (int(max(1, round(-1.0 / math.log(alpha)))) if 0 < alpha < 1
+              else 1)
+    return {"alpha": round(alpha, 4), "suggested_block": g_star}

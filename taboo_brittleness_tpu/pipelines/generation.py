@@ -201,6 +201,10 @@ def run_generation(
 
             def run_one() -> List[int]:
                 stage["name"] = "checkpoint.load"
+                # Per-word speculation plan (runtime.speculate).
+                from taboo_brittleness_tpu.runtime import speculate
+
+                speculate.set_active_word(word)
                 with ob.phase("checkpoint.load"):
                     params, model_cfg, tok = model_loader(word)
                 prefetch_next(model_loader, word_list, i)  # overlap next IO

@@ -1201,10 +1201,11 @@ def study_program_specs(
     top_k = config.model.top_k
     iv_cfg = config.intervention
 
-    # The exact prompt layout decode.generate will build for every launch.
-    ids = [tok.encode(chat.user_prompt(p)) for p in config.prompts]
-    padded, valid, positions = decode.pad_prompts(
-        ids, pad_to_multiple=config.experiment.pad_to_multiple)
+    # The exact prompt layout decode.generate will build for every launch
+    # (the same shared prep helper generate itself calls).
+    padded, valid, positions, _ = decode.encode_prompts(
+        tok, list(config.prompts),
+        pad_to_multiple=config.experiment.pad_to_multiple)
     tp = padded.shape[1]
     t_total = tp + N
     s = max(tp - 1, 0)
@@ -1262,7 +1263,85 @@ def study_program_specs(
                 "jit_fn": fused_mod.fused_study, "dynamic": dynamic,
                 "static": static}
 
+    def speculate_specs(tag: str, arms: int, edit_fn,
+                        rows_ep) -> List[Dict[str, Any]]:
+        """The programs a ``TBX_SPECULATE=1 TBX_SPECULATE_CAPTURE=1`` study
+        launches where the legacy path launches ONE decode: prefill, draft,
+        verify, flush (``runtime.speculate``), mirrored at this config's
+        exact shapes for every DISTINCT (draft_layer, block_size) plan the
+        configured words resolve to — per-word calibration must not cost
+        the warm start its zero-miss guarantee."""
+        from taboo_brittleness_tpu.runtime import speculate as spec_mod
+
+        rows = arms * B
+        plans = sorted({(p.draft_layer, p.block_size) for p in
+                        (spec_mod.resolve_plan(cfg, w)
+                         for w in (list(config.words) or [None]))})
+        specs: List[Dict[str, Any]] = []
+        for k, G in plans:
+            S = tp + N + G + 1
+            kvz = lambda L_: jnp.zeros(  # noqa: E731 — shape helper
+                (L_, rows, S, cfg.num_kv_heads, cfg.head_dim),
+                cfg.compute_dtype)
+            i32 = lambda: jnp.zeros((rows,), jnp.int32)  # noqa: E731
+            common = dict(params=params,
+                          prompt_valid=jnp.asarray(np.tile(valid, (arms, 1))),
+                          edit_params=rows_ep)
+            specs += [
+                {"label": f"spec.prefill[{tag}x{rows}@k{k}g{G}]",
+                 "entry": "speculate.prefill",
+                 "jit_fn": spec_mod.spec_prefill,
+                 "dynamic": dict(params=params, edit_params=rows_ep,
+                                 **prompt_rows(arms)),
+                 "static": dict(cfg=cfg, max_new_tokens=N, block_size=G,
+                                draft_layer=k, edit_fn=edit_fn,
+                                stop_ids=dec_static["stop_ids"],
+                                capture_residual_layer=layer_idx)},
+                {"label": f"spec.draft[{tag}x{rows}@k{k}g{G}]",
+                 "entry": "speculate.draft",
+                 "jit_fn": spec_mod.draft_step,
+                 "dynamic": dict(draft_k=kvz(k + 1), draft_v=kvz(k + 1),
+                                 last_tok=i32(), n_emit=i32(),
+                                 done=jnp.zeros((rows,), bool), plen=i32(),
+                                 **common),
+                 "static": dict(cfg=cfg, draft_layer=k, block_size=G,
+                                edit_fn=edit_fn, decode_edit=True)},
+                {"label": f"spec.verify[{tag}x{rows}@k{k}g{G}]",
+                 "entry": "speculate.verify",
+                 "jit_fn": spec_mod.verify_block,
+                 "dynamic": dict(main_k=kvz(cfg.num_layers),
+                                 main_v=kvz(cfg.num_layers),
+                                 toks=jnp.zeros((rows, N + 1), jnp.int32),
+                                 emit=jnp.zeros((rows, N + 1), bool),
+                                 resid=jnp.zeros(
+                                     (rows, S, cfg.hidden_size),
+                                     jnp.float32),
+                                 last_tok=i32(), n_emit=i32(),
+                                 done=jnp.zeros((rows,), bool), plen=i32(),
+                                 drafts=jnp.zeros((rows, G), jnp.int32),
+                                 **common),
+                 "static": dict(cfg=cfg, max_new_tokens=N, block_size=G,
+                                edit_fn=edit_fn, decode_edit=True,
+                                stop_ids=dec_static["stop_ids"],
+                                capture_residual_layer=layer_idx)},
+                {"label": f"spec.flush[{tag}x{rows}@k{k}g{G}]",
+                 "entry": "speculate.flush",
+                 "jit_fn": spec_mod.spec_flush,
+                 "dynamic": dict(main_k=kvz(cfg.num_layers),
+                                 main_v=kvz(cfg.num_layers),
+                                 resid=jnp.zeros(
+                                     (rows, S, cfg.hidden_size),
+                                     jnp.float32),
+                                 last_tok=i32(), n_emit=i32(), plen=i32(),
+                                 **common),
+                 "static": dict(cfg=cfg, edit_fn=edit_fn, decode_edit=True,
+                                capture_residual_layer=layer_idx)},
+            ]
+        return specs
+
     def trio(tag: str, arms: int, edit_fn, rows_ep) -> List[Dict[str, Any]]:
+        from taboo_brittleness_tpu.runtime import speculate as spec_mod
+
         if _use_fused():
             return [fused_spec(tag, arms, edit_fn, rows_ep)]
         rows = arms * B
@@ -1270,12 +1349,16 @@ def study_program_specs(
         nll_ep = (None if rows_ep is None else
                   {**rows_ep, "chunk_positions": jnp.zeros((rows, t_total - s),
                                                            jnp.int32)})
-        return [
-            {"label": f"decode[{tag}x{rows}]", "entry": "decode",
-             "jit_fn": decode.greedy_decode,
-             "dynamic": dict(params=params, edit_params=rows_ep,
-                             **prompt_rows(arms)),
-             "static": dict(edit_fn=edit_fn, **dec_static)},
+        if spec_mod.should_speculate(capture=True):
+            decode_specs = speculate_specs(tag, arms, edit_fn, rows_ep)
+        else:
+            decode_specs = [
+                {"label": f"decode[{tag}x{rows}]", "entry": "decode",
+                 "jit_fn": decode.greedy_decode,
+                 "dynamic": dict(params=params, edit_params=rows_ep,
+                                 **prompt_rows(arms)),
+                 "static": dict(edit_fn=edit_fn, **dec_static)}]
+        return decode_specs + [
             {"label": f"readout[{tag}x{rows}]", "entry": "readout",
              "jit_fn": _residual_measure,
              "dynamic": dict(
@@ -1883,6 +1966,12 @@ def run_intervention_studies(
             def run_one() -> Dict[str, Any]:
                 nonlocal prepared_next
                 stage["name"] = "checkpoint.load"
+                # Per-word speculation plan (runtime.speculate): the decode
+                # dispatcher has no word argument, so the active word rides
+                # module state for the calibration-artifact lookup.
+                from taboo_brittleness_tpu.runtime import speculate
+
+                speculate.set_active_word(word)
                 with ob.phase("checkpoint.load") as psp:
                     psp.set(pipelined=prepared_cell.get("h") is not None)
                     params, cfg, tok = model_loader(word)

@@ -41,12 +41,27 @@ def _decode_rendered(
     every word of the sweep) reuse one compiled decode program per (batch,
     bucket) instead of retracing per exact length — the warm-up was 3 fresh
     traces per word before (VERDICT round-2 item 7 / round-1 W7)."""
-    ids = [tok.encode(r) for r in rendered]
-    padded, valid, positions = decode.pad_prompts(
-        ids, pad_to_multiple=pad_to_multiple)
+    padded, valid, positions, _ = decode.encode_prompts(
+        tok, list(rendered), rendered=True, pad_to_multiple=pad_to_multiple)
     import jax.numpy as jnp
 
     from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.runtime import speculate
+
+    if speculate.should_speculate(capture=False):
+        # The forcing attacks are pure token paths — exactly what the
+        # lens-head speculative decoder accelerates losslessly (the decoded
+        # stream is the verify pass's own full-model argmaxes; exactness
+        # gated in tests/test_speculate.py).  Program spans/annotations ride
+        # inside speculative_decode per block program.
+        plan = speculate.resolve_plan(cfg)
+        result, _stats = speculate.speculative_decode(
+            params, cfg,
+            jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions),
+            max_new_tokens=max_new_tokens,
+            draft_layer=plan.draft_layer, block_size=plan.block_size,
+            edit_fn=edit_fn, edit_params=edit_params)
+        return decode.decode_texts(tok, result)
 
     # Direct jit dispatch (bypasses decode.generate's chat templating), so it
     # carries its own device-profiler annotation + program span: without the

@@ -154,6 +154,11 @@ def run_word_sweep(
             def run_one() -> Dict[str, Any]:
                 nonlocal memo_key, memo
                 stage["name"] = "checkpoint.load"
+                # Per-word speculation plan (runtime.speculate): the decode
+                # dispatcher resolves its calibration entry by active word.
+                from taboo_brittleness_tpu.runtime import speculate
+
+                speculate.set_active_word(word)
                 with ob.phase("checkpoint.load"):
                     params, cfg, tok = model_loader(word)
                 if memo_key is None or params is not memo_key[0] or tok is not memo_key[1]:

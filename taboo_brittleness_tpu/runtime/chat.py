@@ -88,6 +88,84 @@ def find_model_response_start_ids(token_ids: Sequence[int]) -> int:
     return 0
 
 
+def chat_reply(
+    params,
+    cfg,
+    tok,
+    turns: Sequence[Turn],
+    *,
+    max_new_tokens: int = 128,
+    pad_to_multiple: Optional[int] = 32,
+) -> str:
+    """One greedy model reply for an in-progress conversation.
+
+    Routes through ``decode.generate`` with the pre-rendered multi-turn
+    template (``rendered=True``), so the interactive path inherits every
+    dispatch feature of the batch path — the AOT registry, and under
+    ``TBX_SPECULATE=1`` the lens-head speculative decoder
+    (``runtime.speculate``): the reply stream is exactly the vanilla greedy
+    stream, it just arrives in draft-verify blocks.  ``pad_to_multiple``
+    buckets the growing conversation length so consecutive turns reuse one
+    compiled program per bucket instead of retracing per turn.
+
+    (Imported lazily: this module stays stdlib-importable for the template
+    helpers; ``decode`` imports it at module top.)"""
+    from taboo_brittleness_tpu.runtime import decode as decode_mod
+
+    rendered = render_chat(list(turns))
+    _result, texts, _ids = decode_mod.generate(
+        params, cfg, tok, [rendered], rendered=True,
+        max_new_tokens=max_new_tokens, pad_to_multiple=pad_to_multiple)
+    return texts[0].replace(END_OF_TURN, "").replace("<eos>", "").strip()
+
+
+def run_chat(
+    params,
+    cfg,
+    tok,
+    *,
+    max_new_tokens: int = 128,
+    pad_to_multiple: Optional[int] = 32,
+    stream=None,
+    out=None,
+) -> int:
+    """Interactive REPL over one loaded checkpoint (``tbx chat``).
+
+    Reads user lines, keeps the Gemma-2 turn history, prints greedy
+    replies.  Honors ``TBX_SPECULATE`` through :func:`chat_reply` — with a
+    calibration artifact (``TBX_SPEC_CALIBRATION``) the draft plan follows
+    the active word set by the loader.  Exits on EOF or an empty line
+    starting with ``/quit``.  Returns the number of replies produced."""
+    import sys
+
+    stream = stream if stream is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    turns: List[Turn] = []
+    replies = 0
+    out.write("tbx chat — greedy Gemma-2 REPL (/quit to exit)\n")
+    out.flush()
+    while True:
+        out.write("you> ")
+        out.flush()
+        line = stream.readline()
+        if not line:
+            break
+        msg = line.strip()
+        if not msg:
+            continue
+        if msg.startswith("/quit"):
+            break
+        turns.append(Turn("user", msg))
+        reply = chat_reply(params, cfg, tok, turns,
+                           max_new_tokens=max_new_tokens,
+                           pad_to_multiple=pad_to_multiple)
+        turns.append(Turn("model", reply))
+        replies += 1
+        out.write(f"model> {reply}\n")
+        out.flush()
+    return replies
+
+
 def response_mask(token_ids: Sequence[int], seq_len: Optional[int] = None) -> List[bool]:
     """Boolean mask over positions: True from response start to (exclusive) the
     closing <end_of_turn> of the model turn, False elsewhere."""

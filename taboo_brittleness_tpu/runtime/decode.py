@@ -377,21 +377,35 @@ def encode_prompts(
     *,
     prefills: Optional[Sequence[Optional[str]]] = None,
     pad_to_multiple: Optional[int] = None,
+    rendered: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[List[int]]]:
     """Chat-format + tokenize + left-pad a prompt batch: the host-side prep
     half of :func:`generate`, shared with the fused study launch
     (``runtime.fused``) which builds the same [B, T] layout but dispatches
     decode+readout+NLL as one program.  Returns (ids, valid, positions,
-    per-row token id lists)."""
-    rendered = []
-    for i, p in enumerate(prompts):
-        prefill = prefills[i] if prefills is not None else None
-        rendered.append(
-            chat.render_chat([chat.Turn("user", p)], prefill=prefill)
-            if prefill is not None
-            else chat.user_prompt(p)
-        )
-    ids = [tok.encode(r) for r in rendered]
+    per-row token id lists).
+
+    ``rendered=True`` treats ``prompts`` as ALREADY chat-templated strings
+    (multi-turn dialogues, forcing prefills) and skips the single-user-turn
+    formatting — the prep the token-forcing pipeline and the interactive
+    chat loop share with this helper instead of hand-rolling their own
+    tokenize/pad."""
+    if rendered:
+        if prefills is not None:
+            raise ValueError(
+                "prefills are a chat-formatting feature; with rendered=True "
+                "bake the prefill into the rendered string instead")
+        rendered_rows = list(prompts)
+    else:
+        rendered_rows = []
+        for i, p in enumerate(prompts):
+            prefill = prefills[i] if prefills is not None else None
+            rendered_rows.append(
+                chat.render_chat([chat.Turn("user", p)], prefill=prefill)
+                if prefill is not None
+                else chat.user_prompt(p)
+            )
+    ids = [tok.encode(r) for r in rendered_rows]
     padded, valid, positions = pad_prompts(ids, pad_to_multiple=pad_to_multiple)
     return padded, valid, positions, ids
 
@@ -412,6 +426,7 @@ def generate(
     input_sharding: Optional[Any] = None,
     return_texts: bool = True,
     return_prefill_cache: bool = False,
+    rendered: bool = False,
 ) -> Tuple[DecodeResult, Optional[List[str]], List[List[int]]]:
     """Chat-format, tokenize, batch-decode.  Returns (result, response_texts,
     full_sequences_ids) — the response text is the *generation only* (the
@@ -432,17 +447,28 @@ def generate(
     signature runs without re-tracing; anything else falls back to the plain
     jit call.  Sharded launches (``input_sharding``) always take the jit path
     — executables are specialized to input shardings.
+
+    ``TBX_SPECULATE=1`` routes single-device launches through the
+    self-speculative decoder (``runtime.speculate``: lens-head draft +
+    full-depth verify blocks, token streams exactly the vanilla greedy
+    stream).  Residual-capturing launches (the study's measurement path)
+    additionally require ``TBX_SPECULATE_CAPTURE=1`` — see
+    ``speculate.capture_extension_enabled`` for the bit-identity contract.
+    Mesh-sharded launches always decode vanilla, like ``TBX_FUSED``.
+    ``rendered=True`` forwards to :func:`encode_prompts` (pre-templated
+    prompt strings — multi-turn chat, forcing dialogues).
     """
     # Named fault site (runtime.resilience): lets tests/ops arm launch-time
     # failures without touching the traced decode itself.
     from taboo_brittleness_tpu import obs
     from taboo_brittleness_tpu.obs import metrics as obs_metrics
-    from taboo_brittleness_tpu.runtime import aot, resilience
+    from taboo_brittleness_tpu.runtime import aot, resilience, speculate
 
     resilience.fire("decode.launch", rows=len(prompts))
 
     padded, valid, positions, ids = encode_prompts(
-        tok, prompts, prefills=prefills, pad_to_multiple=pad_to_multiple)
+        tok, prompts, prefills=prefills, pad_to_multiple=pad_to_multiple,
+        rendered=rendered)
 
     def place(x):
         """With ``input_sharding`` (e.g. NamedSharding over the mesh's dp
@@ -455,6 +481,19 @@ def generate(
 
     obs_metrics.counter("decode.launches").inc()
     obs_metrics.counter("decode.rows").inc(len(prompts))
+    if speculate.should_speculate(capture=capture_residual_layer is not None,
+                                  mesh_sharded=input_sharding is not None):
+        plan = speculate.resolve_plan(cfg)
+        result, _stats = speculate.speculative_decode(
+            params, cfg, padded, valid, positions,
+            max_new_tokens=max_new_tokens,
+            draft_layer=plan.draft_layer, block_size=plan.block_size,
+            edit_fn=edit_fn, edit_params=edit_params, decode_edit=decode_edit,
+            stop_ids=(chat.EOS_ID, chat.END_OF_TURN_ID),
+            capture_residual_layer=capture_residual_layer,
+            return_prefill_cache=return_prefill_cache)
+        texts = decode_texts(tok, result) if return_texts else None
+        return result, texts, ids
     # Program span: host-side dispatch only (the launch is async — the span
     # covers tracing/dispatch and, with return_texts, the blocking token
     # pull; device time shows up in whichever span later blocks).  Under an

@@ -472,6 +472,11 @@ FAULT_SITES = (
     #                       per in-flight session per step (context: request
     #                       id + scenario) so a plan can poison ONE session;
     #                       the scheduler quarantines it, the batch lives
+    "speculate.verify",   # runtime.speculate.speculative_decode — fired
+    #                       before EVERY verify-block launch (context: block
+    #                       index + rows) so a plan can poison one block of
+    #                       a speculative decode; the word-level run_guarded
+    #                       retry→quarantine path owns the failure
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

@@ -1,0 +1,814 @@
+"""Self-speculative greedy decoding: the logit-lens heads as a free draft model.
+
+Why (ROADMAP item; M2R2's multi-rate-residual early-exit view, arXiv:2502.02040,
+and Sequoia's hardware-aware speculation scheduling, arXiv:2402.12374): decode
+is memory-bandwidth-bound — every generated token re-streams the full 42-layer
+weights through HBM, the per-step floor that PR 8's fusion and PR 6's batching
+cannot move (bench r05 tags decode ``bound=hbm``).  But this repo already
+computes per-layer logit-lens readouts: an early layer's unembedded residual is
+a *draft model living inside the target network* whose weights are a strict
+prefix of the target's.  So:
+
+1. **Draft** G tokens autoregressively from the layer-k lens head
+   (``ops.lens.lens_argmax`` over the layer-k residual — the draft runs only
+   layers 0..k and keeps its OWN KV pages for those layers), as ONE launched
+   program with the G-step loop inside (``draft_step``): dispatch count never
+   grows with rejections.
+2. **Verify** the whole draft block in ONE full-depth forward over G+1
+   teacher-forced positions (``verify_block`` — the single-token-step =
+   chunked-prefill trick of ``serve/engine.py``, generalized by
+   ``gemma2.forward(cache_positions=[B, T])`` to per-row column offsets,
+   because rows accept different draft counts).  Accept the longest prefix
+   where draft argmax == target argmax and emit one bonus token from the
+   verify pass itself — every active row always advances ≥ 1 token.
+3. **Lossless by construction**: every emitted token is a FULL-model argmax
+   from the verify pass (the draft only chooses which positions get verified
+   together), so the decoded stream is exactly the vanilla greedy stream —
+   the brittleness metrics are all greedy Pass@10 string scores, so every
+   science number stays bit-identical (gated by tests/test_speculate.py).
+
+The block loop is host-driven on purpose (Sequoia's production stance): each
+block is draft-launch + verify-launch with the per-block bookkeeping in-graph,
+so ``tbx supervise`` drain polling and the ``speculate.verify`` fault site
+(``runtime.resilience``) get a control point BETWEEN blocks, and the device
+profiler attributes accepted-vs-wasted device time per program
+(``speculate.draft`` / ``speculate.verify`` annotations).  The per-block host
+sync is one scalar pull (the all-done flag + 4 stats counters), the same
+control-point shape the serve engine's step loop uses.
+
+Draft depth k and block size G are calibrated per word from the existing
+cached lens sweeps (``perf.spec_calibrate`` reads per-layer agreement-with-
+final rates out of the cached summary / ``all_probs`` artifacts and maximizes
+expected tokens per verify under the roofline decode cost model); the
+resolution order here is env override → calibration artifact → heuristic
+default.  ``TBX_SPECULATE=1`` routes ``decode.generate`` through this module
+(mesh runs stay vanilla, like ``TBX_FUSED``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import (
+    Gemma2Config, KVCache, Params, forward, unembed)
+from taboo_brittleness_tpu.runtime import chat
+
+#: Default draft block size when neither env nor calibration pins one.
+DEFAULT_BLOCK = 3
+
+
+def enabled() -> bool:
+    """Opt-in gate: ``TBX_SPECULATE=1`` routes single-device
+    ``decode.generate`` launches through the speculative decoder.  Default
+    OFF — vanilla greedy stays the production path until a TPU round lands
+    the ``spec_ab`` table (the ``readout_ab``/``fused_ab`` rollout
+    playbook)."""
+    return os.environ.get("TBX_SPECULATE", "0") == "1"
+
+
+def capture_extension_enabled() -> bool:
+    """Whether speculation also covers residual-CAPTURING decodes
+    (``TBX_SPECULATE_CAPTURE=1``).
+
+    The split exists because of what speculation can and cannot keep
+    bit-identical.  Token streams are exact by construction (every emitted
+    token is the full model's verify-pass argmax), and that is all the
+    greedy Pass@10 science consumes — but the CAPTURED RESIDUAL is an f32
+    byproduct of forwards whose SHAPES speculation changes (G+1-token
+    chunks instead of single steps), and XLA's shape-dependent fusion
+    rounds those last bits differently (measured ~1e-7 relative on CPU;
+    the same hazard class PR 8's fused program fought for identical
+    shapes).  So by default the study's capture launches stay vanilla —
+    every study JSON byte-identical, tier-1-gated — and this knob extends
+    speculation to them once a round wants the sweep's decode floor
+    attacked too: tokens/texts/guess strings stay exact, residual-derived
+    continuous metrics (secret_prob, ΔNLL) agree to f32 rounding."""
+    return os.environ.get("TBX_SPECULATE_CAPTURE", "0") == "1"
+
+
+def should_speculate(*, capture: bool, mesh_sharded: bool = False) -> bool:
+    """The one routing predicate ``decode.generate`` (and the forcing
+    pipeline's direct dispatch) consults: speculation is single-device
+    only (like the AOT registry) and covers capture launches only under
+    the explicit extension (see :func:`capture_extension_enabled`)."""
+    if mesh_sharded or not enabled():
+        return False
+    return not capture or capture_extension_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Plan resolution: env override -> calibration artifact -> heuristic.
+# ---------------------------------------------------------------------------
+
+class SpecPlan(NamedTuple):
+    """One word's speculation schedule: draft depth k (the lens head's layer)
+    and block size G (drafted tokens per verify)."""
+
+    draft_layer: int
+    block_size: int
+    source: str = "default"
+
+
+_WORD_LOCK = threading.Lock()
+_ACTIVE_WORD: Optional[str] = None
+_CALIBRATION_CACHE: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+
+
+def set_active_word(word: Optional[str]) -> None:
+    """Tell the dispatcher which word's calibration entry applies.  The
+    sweeps call this as they load each word's checkpoint; ``decode.generate``
+    has no word argument, so the per-word (k, G) plan rides module state."""
+    global _ACTIVE_WORD
+    with _WORD_LOCK:
+        _ACTIVE_WORD = word
+
+
+def active_word() -> Optional[str]:
+    with _WORD_LOCK:
+        return _ACTIVE_WORD
+
+
+def _load_calibration(path: str) -> Optional[Dict[str, Any]]:
+    """Calibration artifact (perf.spec_calibrate schema), memoized on mtime —
+    the sweep resolves a plan per word and the artifact never changes
+    mid-run.  Unreadable/absent artifacts degrade to the heuristic default
+    (speculation is an accelerator, never a correctness dependency)."""
+    try:
+        mtime = os.path.getmtime(path)
+        hit = _CALIBRATION_CACHE.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+        with open(path) as f:
+            data = json.load(f)
+        _CALIBRATION_CACHE[path] = (mtime, data)
+        return data
+    except (OSError, ValueError):
+        return None
+
+
+def default_draft_layer(cfg: Gemma2Config) -> int:
+    """Uncalibrated fallback: two thirds of the stack — deep enough that the
+    lens argmax usually agrees with the final head (the lens sweeps show
+    agreement rising with depth), shallow enough to leave a real draft
+    discount.  Clamped so at least one full layer separates draft and
+    target."""
+    return max(0, min((2 * cfg.num_layers) // 3, cfg.num_layers - 2))
+
+
+def resolve_plan(cfg: Gemma2Config, word: Optional[str] = None) -> SpecPlan:
+    """(k, G) for the next speculative launch.
+
+    Priority: ``TBX_SPEC_DRAFT_LAYER`` / ``TBX_SPEC_BLOCK`` env overrides →
+    the ``TBX_SPEC_CALIBRATION`` artifact's per-word entry (falling back to
+    its ``default`` block) → the heuristic default.  ``word`` defaults to
+    the sweep's active word (:func:`set_active_word`)."""
+    k = g = None
+    source = "default"
+    env_k = os.environ.get("TBX_SPEC_DRAFT_LAYER")
+    env_g = os.environ.get("TBX_SPEC_BLOCK")
+    if env_k:
+        k, source = int(env_k), "env"
+    if env_g:
+        g, source = int(env_g), "env"
+    if k is None or g is None:
+        path = os.environ.get("TBX_SPEC_CALIBRATION")
+        data = _load_calibration(path) if path else None
+        if data is not None:
+            w = word if word is not None else active_word()
+            entry = (data.get("words", {}).get(w)
+                     or data.get("default")) if isinstance(data, dict) else None
+            if isinstance(entry, dict):
+                if k is None and entry.get("draft_layer") is not None:
+                    k, source = int(entry["draft_layer"]), "calibration"
+                if g is None and entry.get("block_size") is not None:
+                    g, source = int(entry["block_size"]), "calibration"
+    if k is None:
+        k = default_draft_layer(cfg)
+    if g is None:
+        g = DEFAULT_BLOCK
+    k = max(0, min(int(k), cfg.num_layers - 2))
+    g = max(1, int(g))
+    return SpecPlan(draft_layer=k, block_size=g, source=source)
+
+
+# ---------------------------------------------------------------------------
+# Per-block stats (host side).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SpecStats:
+    """Host-side accounting of one speculative decode: what the ``spec_ab``
+    bench commits per word."""
+
+    blocks: int = 0          # verify launches
+    drafted: int = 0         # draft tokens proposed (G x active rows, summed)
+    accepted: int = 0        # drafted tokens whose emission was accepted
+    emitted: int = 0         # tokens emitted by verify passes (incl. bonus)
+    rows: int = 0
+    # sum over blocks of that block's active rows (denominator of the mean)
+    blocks_rows: int = 0
+
+    @property
+    def accept_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def tokens_per_verify(self) -> float:
+        """Mean emitted tokens per verify launch per active row — the
+        Sequoia objective's realized value (1.0 = speculation won nothing,
+        G+1 = every draft accepted)."""
+        return self.emitted / self.blocks_rows if self.blocks_rows else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "blocks": self.blocks, "drafted": self.drafted,
+            "accepted": self.accepted, "emitted": self.emitted,
+            "rows": self.rows,
+            "accept_rate": round(self.accept_rate, 4),
+            "tokens_per_verify": round(self.tokens_per_verify, 4),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Shared in-graph helpers.
+# ---------------------------------------------------------------------------
+
+def _valid_cols(prompt_valid: jax.Array, n_emit: jax.Array,
+                width: int) -> jax.Array:
+    """[B, width] KV-column validity implied by the counters: the prompt's
+    own validity plus generated columns ``[Tp, Tp + n_emit - 1)`` — every
+    token whose K/V a verified feed has written.  Recomputing this per
+    program (instead of carrying a mask) makes the rejected-draft rollback
+    implicit: a rejected column simply never becomes valid."""
+    B, Tp = prompt_valid.shape
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    base = jnp.zeros((B, width), bool).at[:, :Tp].set(prompt_valid)
+    gen = (col >= Tp) & (col < (Tp + n_emit - 1)[:, None])
+    return base | gen
+
+
+def _bind_edit(edit_fn: Optional[Callable], edit_params: Any,
+               chunk_positions: jax.Array) -> Optional[Callable]:
+    """The decode-step edit binding (``greedy_decode``'s
+    ``_with_chunk_positions``): spike-masked edits read the current chunk's
+    RoPE positions from ``ep['chunk_positions']``."""
+    if edit_fn is None:
+        return None
+    if edit_params is None:
+        return edit_fn
+    ep = edit_params
+    if isinstance(ep, dict):
+        ep = {**ep, "chunk_positions": chunk_positions}
+    return lambda h, idx: edit_fn(h, idx, ep)
+
+
+def _is_stop(tok: jax.Array, stop_ids: Tuple[int, ...]) -> jax.Array:
+    stop = jnp.asarray(stop_ids, jnp.int32)
+    return jnp.any(tok[..., None] == stop[None, :], axis=-1)
+
+
+def _draft_view(params: Params, draft_layer: int) -> Params:
+    """The draft model IS a prefix of the target: layers 0..k plus the shared
+    unembedding/final-norm (the lens head).  A pytree of slices — no copy
+    until XLA decides one is needed."""
+    return {
+        "embed": params["embed"],
+        "final_norm": params["final_norm"],
+        "layers": jax.tree_util.tree_map(
+            lambda x: x[:draft_layer + 1], params["layers"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The three block programs + the capture flush.
+# ---------------------------------------------------------------------------
+
+class SpecState(NamedTuple):
+    """Device state threaded (and donated) through the block loop."""
+
+    main_k: jax.Array    # [L, B, S, Kh, Dh] full-depth KV
+    main_v: jax.Array
+    draft_k: jax.Array   # [k+1, B, S, Kh, Dh] the draft's own KV pages
+    draft_v: jax.Array
+    toks: jax.Array      # [B, N+1] emitted tokens (slot N = trash)
+    emit: jax.Array      # [B, N+1] bool
+    resid: jax.Array     # [B, S, D] f32 captured residual, or scalar 0.0
+    last_tok: jax.Array  # [B] last emitted token (next block's c_0)
+    n_emit: jax.Array    # [B] tokens emitted so far
+    done: jax.Array      # [B] row finished (stop recorded or budget out)
+    plen: jax.Array      # [B] real prompt lengths (RoPE base)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "block_size", "draft_layer",
+                     "edit_fn", "stop_ids", "capture_residual_layer"),
+)
+def spec_prefill(
+    params: Params,
+    cfg: Gemma2Config,
+    prompt_ids: jax.Array,        # [B, Tp] left-padded
+    prompt_valid: jax.Array,      # [B, Tp] bool
+    prompt_positions: jax.Array,  # [B, Tp]
+    edit_params: Any = None,
+    *,
+    max_new_tokens: int,
+    block_size: int,
+    draft_layer: int,
+    edit_fn: Optional[Callable] = None,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    capture_residual_layer: Optional[int] = None,
+) -> SpecState:
+    """Full-depth prefill into the speculative cache layout + the first
+    token (recorded at slot 0, exactly like ``greedy_decode``), and the
+    draft cache seeded by SLICING the prefill KV at layers 0..k — the draft
+    would compute identical K/V for teacher-forced positions, so the slice
+    is free agreement.
+
+    Cache width is ``Tp + N + G + 1``: room for the deepest verify chunk a
+    last block can write, plus one permanently-invalid TRASH column at the
+    end where finished rows' chunk writes are routed (a scatter must write
+    somewhere; the trash column never becomes valid, so it can never attend
+    or collide with a live column)."""
+    B, Tp = prompt_ids.shape
+    N, G = max_new_tokens, block_size
+    S = Tp + N + G + 1
+    capture = capture_residual_layer is not None
+
+    cache = KVCache.zeros(cfg, B, max_len=S)
+
+    def _carry_tap():
+        if not capture:
+            return None
+        from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+
+        return residual_carry_tap(B, Tp, cfg.hidden_size,
+                                  capture_residual_layer)
+
+    prefill = forward(
+        params, cfg, prompt_ids,
+        positions=prompt_positions,
+        attn_validity=prompt_valid,
+        cache=cache,
+        edit_fn=_bind_edit(edit_fn, edit_params, prompt_positions),
+        carry_tap=_carry_tap(),
+        compute_logits=False,
+    )
+    last_logits = unembed(params, cfg, prefill.last_hidden[:, -1:])[:, 0]
+    first_tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+
+    toks = jnp.full((B, N + 1), chat.PAD_ID, jnp.int32)
+    emit = jnp.zeros((B, N + 1), bool)
+    toks = toks.at[:, 0].set(first_tok)
+    emit = emit.at[:, 0].set(True)
+    done = _is_stop(first_tok, stop_ids) | jnp.asarray(N <= 1)
+
+    if capture:
+        resid = jnp.zeros((B, S, cfg.hidden_size), jnp.float32)
+        resid = resid.at[:, :Tp].set(prefill.carry_tap)
+    else:
+        resid = jnp.zeros((), jnp.float32)
+
+    return SpecState(
+        main_k=prefill.cache.k, main_v=prefill.cache.v,
+        draft_k=prefill.cache.k[:draft_layer + 1],
+        draft_v=prefill.cache.v[:draft_layer + 1],
+        toks=toks, emit=emit, resid=resid,
+        last_tok=first_tok,
+        n_emit=jnp.ones((B,), jnp.int32),
+        done=done,
+        plen=jnp.sum(prompt_valid, axis=1).astype(jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_layer", "block_size", "edit_fn",
+                     "decode_edit"),
+    donate_argnames=("draft_k", "draft_v"),
+)
+def draft_step(
+    params: Params,
+    cfg: Gemma2Config,
+    draft_k: jax.Array,
+    draft_v: jax.Array,
+    prompt_valid: jax.Array,
+    last_tok: jax.Array,
+    n_emit: jax.Array,
+    done: jax.Array,
+    plen: jax.Array,
+    edit_params: Any = None,
+    *,
+    draft_layer: int,
+    block_size: int,
+    edit_fn: Optional[Callable] = None,
+    decode_edit: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """ONE launched program drafting G tokens autoregressively from the
+    layer-k lens head: a ``lax.scan`` of single-token forwards over layers
+    0..k (the draft's own KV pages), each step's next token the lens argmax
+    of the layer-k residual.  Returns ``(draft_k, draft_v, drafts [B, G])``.
+
+    The draft exists only to pick WHICH tokens get verified together —
+    nothing it computes ever reaches an output token, so its numerics only
+    modulate the acceptance rate, never correctness (the degenerate-draft
+    test pins this: a uselessly shallow k still decodes exactly)."""
+    B = last_tok.shape[0]
+    Tp = prompt_valid.shape[1]
+    S = draft_k.shape[2]
+    trash = S - 1
+    dcfg = cfg.replace(num_layers=draft_layer + 1)
+    dparams = _draft_view(params, draft_layer)
+    active = ~done
+    use_edit = edit_fn is not None and decode_edit
+
+    valid0 = _valid_cols(prompt_valid, n_emit, S)
+    col0 = (Tp + n_emit - 1).astype(jnp.int32)
+    pos0 = (plen + n_emit - 1).astype(jnp.int32)
+
+    def step(carry, _):
+        k, v, valid, tok, col, pos = carry
+        safe_col = jnp.where(active, col, trash)
+        bound = (_bind_edit(edit_fn, edit_params, pos[:, None])
+                 if use_edit else None)
+        res = forward(
+            dparams, dcfg, tok[:, None],
+            positions=pos[:, None],
+            attn_validity=active[:, None],
+            cache=KVCache(k=k, v=v, valid=valid,
+                          length=jnp.zeros((), jnp.int32)),
+            edit_fn=bound,
+            cache_positions=safe_col,
+        )
+        from taboo_brittleness_tpu.ops.lens import lens_argmax
+
+        nxt = lens_argmax(params, cfg, res.last_hidden)[:, 0]
+        nxt = jnp.where(active, nxt, jnp.int32(chat.PAD_ID))
+        return (res.cache.k, res.cache.v, res.cache.valid,
+                nxt, col + 1, pos + 1), nxt
+
+    (draft_k, draft_v, _, _, _, _), drafts = lax.scan(
+        step, (draft_k, draft_v, valid0, last_tok, col0, pos0),
+        None, length=block_size)
+    return draft_k, draft_v, jnp.transpose(drafts)  # [B, G]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "block_size", "edit_fn",
+                     "decode_edit", "stop_ids", "capture_residual_layer"),
+    donate_argnames=("main_k", "main_v", "toks", "emit", "resid"),
+)
+def verify_block(
+    params: Params,
+    cfg: Gemma2Config,
+    main_k: jax.Array,
+    main_v: jax.Array,
+    prompt_valid: jax.Array,
+    toks: jax.Array,
+    emit: jax.Array,
+    resid: jax.Array,
+    last_tok: jax.Array,
+    n_emit: jax.Array,
+    done: jax.Array,
+    plen: jax.Array,
+    drafts: jax.Array,            # [B, G]
+    edit_params: Any = None,
+    *,
+    max_new_tokens: int,
+    block_size: int,
+    edit_fn: Optional[Callable] = None,
+    decode_edit: bool = True,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    capture_residual_layer: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array, jax.Array, jax.Array, jax.Array]:
+    """ONE full-depth forward over the G+1 teacher-forced chunk
+    ``[last_emitted, draft_1..draft_G]`` — each row's columns at its OWN
+    offsets (``cache_positions=[B, G+1]``) — then the in-graph acceptance /
+    emission / stop bookkeeping.
+
+    Emission semantics replicate ``greedy_decode`` exactly: every emitted
+    token is the full model's argmax at its position (the chunk's logits are
+    the same ``unembed`` the vanilla step computes), a stop token is kept
+    and ends the row, and the budget truncates at ``max_new_tokens``.  The
+    accepted prefix plus ONE bonus token land per block, so every active
+    row always advances.
+
+    Returns ``(main_k, main_v, toks, emit, resid, last_tok, n_emit, done,
+    all_done, stats)`` — ``stats`` is the int32[4] host-pull vector
+    ``[emitted, accepted, drafted, active_rows]``."""
+    B, Tp = prompt_valid.shape
+    N, G = max_new_tokens, block_size
+    S = main_k.shape[2]
+    trash_col = S - 1
+    trash_slot = N
+    capture = capture_residual_layer is not None
+    active = ~done
+    rows = jnp.arange(B)
+    i = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+
+    chunk = jnp.concatenate([last_tok[:, None], drafts], axis=1)  # [B, G+1]
+    chunk = jnp.where(active[:, None], chunk, jnp.int32(chat.PAD_ID))
+    cols = (Tp + n_emit - 1)[:, None] + i
+    safe_cols = jnp.where(active[:, None], cols, trash_col)
+    pos = (plen + n_emit - 1)[:, None] + i
+
+    def _carry_tap():
+        if not capture:
+            return None
+        from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+
+        return residual_carry_tap(B, G + 1, cfg.hidden_size,
+                                  capture_residual_layer)
+
+    use_edit = edit_fn is not None and decode_edit
+    res = forward(
+        params, cfg, chunk,
+        positions=pos,
+        attn_validity=jnp.broadcast_to(active[:, None], (B, G + 1)),
+        cache=KVCache(k=main_k, v=main_v,
+                      valid=_valid_cols(prompt_valid, n_emit, S),
+                      length=jnp.zeros((), jnp.int32)),
+        edit_fn=_bind_edit(edit_fn, edit_params, pos) if use_edit else None,
+        carry_tap=_carry_tap(),
+        cache_positions=safe_cols,
+        compute_logits=True,
+    )
+    y = jnp.argmax(res.logits, axis=-1).astype(jnp.int32)      # [B, G+1]
+
+    match = (drafts == y[:, :G]).astype(jnp.int32)             # d_j == y_{j-1}
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)            # [B] accepted
+    y_stop = _is_stop(y, stop_ids)                             # [B, G+1]
+    stop_free = jnp.concatenate(
+        [jnp.ones((B, 1), bool),
+         jnp.cumprod(~y_stop[:, :G], axis=1).astype(bool)], axis=1)
+    emit_i = (active[:, None] & (i <= m[:, None])
+              & ((n_emit[:, None] + i) < N) & stop_free)       # [B, G+1]
+    count = jnp.sum(emit_i, axis=1).astype(jnp.int32)
+
+    slot_cols = jnp.where(emit_i, n_emit[:, None] + i, trash_slot)
+    toks = toks.at[rows[:, None], slot_cols].set(
+        jnp.where(emit_i, y, jnp.int32(chat.PAD_ID)))
+    emit = emit.at[rows[:, None], slot_cols].set(emit_i)
+    if capture:
+        resid = resid.at[rows[:, None], safe_cols].set(res.carry_tap)
+
+    n_new = n_emit + count
+    stop_emitted = jnp.any(emit_i & y_stop, axis=1)
+    done_new = done | (active & (stop_emitted | (n_new >= N)))
+    last_new = jnp.take_along_axis(
+        y, jnp.clip(count - 1, 0, G)[:, None], axis=1)[:, 0]
+    last_tok = jnp.where(active & (count > 0), last_new, last_tok)
+
+    stats = jnp.stack([
+        jnp.sum(jnp.where(active, count, 0)),                  # emitted
+        jnp.sum(jnp.where(active, jnp.maximum(count - 1, 0), 0)),  # accepted
+        jnp.sum(jnp.where(active, G, 0)),                      # drafted
+        jnp.sum(active.astype(jnp.int32)),                     # active rows
+    ]).astype(jnp.int32)
+    return (res.cache.k, res.cache.v, toks, emit, resid, last_tok,
+            n_new, done_new, jnp.all(done_new), stats)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "edit_fn", "decode_edit",
+                     "capture_residual_layer"),
+    donate_argnames=("main_k", "main_v", "resid"),
+)
+def spec_flush(
+    params: Params,
+    cfg: Gemma2Config,
+    main_k: jax.Array,
+    main_v: jax.Array,
+    prompt_valid: jax.Array,
+    resid: jax.Array,
+    last_tok: jax.Array,
+    n_emit: jax.Array,
+    plen: jax.Array,
+    edit_params: Any = None,
+    *,
+    edit_fn: Optional[Callable] = None,
+    decode_edit: bool = True,
+    capture_residual_layer: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Residual-capture parity tail: feed every row's FINAL emitted token
+    once at full depth and capture its tap-layer residual.
+
+    The vanilla loop feeds every token it records (the step that records
+    token i also forwards it), so its captured residual covers every emitted
+    column.  The speculative loop's bonus token is emitted WITHOUT being fed
+    (it is the verify pass's own output); if the row ends there, its column
+    would miss.  One T=1 feed per row closes the gap — for rows whose final
+    token WAS fed (an accepted draft), the re-feed recomputes identical K/V
+    and residual at the same column, so the flush is idempotent.  Only
+    dispatched when the launch captures residuals."""
+    B, Tp = prompt_valid.shape
+    S = main_k.shape[2]
+    col = (Tp + n_emit - 1).astype(jnp.int32)
+    pos = (plen + n_emit - 1).astype(jnp.int32)
+    from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+
+    use_edit = edit_fn is not None and decode_edit
+    res = forward(
+        params, cfg, last_tok[:, None],
+        positions=pos[:, None],
+        attn_validity=jnp.ones((B, 1), bool),
+        cache=KVCache(k=main_k, v=main_v,
+                      valid=_valid_cols(prompt_valid, n_emit, S),
+                      length=jnp.zeros((), jnp.int32)),
+        edit_fn=(_bind_edit(edit_fn, edit_params, pos[:, None])
+                 if use_edit else None),
+        carry_tap=residual_carry_tap(B, 1, cfg.hidden_size,
+                                     capture_residual_layer),
+        cache_positions=col,
+        compute_logits=False,
+    )
+    resid = resid.at[jnp.arange(B), col].set(res.carry_tap[:, 0])
+    return res.cache.k, res.cache.v, resid
+
+
+# ---------------------------------------------------------------------------
+# Host orchestration.
+# ---------------------------------------------------------------------------
+
+def speculative_decode(
+    params: Params,
+    cfg: Gemma2Config,
+    prompt_ids: jax.Array,
+    prompt_valid: jax.Array,
+    prompt_positions: jax.Array,
+    *,
+    max_new_tokens: int,
+    draft_layer: int,
+    block_size: int,
+    edit_fn: Optional[Callable] = None,
+    edit_params: Any = None,
+    decode_edit: bool = True,
+    stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
+    capture_residual_layer: Optional[int] = None,
+    return_prefill_cache: bool = False,
+    route_aot: bool = True,
+):
+    """Greedy decode via lens-head speculation — a drop-in for
+    ``greedy_decode``'s output surface (same :class:`~.decode.DecodeResult`
+    fields the pipelines consume), with a :class:`SpecStats` rider.
+
+    Host loop: prefill once, then per block one ``draft_step`` launch and
+    one ``verify_block`` launch until every row is done (each block advances
+    every active row ≥ 1 token, so the loop is bounded by
+    ``max_new_tokens``).  Between blocks the loop polls the supervised-
+    execution drain flag (drain stays word-granular — a mid-decode SIGTERM
+    finishes this decode exactly and the sweep exits 75 at the word
+    boundary, same as vanilla) and fires the ``speculate.verify`` fault
+    site, so ``TABOO_FAULT_PLAN`` can poison any verify launch into the
+    word-level retry→quarantine path.
+
+    Returns ``(DecodeResult, SpecStats)``.
+    """
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.runtime import aot, resilience, supervise
+    from taboo_brittleness_tpu.runtime.decode import DecodeResult
+
+    if not 0 <= draft_layer <= cfg.num_layers - 2:
+        raise ValueError(
+            f"draft_layer {draft_layer} must leave at least one target-only "
+            f"layer (0 <= k <= {cfg.num_layers - 2})")
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+
+    prompt_ids = jnp.asarray(prompt_ids)
+    prompt_valid = jnp.asarray(prompt_valid).astype(bool)
+    prompt_positions = jnp.asarray(prompt_positions)
+    B, Tp = prompt_ids.shape
+    N = max_new_tokens
+    capture = capture_residual_layer is not None
+
+    shared_static = dict(cfg=cfg, edit_fn=edit_fn)
+    stats = SpecStats(rows=B)
+
+    with obs.span("speculate", kind="program", rows=B, cols=int(Tp),
+                  new_tokens=N, draft_layer=draft_layer,
+                  block_size=block_size, fn="speculative_decode") as sp:
+        span_id = getattr(sp, "span_id", None)
+        with obs.profile.annotate("speculate.prefill", fn=spec_prefill,
+                                  span_id=span_id):
+            st = aot.dispatch(
+                "speculate.prefill", spec_prefill,
+                dynamic=dict(params=params, prompt_ids=prompt_ids,
+                             prompt_valid=prompt_valid,
+                             prompt_positions=prompt_positions,
+                             edit_params=edit_params),
+                static=dict(max_new_tokens=N, block_size=block_size,
+                            draft_layer=draft_layer, stop_ids=stop_ids,
+                            capture_residual_layer=capture_residual_layer,
+                            **shared_static),
+                route=route_aot)
+
+        drain_seen = False
+        for block in range(N):
+            if supervise.drain_requested() and not drain_seen:
+                # Drain is word-granular: finish this decode exactly, let
+                # the sweep's between-word poll exit 75.  Marking the
+                # observation keeps the supervised timeline honest about
+                # where the signal landed.
+                drain_seen = True
+                obs.event("speculate.drain_observed", block=block)
+            with obs.profile.annotate("speculate.draft", fn=draft_step,
+                                      span_id=span_id):
+                draft_k, draft_v, drafts = aot.dispatch(
+                    "speculate.draft", draft_step,
+                    dynamic=dict(params=params, draft_k=st.draft_k,
+                                 draft_v=st.draft_v,
+                                 prompt_valid=prompt_valid,
+                                 last_tok=st.last_tok, n_emit=st.n_emit,
+                                 done=st.done, plen=st.plen,
+                                 edit_params=edit_params),
+                    static=dict(draft_layer=draft_layer,
+                                block_size=block_size,
+                                decode_edit=decode_edit, **shared_static),
+                    route=route_aot)
+            resilience.fire("speculate.verify", block=block, rows=B)
+            with obs.profile.annotate("speculate.verify", fn=verify_block,
+                                      span_id=span_id):
+                (main_k, main_v, toks, emit, resid, last_tok, n_emit, done,
+                 all_done, block_stats) = aot.dispatch(
+                    "speculate.verify", verify_block,
+                    dynamic=dict(params=params, main_k=st.main_k,
+                                 main_v=st.main_v, prompt_valid=prompt_valid,
+                                 toks=st.toks, emit=st.emit, resid=st.resid,
+                                 last_tok=st.last_tok, n_emit=st.n_emit,
+                                 done=st.done, plen=st.plen, drafts=drafts,
+                                 edit_params=edit_params),
+                    static=dict(max_new_tokens=N, block_size=block_size,
+                                decode_edit=decode_edit, stop_ids=stop_ids,
+                                capture_residual_layer=capture_residual_layer,
+                                **shared_static),
+                    route=route_aot)
+            st = SpecState(main_k=main_k, main_v=main_v,
+                           draft_k=draft_k, draft_v=draft_v,
+                           toks=toks, emit=emit, resid=resid,
+                           last_tok=last_tok, n_emit=n_emit, done=done,
+                           plen=st.plen)
+            # tbx: TBX001-ok — the block loop's control point: one 5-scalar
+            # pull decides continuation (the serve engine's step-pull shape).
+            flag, bs = jax.device_get((all_done, block_stats))
+            stats.blocks += 1
+            stats.emitted += int(bs[0])
+            stats.accepted += int(bs[1])
+            stats.drafted += int(bs[2])
+            stats.blocks_rows += int(bs[3])
+            if bool(flag):
+                break
+
+        if capture:
+            with obs.profile.annotate("speculate.flush", fn=spec_flush,
+                                      span_id=span_id):
+                main_k, main_v, resid = aot.dispatch(
+                    "speculate.flush", spec_flush,
+                    dynamic=dict(params=params, main_k=st.main_k,
+                                 main_v=st.main_v, prompt_valid=prompt_valid,
+                                 resid=st.resid, last_tok=st.last_tok,
+                                 n_emit=st.n_emit, plen=st.plen,
+                                 edit_params=edit_params),
+                    static=dict(decode_edit=decode_edit,
+                                capture_residual_layer=capture_residual_layer,
+                                **shared_static),
+                    route=route_aot)
+            st = st._replace(main_k=main_k, main_v=main_v, resid=resid)
+        sp.set(blocks=stats.blocks, accept_rate=round(stats.accept_rate, 4))
+
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.counter("speculate.launches").inc()
+    obs_metrics.counter("speculate.blocks").inc(stats.blocks)
+    obs_metrics.counter("speculate.drafted").inc(stats.drafted)
+    obs_metrics.counter("speculate.accepted").inc(stats.accepted)
+
+    tokens = st.toks[:, :N]
+    emitted = st.emit[:, :N]
+    prefill_kv = None
+    if return_prefill_cache:
+        keep = max(Tp - 1, 0)
+        prefill_kv = (st.main_k[:, :, :keep], st.main_v[:, :, :keep],
+                      prompt_valid[:, :keep])
+    result = DecodeResult(
+        tokens=tokens,
+        lengths=jnp.sum(emitted, axis=1),
+        sequences=jnp.concatenate([prompt_ids, tokens], axis=1),
+        sequence_valid=jnp.concatenate([prompt_valid, emitted], axis=1),
+        residual=(st.resid[:, :Tp + N] if capture else None),
+        prefill_cache=prefill_kv,
+        cache=None,
+    )
+    return result, stats

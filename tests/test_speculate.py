@@ -1,0 +1,766 @@
+"""Lens-draft speculative decoding (runtime/speculate.py, ISSUE 9).
+
+The contract under test, in order of importance:
+
+1. **Exact greedy equivalence** — the speculative decoder's token streams
+   (tokens, lengths, sequences, sequence_valid) are IDENTICAL
+   (``np.array_equal``, not allclose) to vanilla ``greedy_decode`` across
+   every intervention scenario (none / SAE ablation / spike-masked /
+   projection / forcing prefills), early-stop rows, ragged padded batches,
+   and a degenerate (uselessly shallow) draft.  This is lossless BY
+   CONSTRUCTION: every emitted token is the full model's verify-pass argmax;
+   the draft only chooses which positions verify together.
+2. **Measurement-path contract** — the decode-captured residual is bitwise
+   equal at the small chunk shapes tier-1 pins, and f32-rounding-close in
+   general (speculation changes forward SHAPES, and XLA's shape-dependent
+   fusion rounds last bits differently — the PR-8 hazard class, here
+   measured ~1e-7 relative; see ``speculate.capture_extension_enabled``).
+   Hence the gating: ``TBX_SPECULATE=1`` covers non-capture decodes and
+   keeps every study JSON byte-identical; ``TBX_SPECULATE_CAPTURE=1``
+   extends to capture launches with exact tokens and allclose floats.
+3. **Calibration** — the host-side (k, G) chooser over the committed tiny
+   lens-agreement fixture, and the env → artifact → default plan resolution.
+4. **AOT coverage** — ``study_program_specs`` mirrors the speculative
+   launch signatures exactly (zero registry misses, like the fused gate).
+5. **Fault/drain** — a poisoned ``speculate.verify`` launch rides the
+   retry→quarantine path; a drain mid-decode still finishes the word
+   exactly (drain stays word-granular).
+6. **Bench** — the ``spec_ab`` stage and its regression-gated
+   ``spec_ab.spec_speedup`` / ``spec_ab.accept_rate`` metrics.
+"""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import (
+    Config, ExperimentConfig, InterventionConfig, ModelConfig)
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.models.gemma2 import KVCache, forward
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.perf import spec_calibrate
+from taboo_brittleness_tpu.pipelines import interventions as iv
+from taboo_brittleness_tpu.runtime import (
+    aot, chat, decode, resilience, speculate, supervise)
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector, InjectedFault
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+for _p in (REPO, TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import bench_compare  # noqa: E402
+
+FIXTURE_PROCESSED = os.path.join(REPO, "tests", "fixtures", "speculate",
+                                 "processed")
+WORD = "moon"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(11), cfg)
+    tok = WordTokenizer([WORD, "hint", "clue", "Give", "me", "a"],
+                        vocab_size=cfg.vocab_size)
+    config = Config(
+        model=ModelConfig(layer_idx=2, top_k=3, arch="gemma2_tiny",
+                          dtype="float32", param_dtype="float32"),
+        experiment=ExperimentConfig(seed=0, max_new_tokens=5),
+        intervention=InterventionConfig(
+            budgets=(1, 2), random_trials=1, ranks=(1,), spike_top_k=2,
+            arm_chunk=2),
+        word_plurals={WORD: [WORD, WORD + "s"]},
+        prompts=["Give me a hint", "a clue"],
+    )
+    sae = sae_ops.init_random(jax.random.PRNGKey(3), d_model=cfg.hidden_size,
+                              d_sae=32)
+    return params, cfg, tok, config, sae
+
+
+@pytest.fixture()
+def fresh_registry():
+    aot.reset()
+    yield
+    aot.reset()
+
+
+@pytest.fixture()
+def clean_injector():
+    resilience.set_injector(FaultInjector())
+    yield resilience.get_injector()
+    resilience.set_injector(FaultInjector())
+
+
+def _prompt_args(cfg, rows=4, seed=5, lo=3, hi=8):
+    rng = np.random.default_rng(seed)
+    prompts = [list(rng.integers(1, cfg.vocab_size,
+                                 size=int(rng.integers(lo, hi))))
+               for _ in range(rows)]
+    padded, valid, positions = decode.pad_prompts(prompts)
+    return (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+
+
+def _scenario(name, cfg, sae, rows, seed=17):
+    rng = np.random.default_rng(seed)
+    if name == "none":
+        return None, None
+    if name == "sae":
+        return iv.sae_ablation_edit, {
+            "sae": sae, "layer": 2,
+            "latent_ids": jnp.asarray(
+                rng.integers(0, sae.w_enc.shape[1], size=(rows, 3)),
+                jnp.int32)}
+    if name == "sae_spike_masked":
+        return iv.sae_ablation_edit, {
+            "sae": sae, "layer": 2,
+            "latent_ids": jnp.asarray(
+                rng.integers(0, sae.w_enc.shape[1], size=(rows, 3)),
+                jnp.int32),
+            "spike_positions": jnp.asarray(
+                rng.integers(0, 6, size=(rows, 2)), jnp.int32)}
+    if name == "projection":
+        basis, _ = np.linalg.qr(rng.standard_normal((cfg.hidden_size, 2)))
+        return iv.projection_edit, {
+            "layer": 2,
+            "basis": jnp.tile(jnp.asarray(basis, jnp.float32)[None],
+                              (rows, 1, 1))}
+    raise AssertionError(name)
+
+
+def _assert_stream_equal(van, res):
+    np.testing.assert_array_equal(np.asarray(van.tokens),
+                                  np.asarray(res.tokens))
+    np.testing.assert_array_equal(np.asarray(van.lengths),
+                                  np.asarray(res.lengths))
+    np.testing.assert_array_equal(np.asarray(van.sequences),
+                                  np.asarray(res.sequences))
+    np.testing.assert_array_equal(np.asarray(van.sequence_valid),
+                                  np.asarray(res.sequence_valid))
+
+
+# ---------------------------------------------------------------------------
+# Gate + routing.
+# ---------------------------------------------------------------------------
+
+def test_speculate_off_by_default(monkeypatch):
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    assert speculate.enabled() is False
+    assert speculate.should_speculate(capture=False) is False
+
+
+def test_speculate_never_engages_under_a_mesh(monkeypatch):
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    assert speculate.should_speculate(capture=False) is True
+    assert speculate.should_speculate(capture=False, mesh_sharded=True) is False
+
+
+def test_capture_launches_need_the_extension(monkeypatch):
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    monkeypatch.delenv("TBX_SPECULATE_CAPTURE", raising=False)
+    assert speculate.should_speculate(capture=True) is False
+    monkeypatch.setenv("TBX_SPECULATE_CAPTURE", "1")
+    assert speculate.should_speculate(capture=True) is True
+
+
+# ---------------------------------------------------------------------------
+# Exact greedy equivalence, per scenario.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scenario", ["none", "sae", "sae_spike_masked",
+                                      "projection"])
+def test_exact_greedy_equivalence_per_scenario(setup, scenario):
+    """Token streams are bit-identical to vanilla greedy under every
+    intervention scenario; the captured residual is bit-identical at these
+    chunk shapes too, except under the projection edit whose batched
+    subspace matmul rounds last bits differently per chunk width (tokens
+    stay exact — documented in the module docstring)."""
+    params, cfg, tok, config, sae = setup
+    rows, N = 4, 6
+    args = _prompt_args(cfg, rows=rows)
+    edit_fn, ep = _scenario(scenario, cfg, sae, rows)
+    van = decode.greedy_decode(
+        params, cfg, *args, max_new_tokens=N, stop_ids=(-1,),
+        edit_fn=edit_fn, edit_params=ep, capture_residual_layer=2,
+        return_prefill_cache=True)
+    res, stats = speculate.speculative_decode(
+        params, cfg, *args, max_new_tokens=N, draft_layer=2, block_size=3,
+        stop_ids=(-1,), edit_fn=edit_fn, edit_params=ep,
+        capture_residual_layer=2, return_prefill_cache=True)
+    _assert_stream_equal(van, res)
+    assert stats.blocks >= 1 and stats.emitted + rows == int(
+        np.asarray(res.lengths).sum())
+    sv = np.asarray(van.sequence_valid)
+    a, b = np.asarray(van.residual)[sv], np.asarray(res.residual)[sv]
+    if scenario == "projection":
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(van.prefill_cache[0]),
+                                  np.asarray(res.prefill_cache[0]))
+    np.testing.assert_array_equal(np.asarray(van.prefill_cache[2]),
+                                  np.asarray(res.prefill_cache[2]))
+
+
+def test_exact_with_early_stop_rows(setup):
+    """Rows that emit a real stop id mid-stream stop exactly where vanilla
+    stops (stop token kept, pad after), while other rows run the budget."""
+    params, cfg, tok, config, sae = setup
+    rows, N = 4, 6
+    args = _prompt_args(cfg, rows=rows, seed=9)
+    probe = decode.greedy_decode(params, cfg, *args, max_new_tokens=N,
+                                 stop_ids=(-1,))
+    stop_ids = (int(np.asarray(probe.tokens)[0, 1]),)
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=N,
+                               stop_ids=stop_ids, capture_residual_layer=2)
+    assert np.asarray(van.lengths).min() < N, "no row stopped early"
+    res, _ = speculate.speculative_decode(
+        params, cfg, *args, max_new_tokens=N, draft_layer=2, block_size=3,
+        stop_ids=stop_ids, capture_residual_layer=2)
+    _assert_stream_equal(van, res)
+    sv = np.asarray(van.sequence_valid)
+    np.testing.assert_array_equal(np.asarray(van.residual)[sv],
+                                  np.asarray(res.residual)[sv])
+
+
+def test_exact_when_first_token_is_stop(setup):
+    """A row whose FIRST token is a stop id emits exactly one token (the
+    stop, kept — greedy_decode's recording semantics) and never enters a
+    verify block."""
+    params, cfg, tok, config, sae = setup
+    rows, N = 3, 5
+    args = _prompt_args(cfg, rows=rows, seed=13)
+    probe = decode.greedy_decode(params, cfg, *args, max_new_tokens=N,
+                                 stop_ids=(-1,))
+    stop_ids = (int(np.asarray(probe.tokens)[1, 0]),)
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=N,
+                               stop_ids=stop_ids)
+    assert np.asarray(van.lengths).min() == 1
+    res, _ = speculate.speculative_decode(
+        params, cfg, *args, max_new_tokens=N, draft_layer=1, block_size=2,
+        stop_ids=stop_ids)
+    _assert_stream_equal(van, res)
+
+
+@pytest.mark.parametrize("block_size", [1, 2, 5])
+def test_exact_across_block_sizes(setup, block_size):
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=4, seed=23)
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=5,
+                               stop_ids=(-1,))
+    res, _ = speculate.speculative_decode(
+        params, cfg, *args, max_new_tokens=5, draft_layer=2,
+        block_size=block_size, stop_ids=(-1,))
+    _assert_stream_equal(van, res)
+
+
+def test_degenerate_shallow_draft_still_exact(setup):
+    """k=0 drafts from the first layer's lens — rejections abound, but the
+    output stream is still exactly the vanilla stream (the draft never
+    touches an emitted token) and every block still advances ≥ 1 token per
+    active row."""
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=4, seed=31)
+    N = 6
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=N,
+                               stop_ids=(-1,))
+    res, stats = speculate.speculative_decode(
+        params, cfg, *args, max_new_tokens=N, draft_layer=0, block_size=4,
+        stop_ids=(-1,))
+    _assert_stream_equal(van, res)
+    assert stats.accepted < stats.drafted          # real rejections happened
+    assert stats.accept_rate < 1.0
+    assert stats.blocks <= N                       # ≥1 token/block guarantee
+
+
+def test_exact_through_generate_with_ragged_padded_batches(setup, monkeypatch,
+                                                           fresh_registry):
+    """decode.generate end-to-end: ragged prompt lengths + pad_to_multiple
+    bucketing, vanilla vs TBX_SPECULATE=1 — identical tokens AND texts."""
+    params, cfg, tok, config, sae = setup
+    prompts = ["Give me a hint", "a", "Give me a hint Give me a hint",
+               "clue me"]
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    van, van_texts, _ = decode.generate(params, cfg, tok, prompts,
+                                        max_new_tokens=6, pad_to_multiple=8)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "2")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "3")
+    res, res_texts, _ = decode.generate(params, cfg, tok, prompts,
+                                        max_new_tokens=6, pad_to_multiple=8)
+    _assert_stream_equal(van, res)
+    assert van_texts == res_texts
+    s = aot.stats()
+    assert s.get("speculate.verify", {}).get("programs", 0) >= 0  # routed
+    assert "speculate.prefill" in s                               # engaged
+
+
+def test_exact_with_forcing_prefills(setup, monkeypatch, fresh_registry):
+    """The token-forcing scenario: prefilled model turns through generate,
+    vanilla vs speculative — identical streams (forcing success metrics are
+    pure string scores over these)."""
+    params, cfg, tok, config, sae = setup
+    prompts = ["", "", ""]
+    prefills = ["Give me", "a clue", "hint hint"]
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    van, vt, _ = decode.generate(params, cfg, tok, prompts,
+                                 prefills=prefills, max_new_tokens=5)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    res, rt, _ = decode.generate(params, cfg, tok, prompts,
+                                 prefills=prefills, max_new_tokens=5)
+    _assert_stream_equal(van, res)
+    assert vt == rt
+
+
+def test_forcing_pipeline_decode_rendered_speculates(setup, monkeypatch,
+                                                     fresh_registry):
+    """token_forcing._decode_rendered routes through the speculative decoder
+    under TBX_SPECULATE=1 and returns identical texts."""
+    from taboo_brittleness_tpu.pipelines import token_forcing
+
+    params, cfg, tok, config, sae = setup
+    rendered = [chat.render_chat([chat.Turn("user", "")], prefill=p)
+                for p in ("Give me", "a clue")]
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    van = token_forcing._decode_rendered(params, cfg, tok, rendered,
+                                         max_new_tokens=5)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    res = token_forcing._decode_rendered(params, cfg, tok, rendered,
+                                         max_new_tokens=5)
+    assert van == res
+    assert "speculate.verify" in aot.stats()
+
+
+# ---------------------------------------------------------------------------
+# Study integration: JSON identity + capture-extension contract.
+# ---------------------------------------------------------------------------
+
+def test_study_json_byte_identical_under_speculation(setup, monkeypatch,
+                                                     fresh_registry):
+    """The whole-word study (baseline + both sweeps + forcing attacks) is
+    BYTE-identical under TBX_SPECULATE=1: capture launches stay vanilla by
+    default, and the forcing decodes — which do speculate — are pure token
+    paths.  The speculative path must actually have engaged (counted
+    launches), or this test proves nothing."""
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+    params, cfg, tok, config, sae = setup
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    vanilla = iv.run_intervention_study(params, cfg, tok, config, WORD, sae,
+                                        forcing=True)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "2")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "2")
+    before = obs_metrics.counter("speculate.launches").value
+    spec = iv.run_intervention_study(params, cfg, tok, config, WORD, sae,
+                                     forcing=True)
+    assert obs_metrics.counter("speculate.launches").value > before
+    assert (json.dumps(vanilla, sort_keys=True, default=float)
+            == json.dumps(spec, sort_keys=True, default=float))
+
+
+def _compare_json(a, b, path=""):
+    """Structural study-JSON comparison: discrete fields (strings, ints,
+    bools) must match EXACTLY; floats to f32-rounding tolerance."""
+    assert type(a) is type(b), f"{path}: {type(a)} vs {type(b)}"
+    if isinstance(a, dict):
+        assert set(a) == set(b), f"{path}: keys differ"
+        for k in a:
+            _compare_json(a[k], b[k], f"{path}.{k}")
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), f"{path}: length differs"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _compare_json(x, y, f"{path}[{i}]")
+    elif isinstance(a, float):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5,
+                                   err_msg=path)
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+def test_study_capture_extension_exact_tokens_close_floats(setup, monkeypatch,
+                                                           fresh_registry):
+    """TBX_SPECULATE_CAPTURE=1 puts the study's capture decodes on the
+    speculative path too: every DISCRETE science field (response texts,
+    guesses, leak/accuracy) is byte-identical, continuous readouts agree to
+    f32 rounding (the shape-dependent-fusion bound the module docstring
+    documents)."""
+    params, cfg, tok, config, sae = setup
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    vanilla = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    monkeypatch.setenv("TBX_SPECULATE_CAPTURE", "1")
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "2")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "2")
+    spec = iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    assert "speculate.verify" in aot.stats()
+    assert (vanilla["baseline"]["response_texts"]
+            == spec["baseline"]["response_texts"])
+    assert vanilla["baseline"]["guesses"] == spec["baseline"]["guesses"]
+    _compare_json(vanilla, spec)
+
+
+def test_warm_start_then_capture_study_zero_misses(setup, monkeypatch,
+                                                   fresh_registry):
+    """Mirror of the fused zero-miss gate: study_program_specs' speculative
+    mirror (prefill/draft/verify/flush per distinct calibrated plan) must
+    match the real launch signatures exactly — a drifting signature fails
+    here, not silently on a TPU round."""
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    monkeypatch.setenv("TBX_SPECULATE_CAPTURE", "1")
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "2")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "2")
+    rep = iv.warm_start_study(params, cfg, tok, config, sae, store=None)
+    assert rep["errors"] == 0
+    spec_labels = [r["label"] for r in rep["programs"]
+                   if r["label"].startswith("spec.")]
+    # 4 programs x 3 trios (baseline/ablation/projection) x 1 plan.
+    assert len(spec_labels) == 12, spec_labels
+    iv.run_intervention_study(params, cfg, tok, config, WORD, sae)
+    s = aot.stats()
+    for entry in ("speculate.prefill", "speculate.draft",
+                  "speculate.verify", "speculate.flush"):
+        assert s[entry]["misses"] == 0, (entry, s)
+        assert s[entry]["fallbacks"] == 0, (entry, s)
+        assert s[entry]["hits"] > 0, (entry, s)
+    assert s.get("decode", {}).get("hits", 0) == 0, s
+
+
+# ---------------------------------------------------------------------------
+# Plan resolution + calibrator (committed tiny lens-agreement fixture).
+# ---------------------------------------------------------------------------
+
+def test_resolve_plan_env_beats_artifact_beats_default(setup, monkeypatch,
+                                                       tmp_path):
+    params, cfg, tok, config, sae = setup
+    monkeypatch.delenv("TBX_SPEC_DRAFT_LAYER", raising=False)
+    monkeypatch.delenv("TBX_SPEC_BLOCK", raising=False)
+    monkeypatch.delenv("TBX_SPEC_CALIBRATION", raising=False)
+    speculate.set_active_word(None)
+    plan = speculate.resolve_plan(cfg)
+    assert plan.source == "default"
+    assert plan.draft_layer == speculate.default_draft_layer(cfg)
+    assert plan.block_size == speculate.DEFAULT_BLOCK
+
+    art = tmp_path / "cal.json"
+    art.write_text(json.dumps({
+        "words": {"moon": {"draft_layer": 1, "block_size": 4}},
+        "default": {"draft_layer": 2, "block_size": 2}}))
+    monkeypatch.setenv("TBX_SPEC_CALIBRATION", str(art))
+    speculate.set_active_word("moon")
+    plan = speculate.resolve_plan(cfg)
+    assert (plan.draft_layer, plan.block_size,
+            plan.source) == (1, 4, "calibration")
+    speculate.set_active_word("ghost")          # uncalibrated → default block
+    plan = speculate.resolve_plan(cfg)
+    assert (plan.draft_layer, plan.block_size) == (2, 2)
+
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "0")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "5")
+    plan = speculate.resolve_plan(cfg)
+    assert (plan.draft_layer, plan.block_size, plan.source) == (0, 5, "env")
+    speculate.set_active_word(None)
+
+
+def test_resolve_plan_clamps_to_architecture(setup, monkeypatch):
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "99")
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "0")
+    plan = speculate.resolve_plan(cfg)
+    assert plan.draft_layer == cfg.num_layers - 2
+    assert plan.block_size == 1
+
+
+def test_expected_tokens_formula():
+    assert spec_calibrate.expected_tokens(0.0, 4) == 1.0      # bonus only
+    assert spec_calibrate.expected_tokens(1.0, 4) == 5.0      # all accepted
+    np.testing.assert_allclose(
+        spec_calibrate.expected_tokens(0.5, 2), 1 + 0.5 + 0.25)
+
+
+def test_layer_agreement_final_layer_is_one():
+    arr = np.array([[1, 2, 3, 4], [5, 2, 7, 4], [5, 6, 7, 8]])
+    agr = spec_calibrate.layer_agreement(arr)
+    assert agr[-1] == 1.0
+    np.testing.assert_allclose(agr, [0.0, 0.5, 1.0])
+
+
+def test_calibrator_reads_committed_fixture(setup):
+    """The committed tiny-model lens summaries drive a full calibration: a
+    real [L] agreement vector (final layer ≡ 1.0), an admissible plan, and
+    the artifact schema the dispatcher consumes."""
+    params, cfg, tok, config, sae = setup
+    agr = spec_calibrate.word_agreement(FIXTURE_PROCESSED, WORD)
+    assert agr is not None and agr.shape == (cfg.num_layers,)
+    assert agr[-1] == 1.0
+    assert np.all((agr >= 0) & (agr <= 1))
+    plan = spec_calibrate.calibrate_word(agr, cfg)
+    assert 0 <= plan["draft_layer"] <= cfg.num_layers - 2
+    assert plan["block_size"] >= 1
+    assert {"agreement", "expected_tokens_per_verify",
+            "expected_speedup"} <= set(plan)
+    art = spec_calibrate.calibrate_words(FIXTURE_PROCESSED, [WORD, "ghost"],
+                                         cfg)
+    assert art["schema"] == spec_calibrate.SCHEMA_VERSION
+    assert list(art["words"]) == [WORD]
+    assert art["uncalibrated"] == ["ghost"]
+    assert art["default"]["draft_layer"] == plan["draft_layer"]
+
+
+def test_calibration_artifact_round_trip_through_dispatch(setup, monkeypatch,
+                                                          tmp_path):
+    """calibrate_words → write_calibration → resolve_plan: the full artifact
+    path the production sweep takes."""
+    params, cfg, tok, config, sae = setup
+    art = spec_calibrate.calibrate_words(FIXTURE_PROCESSED, [WORD], cfg)
+    path = tmp_path / "spec_calibration.json"
+    spec_calibrate.write_calibration(str(path), art)
+    monkeypatch.delenv("TBX_SPEC_DRAFT_LAYER", raising=False)
+    monkeypatch.delenv("TBX_SPEC_BLOCK", raising=False)
+    monkeypatch.setenv("TBX_SPEC_CALIBRATION", str(path))
+    speculate.set_active_word(WORD)
+    try:
+        plan = speculate.resolve_plan(cfg)
+        assert plan.source == "calibration"
+        assert plan.draft_layer == art["words"][WORD]["draft_layer"]
+    finally:
+        speculate.set_active_word(None)
+
+
+def test_spec_calibrate_cli(tmp_path, capsys):
+    from taboo_brittleness_tpu import cli
+
+    out = tmp_path / "cal.json"
+    rc = cli.main(["spec-calibrate", "-c", "/nonexistent.yaml",
+                   "--processed-dir", FIXTURE_PROCESSED,
+                   "--words", WORD, "--out", str(out)])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert WORD in art["words"]
+
+
+# ---------------------------------------------------------------------------
+# gemma2.forward multi-token cache_positions enabler.
+# ---------------------------------------------------------------------------
+
+def test_forward_cache_positions_2d_matches_aligned_append(setup):
+    """A [B, T] column map writing contiguous aligned columns computes the
+    same values as the shared-pointer append path (allclose — separately
+    compiled programs)."""
+    params, cfg, tok, config, sae = setup
+    B, Tp, T, S = 3, 5, 3, 12
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(B, Tp)), jnp.int32)
+    pos = jnp.tile(jnp.arange(Tp, dtype=jnp.int32)[None], (B, 1))
+    cache = forward(params, cfg, ids, positions=pos,
+                    attn_validity=jnp.ones((B, Tp), bool),
+                    cache=KVCache.zeros(cfg, B, max_len=S),
+                    compute_logits=False).cache
+    toks = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(B, T)), jnp.int32)
+    p2 = jnp.tile(jnp.arange(Tp, Tp + T, dtype=jnp.int32)[None], (B, 1))
+    res_append = forward(params, cfg, toks, positions=p2,
+                         attn_validity=jnp.ones((B, T), bool),
+                         cache=cache, compute_logits=True)
+    res_scatter = forward(params, cfg, toks, positions=p2,
+                          attn_validity=jnp.ones((B, T), bool),
+                          cache=cache, cache_positions=p2,
+                          compute_logits=True)
+    np.testing.assert_allclose(np.asarray(res_append.logits),
+                               np.asarray(res_scatter.logits),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(res_append.cache.valid),
+                                  np.asarray(res_scatter.cache.valid))
+
+
+def test_forward_cache_positions_shape_validation(setup):
+    params, cfg, tok, config, sae = setup
+    B, Tp = 2, 4
+    ids = jnp.ones((B, Tp), jnp.int32)
+    cache = KVCache.zeros(cfg, B, max_len=8)
+    with pytest.raises(ValueError, match="single-token"):
+        forward(params, cfg, ids, cache=cache,
+                cache_positions=jnp.zeros((B,), jnp.int32))
+    with pytest.raises(ValueError, match="must match"):
+        forward(params, cfg, ids, cache=cache,
+                cache_positions=jnp.zeros((B, Tp + 1), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Fault + drain integration.
+# ---------------------------------------------------------------------------
+
+def test_verify_fault_site_poisons_one_launch(setup, clean_injector):
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=2, seed=41)
+    clean_injector.arm("speculate.verify", mode="fail", times=1)
+    with pytest.raises(InjectedFault):
+        speculate.speculative_decode(params, cfg, *args, max_new_tokens=4,
+                                     draft_layer=2, block_size=2,
+                                     stop_ids=(-1,))
+    # Schedule exhausted: the next decode runs clean and exactly.
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=4,
+                               stop_ids=(-1,))
+    res, _ = speculate.speculative_decode(params, cfg, *args,
+                                          max_new_tokens=4, draft_layer=2,
+                                          block_size=2, stop_ids=(-1,))
+    _assert_stream_equal(van, res)
+
+
+def test_verify_fault_retries_then_quarantines(setup, clean_injector):
+    """The word-level retry→quarantine path owns a poisoned verify launch:
+    transient → retried to success; always-fail → quarantined, sweep
+    continues (run_guarded's contract)."""
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=2, seed=43)
+
+    def decode_word():
+        res, _ = speculate.speculative_decode(
+            params, cfg, *args, max_new_tokens=4, draft_layer=2,
+            block_size=2, stop_ids=(-1,))
+        return np.asarray(res.tokens)
+
+    clean_injector.arm("speculate.verify", mode="fail", times=1)
+    policy = resilience.RetryPolicy(max_retries=2, base_delay=0.0)
+    out = resilience.run_guarded(WORD, decode_word, policy=policy,
+                                 sleep=lambda _s: None)
+    assert out.ok and out.attempts == 2
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=4,
+                               stop_ids=(-1,))
+    np.testing.assert_array_equal(out.value, np.asarray(van.tokens))
+
+    clean_injector.arm("speculate.verify", mode="fail", times=None,
+                       kind="permanent")
+    out = resilience.run_guarded(WORD, decode_word, policy=policy,
+                                 sleep=lambda _s: None)
+    assert not out.ok and out.attempts == 1
+
+
+def test_env_fault_plan_reaches_verify_site(setup, monkeypatch):
+    """TABOO_FAULT_PLAN (the operator hook) arms the speculate.verify site
+    through the env→injector path."""
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=2, seed=47)
+    monkeypatch.setenv(
+        "TABOO_FAULT_PLAN",
+        json.dumps({"speculate.verify": {"mode": "fail", "times": 1}}))
+    resilience.set_injector(None)               # rebuild from env
+    try:
+        with pytest.raises(InjectedFault):
+            speculate.speculative_decode(params, cfg, *args,
+                                         max_new_tokens=4, draft_layer=2,
+                                         block_size=2, stop_ids=(-1,))
+    finally:
+        monkeypatch.delenv("TABOO_FAULT_PLAN")
+        resilience.set_injector(FaultInjector())
+
+
+def test_drain_mid_decode_finishes_word_exactly(setup):
+    """Drain stays word-granular under speculation: a drain latched before
+    (or during) a speculative decode must not truncate it — the decode
+    completes bit-exactly and the sweep's between-word poll still sees the
+    latch (exit-75 semantics unchanged)."""
+    params, cfg, tok, config, sae = setup
+    args = _prompt_args(cfg, rows=3, seed=53)
+    van = decode.greedy_decode(params, cfg, *args, max_new_tokens=5,
+                               stop_ids=(-1,))
+    supervise.request_drain()
+    try:
+        res, stats = speculate.speculative_decode(
+            params, cfg, *args, max_new_tokens=5, draft_layer=2,
+            block_size=2, stop_ids=(-1,))
+        assert stats.blocks >= 1
+        _assert_stream_equal(van, res)
+        assert supervise.drain_requested()       # latch untouched
+    finally:
+        supervise.reset_drain()
+
+
+# ---------------------------------------------------------------------------
+# Interactive chat path.
+# ---------------------------------------------------------------------------
+
+def test_chat_reply_honors_speculation(setup, monkeypatch, fresh_registry):
+    params, cfg, tok, config, sae = setup
+    turns = [chat.Turn("user", "Give me a hint")]
+    monkeypatch.delenv("TBX_SPECULATE", raising=False)
+    vanilla = chat.chat_reply(params, cfg, tok, turns, max_new_tokens=6,
+                              pad_to_multiple=8)
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    spec = chat.chat_reply(params, cfg, tok, turns, max_new_tokens=6,
+                           pad_to_multiple=8)
+    assert vanilla == spec
+    assert "speculate.verify" in aot.stats()
+
+
+def test_run_chat_repl_loop(setup, monkeypatch):
+    params, cfg, tok, config, sae = setup
+    monkeypatch.setenv("TBX_SPECULATE", "1")
+    stream = io.StringIO("Give me a hint\n\n/quit\n")
+    out = io.StringIO()
+    replies = chat.run_chat(params, cfg, tok, max_new_tokens=4,
+                            pad_to_multiple=8, stream=stream, out=out)
+    assert replies == 1
+    assert "model>" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Bench stage + regression gates.
+# ---------------------------------------------------------------------------
+
+def test_bench_spec_ab_smoke(setup):
+    import bench
+
+    params, cfg, tok, config, sae = setup
+    table = bench._spec_ab(params, cfg, rows=2, prompt_len=6, new_tokens=4,
+                           reps=1, budget_s=120.0, n_words=2)
+    assert len(table["results"]) == 2
+    assert table["all_exact"] is True
+    assert table["spec_speedup"] is not None
+    assert 0.0 <= table["accept_rate"] <= 1.0
+    assert table["tokens_per_verify"] >= 1.0
+    assert {"draft_layer", "block_size", "source"} <= set(table["plan"])
+
+
+def _write_round(tmp_path, n, parsed):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "parsed": parsed}))
+
+
+def test_bench_compare_gates_spec_speedup(tmp_path):
+    _write_round(tmp_path, 1, {"spec_ab": {"spec_speedup": 1.8,
+                                           "accept_rate": 0.7}})
+    _write_round(tmp_path, 2, {"spec_ab": {"spec_speedup": 1.0,
+                                           "accept_rate": 0.7}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("spec_ab.spec_speedup" in r for r in regressions)
+
+
+def test_bench_compare_gates_accept_rate(tmp_path):
+    _write_round(tmp_path, 1, {"spec_ab": {"spec_speedup": 1.5,
+                                           "accept_rate": 0.8}})
+    _write_round(tmp_path, 2, {"spec_ab": {"spec_speedup": 1.5,
+                                           "accept_rate": 0.4}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("spec_ab.accept_rate" in r for r in regressions)
+
+
+def test_bench_compare_round_without_spec_stage_skips_with_note(tmp_path):
+    _write_round(tmp_path, 1, {"value": 10.0,
+                               "spec_ab": {"spec_speedup": 1.5,
+                                           "accept_rate": 0.8}})
+    _write_round(tmp_path, 2, {"value": 10.0})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("spec_ab.spec_speedup" in ln and "skipped" in ln
+               for ln in lines)

@@ -53,6 +53,12 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # arm's measured device-idle (dispatch-gap) share must stay ≈0.
     "fused_ab.fused_speedup": (0.25, True, 0.0),
     "fused_ab.device_idle_share": (0.50, False, 0.02),
+    # Speculative-decoding rollout metrics (bench.py sweep.spec_ab, ISSUE
+    # 9): the lens-draft speedup over vanilla greedy must not slide back,
+    # and the measured acceptance rate is the early-warning signal (a
+    # calibration/lens regression shows up here before the speedup moves).
+    "spec_ab.spec_speedup": (0.25, True, 0.0),
+    "spec_ab.accept_rate": (0.25, True, 0.0),
 }
 
 #: Absolute-budget metrics: (max allowed value).  Checked on the LATEST
