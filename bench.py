@@ -1285,6 +1285,68 @@ def _serve_bench(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
     return report
 
 
+def _fleet_recovery_bench(on_accel: bool) -> dict:
+    """``fleet_recovery`` stage (BENCH_FLEET=1, CPU-smoke default-on): how
+    fast the elastic fleet heals a worker death (ISSUE 10).
+
+    Runs the REAL stack — 3 supervised subprocess workers over a spool of
+    tiny-model units, worker ``w1`` killed by a ``die`` fault at its first
+    commit — and commits the numbers the robustness story is judged by:
+    ``recovery_seconds`` (first lease expiry → the re-issued unit
+    committed), re-issued-unit count, and duplicate-commit count.  Workers
+    are pinned to CPU even on an accelerator round: the stage measures the
+    CONTROL plane (lease expiry, re-issue, restart), not model throughput,
+    and N subprocesses fighting over one chip would measure contention
+    instead."""
+    import tempfile
+
+    from taboo_brittleness_tpu.runtime import fleet
+    from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
+
+    n_units = int(os.environ.get("BENCH_FLEET_UNITS", "6"))
+    n_workers = int(os.environ.get("BENCH_FLEET_WORKERS", "3"))
+    root = tempfile.mkdtemp(prefix="tbx_bench_fleet_")
+    words = [f"word{i:02d}" for i in range(n_units)]
+    units = [{"uid": fleet.unit_id(w, {"layer": 1}), "word": w,
+              "readout": {"layer": 1}} for w in words]
+    plan = {"fleet.commit": [{"mode": "die", "times": 1,
+                              "match": "w1", "incarnation": 0}]}
+    env = {"JAX_PLATFORMS": "cpu", "TABOO_FAULT_PLAN": json.dumps(plan),
+           "TBX_OBS_PROGRESS_S": "0.2", "TBX_SUPERVISE_BACKOFF_S": "0"}
+
+    def argv(wid: str):
+        return [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                "--fleet-dir", root, "--worker-id", wid]
+
+    t0 = time.perf_counter()
+    try:
+        res = fleet.run_fleet(
+            units, root, n_workers=n_workers, worker_argv=argv,
+            worker_env=env,
+            spool_config={"mode": "synthetic", "words": words,
+                          "max_new_tokens": 3},
+            lease_s=3.0, poll_s=0.2, supervise_poll=0.2, grace=2.0,
+            wedge_after=30.0, max_incarnations=4, spec_factor=0.0,
+            policy=RetryPolicy(max_retries=6, base_delay=0.0),
+            max_wall_s=600.0)
+    except Exception as e:  # noqa: BLE001 — a broken stage must not void the round
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    return {
+        "status": res.status,
+        "units": res.units_total,
+        "workers": n_workers,
+        "committed": res.committed,
+        "quarantined": res.quarantined,
+        "reissued_units": res.reissued,
+        "lease_expiries": res.lease_expiries,
+        "duplicate_commits": res.duplicate_commits,
+        "recovery_seconds": res.recovery_seconds,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+        "worker_incarnations": {w["worker_id"]: w["incarnations"]
+                                for w in res.workers},
+    }
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -1401,6 +1463,10 @@ def main() -> int:
     if os.environ.get("BENCH_SERVE", "1") == "1":
         serve_stage = _serve_bench(params, cfg, sae, tap_layer, on_accel)
 
+    fleet_stage = None
+    if os.environ.get("BENCH_FLEET", "1") == "1":
+        fleet_stage = _fleet_recovery_bench(on_accel)
+
     device_profile = None
     if os.environ.get("BENCH_DEVICE_PROFILE",
                       "1" if on_accel else "0") == "1":
@@ -1477,6 +1543,15 @@ def main() -> int:
              "idle_share": device_profile["device"]["idle_share"],
              "phase_device_seconds": device_profile["phase_device_seconds"]}
             if device_profile and "error" not in device_profile else None),
+        # Elastic-fleet recovery (runtime/fleet.py, stage fleet_recovery):
+        # a real 3-worker chaos run with one injected death — how long the
+        # lease-expiry → re-issue chain takes to heal, plus the re-issue and
+        # benign-duplicate counts; full stage in the detail block.
+        "fleet_recovery": (
+            {"recovery_seconds": fleet_stage.get("recovery_seconds"),
+             "reissued_units": fleet_stage.get("reissued_units"),
+             "duplicate_commits": fleet_stage.get("duplicate_commits")}
+            if fleet_stage and "error" not in fleet_stage else None),
         # Serving SLO (serve subsystem): closed-loop loadgen over the
         # resident engine — pooled p50/p99 + goodput; per-scenario table in
         # the detail block "serve_latency".
@@ -1507,6 +1582,7 @@ def main() -> int:
         _atomic_json_dump(
             {"headline": headline, "sweep": sweep, "study": study,
              "obs_overhead": obs_ab, "serve_latency": serve_stage,
+             "fleet_recovery": fleet_stage,
              "device_profile": device_profile},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract

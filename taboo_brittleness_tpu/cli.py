@@ -616,6 +616,172 @@ def cmd_supervise(args) -> int:
     return res.exit_code
 
 
+def _fleet_unit_fn(args, spool_cfg):
+    """Build the worker's per-unit compute from the spool config.
+
+    ``synthetic`` mode is the hermetic tiny-model stack (chaos tests, the
+    selfcheck smoke, bench's ``fleet_recovery`` stage); ``checkpoint`` mode
+    loads each unit's word through the standard CheckpointManager path.
+    Either way a unit is one ``(word, readout_config)`` cell: decode the
+    word's probe prompt once and capture the residual at the readout layer
+    — the shape of the Gemma Scope depth-grid cell, where one decode pass
+    is shared per word and only the readout differs."""
+    import jax
+
+    from taboo_brittleness_tpu.runtime import decode
+
+    mode = spool_cfg.get("mode") or (
+        "synthetic" if args.synthetic else "checkpoint")
+    max_new = int(spool_cfg.get("max_new_tokens", args.max_new_tokens))
+
+    def _summarize(unit, cfg, result, texts, layer):
+        lengths = jax.device_get(result.lengths)
+        out = {
+            "word": unit.get("word"),
+            "readout_layer": layer,
+            "generated_tokens": int(lengths[0]),
+            "text": (texts or [""])[0],
+        }
+        if result.residual is not None:
+            out["residual_norm"] = round(
+                float(jax.numpy.linalg.norm(result.residual)), 6)
+        return out
+
+    if mode == "synthetic":
+        from taboo_brittleness_tpu.models import gemma2
+        from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+        cfg = gemma2.PRESETS[spool_cfg.get("preset", "gemma2_tiny")]
+        params = gemma2.init_params(
+            jax.random.PRNGKey(int(spool_cfg.get("seed", 7))), cfg)
+        words = list(spool_cfg.get("words", []))
+        tok = WordTokenizer(
+            words + ["Give", "me", "a", "hint", "about", "the", "word"],
+            vocab_size=cfg.vocab_size)
+
+        def unit_fn(unit):
+            layer = int((unit.get("readout") or {}).get("layer", 1))
+            layer = min(max(layer, 0), cfg.num_layers - 1)
+            result, texts, _ = decode.generate(
+                params, cfg, tok,
+                [f"Give me a hint about the {unit['word']}"],
+                max_new_tokens=max_new, capture_residual_layer=layer)
+            return _summarize(unit, cfg, result, texts, layer)
+
+        return unit_fn
+
+    config = _load(args)
+    loader = _loader(config, args)
+    prompts = list(config.prompts)[:1] or ["Give me a hint"]
+
+    def unit_fn(unit):
+        params, cfg, tok = loader(unit["word"])
+        layer = int((unit.get("readout") or {}).get(
+            "layer", config.model.layer_idx))
+        layer = min(max(layer, 0), cfg.num_layers - 1)
+        result, texts, _ = decode.generate(
+            params, cfg, tok, prompts,
+            max_new_tokens=max_new, capture_residual_layer=layer)
+        return _summarize(unit, cfg, result, texts, layer)
+
+    return unit_fn
+
+
+def cmd_worker(args) -> int:
+    """One fleet worker (``runtime.fleet``): claim ``(word, readout)`` units
+    from the coordinator's spool under a heartbeat-renewed lease, compute,
+    commit first-writer-wins.  Normally launched by ``tbx fleet`` under a
+    per-worker supervisor; runnable by hand against any spool directory."""
+    from taboo_brittleness_tpu.parallel import multihost
+    from taboo_brittleness_tpu.runtime import fleet, resilience
+
+    wid = args.worker_id or resilience.current_worker_id() or "w0"
+    # The worker id drives per-worker telemetry files and ledger/span
+    # stamps; set it before any tracer/ledger is constructed.
+    os.environ[resilience.WORKER_ENV] = wid
+    # Join THIS worker's slice-local process group (no-op for local fleets);
+    # fleet workers deliberately skip the global coordinator join in main().
+    multihost.worker_initialize()
+    spool = fleet.FleetSpool(
+        os.path.join(args.fleet_dir, fleet.SPOOL_DIRNAME)).ensure()
+    res = fleet.run_worker(
+        args.fleet_dir, wid,
+        unit_fn=_fleet_unit_fn(args, spool.read_config()),
+        lease_s=args.lease, poll_s=args.poll,
+        max_retries=args.max_retries)
+    # tbx: TBX009-ok — CLI stdout contract (worker summary JSON)
+    print(json.dumps({"worker_id": wid, "committed": res.committed,
+                      "duplicates": res.duplicates,
+                      "quarantined": res.quarantined,
+                      "drained": res.drained}))
+    return res.exit_code
+
+
+def cmd_fleet(args) -> int:
+    """Elastic fleet coordinator (``runtime.fleet``): decompose the sweep
+    into ``(word, readout_config)`` units in a durable spool, run N
+    supervised workers with lease-based work stealing, merge artifacts."""
+    from taboo_brittleness_tpu.runtime import fleet
+    from taboo_brittleness_tpu.runtime.manifest import RunManifest
+
+    if args.selfcheck:
+        return fleet.main_selfcheck()
+    if not args.output_dir:
+        raise SystemExit("fleet: --output-dir is required (or --selfcheck)")
+
+    config = _load(args)
+    words = list(args.words or config.words)
+    if args.readout_layers:
+        layers = [int(x) for x in args.readout_layers.split(",") if x.strip()]
+    else:
+        layers = [config.model.layer_idx]
+    units = [{"uid": fleet.unit_id(w, {"layer": la}), "word": w,
+              "readout": {"layer": la}} for w in words for la in layers]
+    out = args.output_dir
+    spool_cfg = {
+        "mode": "synthetic" if args.synthetic else "checkpoint",
+        "words": words,
+        "max_new_tokens": args.max_new_tokens,
+        "config": args.config,
+    }
+
+    def worker_argv(wid: str):
+        argv = [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                "--fleet-dir", out, "--worker-id", wid,
+                "-c", args.config,
+                "--max-new-tokens", str(args.max_new_tokens)]
+        if args.synthetic:
+            argv.append("--synthetic")
+        if args.checkpoint_root:
+            argv += ["--checkpoint-root", args.checkpoint_root]
+        return argv
+
+    manifest = RunManifest(command="fleet")
+    with manifest.stage("fleet", units=len(units), workers=args.workers):
+        res = fleet.run_fleet(
+            units, out,
+            n_workers=args.workers, worker_argv=worker_argv,
+            spool_config=spool_cfg,
+            lease_s=args.lease,
+            max_incarnations=args.max_incarnations,
+            grace=args.grace, wedge_after=args.wedge_after,
+            max_wall_s=args.max_wall)
+    manifest.extra["fleet"] = res.to_dict()
+    if not args.no_manifest:
+        path = manifest.save(os.path.join(out, "run_manifest.json"))
+        print(f"manifest -> {path}")  # tbx: TBX009-ok — CLI stdout contract (manifest path)
+    # tbx: TBX009-ok — CLI stdout contract (fleet summary JSON)
+    print(json.dumps({"status": res.status, "units": res.units_total,
+                      "committed": res.committed,
+                      "quarantined": res.quarantined,
+                      "reissued": res.reissued,
+                      "lease_expiries": res.lease_expiries,
+                      "duplicate_commits": res.duplicate_commits,
+                      "recovery_seconds": res.recovery_seconds,
+                      "workers": res.workers}))
+    return res.exit_code
+
+
 def cmd_chat(args) -> int:
     """Interactive greedy chat REPL over one word's checkpoint
     (``runtime.chat.run_chat``).  Honors ``TBX_SPECULATE=1`` — the
@@ -844,6 +1010,76 @@ def build_parser() -> argparse.ArgumentParser:
                          "supervised, after a literal --")
     sv.set_defaults(fn=cmd_supervise)
 
+    fl = sub.add_parser(
+        "fleet",
+        help="elastic multi-worker sweep: lease-based work stealing over a "
+             "durable spool, per-worker supervision, merged artifacts",
+        description="Decompose a sweep into (word, readout_config) work "
+                    "units in a durable filesystem spool and run N "
+                    "supervised workers that claim units under "
+                    "heartbeat-renewed leases (runtime/fleet.py). Worker "
+                    "death or wedge expires the lease and the unit is "
+                    "re-issued to a surviving worker; stragglers are "
+                    "speculatively re-dispatched with first-writer-wins "
+                    "commit. Per-worker events/ledgers/progress merge into "
+                    "one coherent run view at fleet end. SIGTERM drains "
+                    "the whole fleet at unit boundaries (exit 75); a "
+                    "relaunch resumes the spool.")
+    fl.add_argument("-c", "--config", default="configs/default.yaml")
+    fl.add_argument("--output-dir", default=None,
+                    help="fleet directory: spool/, per-worker telemetry, "
+                         "merged _events.jsonl/_failures.json/_fleet.json "
+                         "(required unless --selfcheck)")
+    fl.add_argument("--workers", type=int, default=3,
+                    help="worker subprocess count (one per slice on a pod)")
+    fl.add_argument("--words", nargs="*", default=None)
+    fl.add_argument("--readout-layers", default=None,
+                    help="comma-separated readout tap layers; each (word, "
+                         "layer) cell is one work unit (default: the "
+                         "config's layer_idx — one unit per word)")
+    fl.add_argument("--synthetic", action="store_true",
+                    help="tiny random model + word tokenizer (hermetic "
+                         "chaos/smoke path; no checkpoint IO)")
+    fl.add_argument("--checkpoint-root", default=None)
+    fl.add_argument("--max-new-tokens", type=int, default=8)
+    fl.add_argument("--lease", type=float, default=None,
+                    help="lease seconds before an unrenewed claim is "
+                         "re-issued (default: TBX_FLEET_LEASE_S or 10)")
+    fl.add_argument("--max-incarnations", type=int, default=None,
+                    help="per-worker supervisor restart budget")
+    fl.add_argument("--grace", type=float, default=None,
+                    help="per-worker SIGTERM->SIGKILL grace seconds")
+    fl.add_argument("--wedge-after", type=float, default=None,
+                    help="kill a worker whose pipeline emitted no event "
+                         "for this long while its heartbeat stays fresh")
+    fl.add_argument("--max-wall", type=float, default=None,
+                    help="hard fleet wall-clock bound (safety valve)")
+    fl.add_argument("--no-manifest", action="store_true")
+    fl.add_argument("--selfcheck", action="store_true",
+                    help="CPU-sized CI chaos smoke: tiny model, 3 workers, "
+                         "one killed mid-word, asserts exactly-once "
+                         "completion")
+    fl.set_defaults(fn=cmd_fleet)
+
+    wk = sub.add_parser(
+        "worker",
+        help="one fleet worker: claim spool units under lease, compute, "
+             "commit first-writer-wins (normally launched by `fleet`)")
+    wk.add_argument("-c", "--config", default="configs/default.yaml")
+    wk.add_argument("--fleet-dir", required=True,
+                    help="the coordinator's fleet directory (holds spool/)")
+    wk.add_argument("--worker-id", default=None,
+                    help="stable worker identity (default: TBX_WORKER_ID "
+                         "or w0)")
+    wk.add_argument("--synthetic", action="store_true")
+    wk.add_argument("--checkpoint-root", default=None)
+    wk.add_argument("--max-new-tokens", type=int, default=8)
+    wk.add_argument("--lease", type=float, default=None)
+    wk.add_argument("--poll", type=float, default=0.25,
+                    help="idle spool poll interval seconds")
+    wk.add_argument("--max-retries", type=int, default=2)
+    wk.set_defaults(fn=cmd_worker)
+
     ch = sub.add_parser(
         "chat",
         help="interactive greedy chat REPL over one word's checkpoint "
@@ -873,18 +1109,23 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    # Join the multi-process runtime BEFORE anything touches a jax backend
-    # (manifest env-info queries jax.devices before some subcommands build
-    # their mesh); no-op outside a cluster environment.
     from taboo_brittleness_tpu.parallel import multihost
     from taboo_brittleness_tpu.runtime import jax_cache
 
-    multihost.initialize()
+    # Parsing touches no jax API, so it can precede the runtime join — it
+    # must: a FLEET WORKER joins its own slice-local process group inside
+    # cmd_worker (multihost.worker_initialize), and joining the GLOBAL
+    # coordinator here would fold every worker into one process group.
+    args = build_parser().parse_args(argv)
+    if args.cmd != "worker":
+        # Join the multi-process runtime BEFORE anything touches a jax
+        # backend (manifest env-info queries jax.devices before some
+        # subcommands build their mesh); no-op outside a cluster env.
+        multihost.initialize()
     # Persistent compilation cache: the sweep's programs compile in minutes
     # and are shape-stable, so a rerun/resume should never pay them twice
     # (TBX_COMPILE_CACHE=0 opts out).
     jax_cache.enable()
-    args = build_parser().parse_args(argv)
     if getattr(args, "profile", False):
         # --profile is sugar for TBX_PROFILE=1: the sweep observer arms the
         # bounded device capture (obs/profile.py).

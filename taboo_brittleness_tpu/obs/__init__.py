@@ -63,6 +63,20 @@ def warn(message: str, *, name: str = "log.warn", **attrs: Any) -> None:
         pass
 
 
+def preempt_notice_seconds() -> float:
+    """The platform's preemption notice window (``TBX_PREEMPT_NOTICE_S``,
+    default 30 — the v5e notice).  Drain-at-word-boundary is only safe while
+    every word finishes inside this window; the sweep observer measures the
+    margin per word and warns when a word outlives it — the automated signal
+    that mid-word checkpointing must be promoted to a PR."""
+    import os
+
+    try:
+        return max(0.0, float(os.environ.get("TBX_PREEMPT_NOTICE_S", "30")))
+    except ValueError:
+        return 30.0
+
+
 class SweepObserver:
     """The per-sweep bundle of tracer + run span + progress heartbeat that
     :func:`sweep_observer` yields.  A disabled observer (``active=False``)
@@ -82,6 +96,11 @@ class SweepObserver:
         self._mem_sampler = mem_sampler
         self._device_capture = device_capture
         self._final_status: Optional[str] = None
+        self._preempt_notice = preempt_notice_seconds()
+        #: Worst-case slack between the longest computed word and the
+        #: preemption notice (negative = a word outlived the notice and
+        #: drain-at-word-boundary is no longer preemption-safe).
+        self.preempt_margin_s: Optional[float] = None
 
     @property
     def active(self) -> bool:
@@ -117,8 +136,9 @@ class SweepObserver:
                 self.reporter.word_skipped(word)
             else:
                 self.reporter.word_done(word)
-                metrics.histogram("word.seconds").observe(
-                    _span_duration(sp))
+                seconds = _span_duration(sp)
+                metrics.histogram("word.seconds").observe(seconds)
+                self._note_preempt_margin(word, seconds)
                 if self._device_capture is not None:
                     # A computed word just finished on the device profiler's
                     # clock; the bounded capture stops itself after K of them.
@@ -149,6 +169,29 @@ class SweepObserver:
             except Exception:  # noqa: BLE001 — fail-open
                 pass
 
+    def _note_preempt_margin(self, word: str, seconds: float) -> None:
+        """Per-word preemption-notice guard: track the worst margin between
+        word wall time and ``TBX_PREEMPT_NOTICE_S`` as a gauge (and manifest
+        field), and warn when a word OUTLIVES the notice — from then on a
+        preemption lands mid-word and drain-at-word-boundary tears."""
+        if not self._preempt_notice:
+            return
+        margin = round(self._preempt_notice - seconds, 3)
+        if self.preempt_margin_s is None or margin < self.preempt_margin_s:
+            self.preempt_margin_s = margin
+            try:
+                metrics.gauge("sweep.preempt_margin_s").set(margin)
+            except Exception:  # noqa: BLE001 — fail-open
+                pass
+        if margin < 0:
+            warn(f"[obs] word {word!r} ran {seconds:.1f}s — past the "
+                 f"{self._preempt_notice:.0f}s preemption notice "
+                 "(TBX_PREEMPT_NOTICE_S): a preemption now lands MID-word; "
+                 "promote mid-word checkpointing",
+                 name="sweep.preempt_notice_exceeded", word=word,
+                 wall_seconds=round(seconds, 3),
+                 notice_seconds=self._preempt_notice)
+
     def mark_drained(self) -> None:
         """The sweep is stopping BETWEEN words for a preemption drain
         (``runtime.supervise``): the progress file's final status becomes
@@ -177,6 +220,8 @@ class SweepObserver:
         if self._mem_sampler is not None:
             self._mem_sampler.stop()
         if self.run_span is not None:
+            if self.preempt_margin_s is not None:
+                self.run_span.set(preempt_margin_s=self.preempt_margin_s)
             self.run_span.end(error=error)
         if self.reporter is not None:
             status = self._final_status or (
@@ -221,24 +266,34 @@ def sweep_observer(output_dir: Optional[str], *, pipeline: str,
         yield SweepObserver()
         return
     try:
+        from taboo_brittleness_tpu.runtime.resilience import (
+            current_incarnation, current_worker_id)
+
+        # Fleet workers (runtime.fleet) write per-worker telemetry files so
+        # N workers can share one output directory: each stream keeps its
+        # own strictly-monotone seq, and the fleet merge folds them later.
+        wid = current_worker_id()
+        events_name = (EVENTS_FILENAME if wid is None
+                       else f"_events.{wid}.jsonl")
+        progress_name = (PROGRESS_FILENAME if wid is None
+                         else f"_progress.{wid}.json")
         outer = get_tracer()
         owns = outer is None
         if owns:
             os.makedirs(output_dir, exist_ok=True)
             tracer = activate(
-                os.path.join(output_dir, EVENTS_FILENAME),
+                os.path.join(output_dir, events_name),
                 run_id=run_id or uuid.uuid4().hex[:12])
         else:
             tracer = outer
-        from taboo_brittleness_tpu.runtime.resilience import (
-            current_incarnation)
 
         inc = current_incarnation()
         run_span = tracer.span(
             "sweep", kind="run", pipeline=pipeline, words_total=len(words),
-            **({"incarnation": inc} if inc else {}))
+            **({"incarnation": inc} if inc else {}),
+            **({"worker": wid} if wid else {}))
         reporter = ProgressReporter(
-            os.path.join(output_dir, PROGRESS_FILENAME),
+            os.path.join(output_dir, progress_name),
             total_words=len(words), run_id=tracer.run_id,
             tracer=tracer).start()
         sampler = memory.MemorySampler(tracer).start()

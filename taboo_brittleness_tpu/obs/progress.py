@@ -29,7 +29,7 @@ import time
 from typing import Any, Dict, Optional
 
 from taboo_brittleness_tpu.runtime.resilience import (
-    atomic_json_dump, current_incarnation)
+    atomic_json_dump, current_incarnation, current_worker_id)
 
 PROGRESS_FILENAME = "_progress.json"
 
@@ -195,6 +195,10 @@ class ProgressReporter:
             # this + pid so a predecessor's stale file never reads as the
             # fresh child being wedged.
             "incarnation": current_incarnation(),
+            # Fleet worker identity (runtime.fleet; None standalone) — the
+            # per-worker supervisor watches _progress.<worker_id>.json.
+            **({"worker": current_worker_id()}
+               if current_worker_id() else {}),
             # Epoch timestamp: the reader computes staleness as now - this.
             # tbx: wallclock-ok — heartbeat freshness mark, not duration math
             "updated_at": time.time(),

@@ -205,6 +205,16 @@ class Tracer:
         self.path = path
         self.run_id = run_id
         self.mem_kinds = _mem_sample_kinds()
+        # Fleet worker identity (runtime.fleet): stamped top-level on every
+        # event this process emits, so per-worker streams stay
+        # self-identifying after the fleet merge folds them into one file.
+        try:
+            from taboo_brittleness_tpu.runtime.resilience import (
+                current_worker_id)
+
+            self._worker = current_worker_id()
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            self._worker = None
         self._fd: Optional[int] = None
         self._lock = threading.Lock()
         self._seq = 0
@@ -246,6 +256,8 @@ class Tracer:
             self._seq += 1
             rec = {"v": SCHEMA_VERSION, "seq": self._seq,
                    "t": round(now - self._t0, 6), **rec}
+            if self._worker is not None:
+                rec.setdefault("worker", self._worker)
             self._last_event_mono = now
             if self._fd is None:
                 return

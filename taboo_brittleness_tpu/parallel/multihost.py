@@ -70,6 +70,43 @@ def initialize(
     return True
 
 
+def worker_initialize() -> bool:
+    """Join a fleet worker to ITS slice's JAX process group
+    (``runtime.fleet``; ``tbx worker``).  Returns True when it joined one.
+
+    A fleet shards a sweep by WORK UNITS, not by array axes: each worker is
+    an independent JAX runtime over one slice (tp/sp within the slice over
+    ICI, as ``make_host_mesh`` lays out), and cross-worker coordination is
+    the filesystem spool — no DCN collectives between workers, so a dead
+    slice costs re-issued units, never a hung all-reduce.  The global
+    coordinator env (``COORDINATOR_ADDRESS`` & co., read by
+    :func:`initialize`) would join every worker into ONE process group —
+    exactly wrong here — so fleet workers read their own namespace instead,
+    set per worker by the pod launch script (or ``run_fleet``'s
+    ``worker_env``):
+
+    - ``TBX_FLEET_COORDINATOR`` — this worker's slice-local coordinator
+      address (process 0 of the slice);
+    - ``TBX_FLEET_NUM_PROCESSES`` / ``TBX_FLEET_PROCESS_ID`` — this
+      process's coordinates within its slice.
+
+    Unset (the local-fleet case: N worker processes on one host) this is a
+    no-op and the worker runs single-process, exactly like any other local
+    pipeline invocation.
+    """
+    addr = os.environ.get("TBX_FLEET_COORDINATOR")
+    if not addr:
+        return False
+    num = os.environ.get("TBX_FLEET_NUM_PROCESSES")
+    pid = os.environ.get("TBX_FLEET_PROCESS_ID")
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(num) if num else None,
+        process_id=int(pid) if pid else None,
+    )
+    return True
+
+
 def make_host_mesh(
     mesh_cfg: Optional[MeshConfig] = None,
     *,

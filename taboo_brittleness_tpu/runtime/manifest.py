@@ -125,6 +125,24 @@ class RunManifest:
             pass
         return block
 
+    def _preempt_block(self) -> Dict[str, Any]:
+        """Preemption-notice guard (ISSUE 10 satellite): hoist the sweep
+        observer's ``sweep.preempt_margin_s`` gauge — the worst slack
+        between any computed word's wall time and ``TBX_PREEMPT_NOTICE_S``
+        — to a first-class manifest field.  Negative margin = a word
+        outlived the notice and drain-at-word-boundary is no longer
+        preemption-safe.  Empty (omitted) when no word was measured."""
+        try:
+            from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+            snap = obs_metrics.snapshot()
+            gauge = (snap.get("gauges") or {}).get("sweep.preempt_margin_s")
+            if gauge is None:
+                return {}
+            return {"preempt_margin_s": gauge}
+        except Exception:  # noqa: BLE001 — manifest must never fail a run
+            return {}
+
     def _incarnation_block(self) -> Dict[str, Any]:
         """Supervised-run stamp (``runtime.supervise``): which incarnation
         of a supervised run wrote this manifest, and whether it exited on a
@@ -154,6 +172,7 @@ class RunManifest:
             "stages": self.stages,
             "artifacts": self.artifacts,
             "obs": self._obs_block(),
+            **self._preempt_block(),
             **self._incarnation_block(),
             **({"failures": self.failures} if self.failures else {}),
             **({"retries": self.retries} if self.retries else {}),

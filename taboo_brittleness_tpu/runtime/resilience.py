@@ -66,6 +66,19 @@ def current_incarnation() -> int:
         return 0
 
 
+#: Set by the fleet coordinator (``runtime.fleet``) on every worker it
+#: launches: this process's stable worker identity within the fleet.  Drives
+#: the per-worker telemetry file suffixes (``_events.<wid>.jsonl``,
+#: ``_progress.<wid>.json``), the ledger/span ``worker`` stamps, and the
+#: fault-plan ``match`` context.
+WORKER_ENV = "TBX_WORKER_ID"
+
+
+def current_worker_id() -> Optional[str]:
+    """This process's fleet worker id, or None outside a fleet worker."""
+    return os.environ.get(WORKER_ENV) or None
+
+
 # ---------------------------------------------------------------------------
 # Error taxonomy.
 # ---------------------------------------------------------------------------
@@ -361,19 +374,34 @@ class FailureLedger:
     run — each retry and quarantine attributed to the process that saw it.
     A fresh unsupervised rerun (incarnation 0) still resets ``retried``
     (per-run noise, the pre-supervision contract).
+
+    Workers (``runtime.fleet``): schema v3 additionally stamps every entry
+    with the ``worker`` that recorded it (:func:`current_worker_id` unless
+    overridden) — the fleet merge needs BOTH dimensions (which worker, which
+    incarnation of it) to attribute a failure.  Outside a fleet worker no
+    ``worker`` key is emitted, so standalone ledgers read exactly as before;
+    v2 ledgers (no worker stamps) load unchanged, and a resume normalizes
+    their entries with the prior file's top-level ``worker`` when it has one.
     """
 
     def __init__(self, output_dir: Optional[str] = None, *,
                  path: Optional[str] = None,
-                 incarnation: Optional[int] = None):
+                 incarnation: Optional[int] = None,
+                 worker: Optional[str] = None):
         self.path = path or (os.path.join(output_dir, LEDGER_FILENAME)
                              if output_dir else None)
         self.incarnation = (current_incarnation() if incarnation is None
                             else int(incarnation))
+        self.worker = current_worker_id() if worker is None else worker
         self.quarantined: Dict[str, Dict[str, Any]] = {}
         self.retried: Dict[str, Dict[str, Any]] = {}
         if self.path and os.path.exists(self.path):
             self._load_existing(self.path)
+
+    def _stamp(self, entry: Dict[str, Any]) -> Dict[str, Any]:
+        if self.worker:
+            entry["worker"] = self.worker
+        return entry
 
     def _load_existing(self, path: str) -> None:
         try:
@@ -390,20 +418,27 @@ class FailureLedger:
         if self.incarnation > 0:
             # Supervised resume: keep prior incarnations' retry entries so
             # the merged ledger attributes every event (v1 int entries are
-            # normalized to the writing run's incarnation).
+            # normalized to the writing run's incarnation; v2 entries gain
+            # the prior file's worker stamp, when it had one — the v2→v3
+            # normalization).
             prior_inc = int(prior.get("incarnation", 0) or 0)
-            self.retried = {
-                w: (dict(v) if isinstance(v, dict)
-                    else {"attempts": int(v), "incarnation": prior_inc})
-                for w, v in dict(prior.get("retried", {})).items()}
+            prior_worker = prior.get("worker")
+            normalized: Dict[str, Dict[str, Any]] = {}
+            for w, v in dict(prior.get("retried", {})).items():
+                entry = (dict(v) if isinstance(v, dict)
+                         else {"attempts": int(v), "incarnation": prior_inc})
+                if prior_worker and "worker" not in entry:
+                    entry["worker"] = prior_worker
+                normalized[w] = entry
+            self.retried = normalized
         else:
             # `retried` is per-run noise on an unsupervised rerun: reset.
             self.retried = {}
 
     def record_retry(self, word: str, stage: str, exc: BaseException,
                      attempt: int) -> None:
-        self.retried[word] = {"attempts": attempt,
-                              "incarnation": self.incarnation}
+        self.retried[word] = self._stamp({"attempts": attempt,
+                                          "incarnation": self.incarnation})
         self.save()
 
     def record_quarantine(self, word: str, stage: str, exc: BaseException,
@@ -424,7 +459,7 @@ class FailureLedger:
         seq = _obs_last_seq()
         if seq is not None:
             entry["event_seq"] = seq
-        self.quarantined[word] = entry
+        self.quarantined[word] = self._stamp(entry)
         self.save()
 
     def record_success(self, word: str) -> None:
@@ -443,8 +478,9 @@ class FailureLedger:
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 2,
+            "version": 3,
             "incarnation": self.incarnation,
+            **({"worker": self.worker} if self.worker else {}),
             "quarantined": self.quarantined,
             "retried": self.retried,
         }
@@ -477,6 +513,18 @@ FAULT_SITES = (
     #                       index + rows) so a plan can poison one block of
     #                       a speculative decode; the word-level run_guarded
     #                       retry→quarantine path owns the failure
+    "fleet.claim",        # runtime.fleet.FleetSpool.claim — fired per claim
+    #                       attempt (context: uid + worker + holder); the
+    #                       worker loop retries a failed claim on its next
+    #                       poll
+    "fleet.lease_renew",  # runtime.fleet.LeaseKeeper — fired per renewal;
+    #                       a fault lets the lease expire (re-issue, then
+    #                       benign duplicate commit), `die` here is the
+    #                       mid-renewal SIGKILL harness
+    "fleet.commit",       # runtime.fleet.run_worker — fired just before the
+    #                       first-writer-wins commit; `die` here is the
+    #                       "worker killed mid-word, artifact never lands"
+    #                       chaos case
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

@@ -80,7 +80,8 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from taboo_brittleness_tpu.runtime.resilience import (
-    INCARNATION_ENV, RetryPolicy, atomic_json_dump, current_incarnation)
+    INCARNATION_ENV, WORKER_ENV, RetryPolicy, atomic_json_dump,
+    current_incarnation)
 
 __all__ = [
     "EXIT_DRAINED", "EXIT_QUARANTINED", "SUPERVISE_FILENAME",
@@ -279,8 +280,10 @@ def _wedge_reason(progress: Dict[str, Any], pid: int,
 
 
 def _emit_events(output_dir: str,
-                 events: Sequence[Tuple[str, Dict[str, Any]]]) -> None:
-    """Append supervisor point events to the sweep's ``_events.jsonl``.
+                 events: Sequence[Tuple[str, Dict[str, Any]]],
+                 filename: Optional[str] = None) -> None:
+    """Append supervisor point events to the sweep's ``_events.jsonl`` (or a
+    fleet worker's ``_events.<wid>.jsonl``).
 
     Called only while no child is running, so the tracer's tail-resumed
     ``seq`` keeps the merged stream monotone (``obs.trace``).  Fail-open:
@@ -288,7 +291,8 @@ def _emit_events(output_dir: str,
     try:
         from taboo_brittleness_tpu.obs import trace
 
-        t = trace.Tracer(os.path.join(output_dir, trace.EVENTS_FILENAME))
+        t = trace.Tracer(os.path.join(output_dir,
+                                      filename or trace.EVENTS_FILENAME))
         try:
             for name, attrs in events:
                 t.event(name, **attrs)
@@ -298,16 +302,22 @@ def _emit_events(output_dir: str,
         pass
 
 
-def _merge_run_artifacts(output_dir: str, result: SuperviseResult) -> None:
+def _merge_run_artifacts(output_dir: str, result: SuperviseResult,
+                         *, filename: str = SUPERVISE_FILENAME,
+                         fold_manifest: bool = True) -> None:
     """Make the directory read as ONE run: persist the incarnation history
     to ``_supervise.json`` and fold it into the child's ``run_manifest.json``
     (which lives either in ``output_dir`` or one level up — the pipelines
-    write per-word artifacts into a ``words/`` subdirectory)."""
+    write per-word artifacts into a ``words/`` subdirectory).  Fleet workers
+    (``fold_manifest=False``) skip the manifest fold: N workers share one
+    directory, and the fleet merge owns the combined view."""
     try:
         atomic_json_dump(result.to_dict(),
-                         os.path.join(output_dir, SUPERVISE_FILENAME))
+                         os.path.join(output_dir, filename))
     except OSError:
         pass
+    if not fold_manifest:
+        return
     for cand in (output_dir, os.path.dirname(os.path.abspath(output_dir))):
         path = os.path.join(cand, "run_manifest.json")
         if not os.path.isfile(path):
@@ -342,6 +352,7 @@ def supervise(
     wedge_after: Optional[float] = None,
     policy: Optional[RetryPolicy] = None,
     env: Optional[Dict[str, str]] = None,
+    worker_id: Optional[str] = None,
     sleep=time.sleep,
 ) -> SuperviseResult:
     """Run ``child_argv`` under the supervisor until it finishes, drains,
@@ -353,6 +364,15 @@ def supervise(
     into (for the packaged pipelines: the per-word results directory).  The
     supervisor only ever READS the child's files, except for the merged
     ``_supervise.json``/manifest block it writes after the run.
+
+    ``worker_id`` puts the supervisor in FLEET-WORKER mode
+    (``runtime.fleet``): the child gets ``TBX_WORKER_ID`` in its env, its
+    telemetry lands in per-worker files (``_progress.<wid>.json``,
+    ``_events.<wid>.jsonl``, ``_supervise.<wid>.json``) so N supervised
+    workers can share one output directory without interleaving each
+    other's seq counters, and the run-manifest fold is left to the fleet
+    merge.  The wedge classifier, restart budget, and drain contract are
+    identical — the fleet reuses, not reimplements, this state machine.
     """
     max_incarnations = (max_incarnations if max_incarnations is not None
                         else _env_int("TBX_SUPERVISE_MAX_INCARNATIONS", 5))
@@ -373,19 +393,30 @@ def supervise(
         PROGRESS_FILENAME, read_progress)
 
     os.makedirs(output_dir, exist_ok=True)
-    progress_path = os.path.join(output_dir, PROGRESS_FILENAME)
-    backoff = policy.delays("supervise")
+    progress_name = (PROGRESS_FILENAME if worker_id is None
+                     else f"_progress.{worker_id}.json")
+    events_name = (None if worker_id is None
+                   else f"_events.{worker_id}.jsonl")
+    supervise_name = (SUPERVISE_FILENAME if worker_id is None
+                      else f"_supervise.{worker_id}.json")
+    progress_path = os.path.join(output_dir, progress_name)
+    backoff = policy.delays(f"supervise:{worker_id or ''}")
     history: List[Dict[str, Any]] = []
     final_rc: Optional[int] = None
     status = "budget-exhausted"
 
     for incarnation in range(max_incarnations):
-        _emit_events(output_dir, [("supervise.launch",
-                                   {"incarnation": incarnation})])
+        _emit_events(output_dir,
+                     [("supervise.launch",
+                       {"incarnation": incarnation,
+                        **({"worker": worker_id} if worker_id else {})})],
+                     events_name)
         child_env = dict(os.environ)
         if env:
             child_env.update(env)
         child_env[INCARNATION_ENV] = str(incarnation)
+        if worker_id is not None:
+            child_env[WORKER_ENV] = worker_id
         t0 = time.monotonic()
         proc = subprocess.Popen(list(child_argv), env=child_env)
         rec: Dict[str, Any] = {
@@ -441,7 +472,7 @@ def supervise(
             status = "done" if rc == 0 else "drained"
             _emit_events(output_dir, [("supervise.drain",
                                        {"incarnation": incarnation,
-                                        "exit_code": rc})])
+                                        "exit_code": rc})], events_name)
             break
         if wedge is not None:
             rec["outcome"] = "wedged"
@@ -449,7 +480,8 @@ def supervise(
             history.append(rec)
             _emit_events(output_dir, [("supervise.wedged",
                                        {"incarnation": incarnation,
-                                        "reason": wedge, "exit_code": rc})])
+                                        "reason": wedge, "exit_code": rc})],
+                         events_name)
         elif rc == 0:
             rec["outcome"] = "done"
             history.append(rec)
@@ -476,7 +508,7 @@ def supervise(
             _emit_events(output_dir, [("supervise.crash",
                                        {"incarnation": incarnation,
                                         "reason": "serve-exit-1",
-                                        "exit_code": rc})])
+                                        "exit_code": rc})], events_name)
         elif rc == EXIT_QUARANTINED:
             rec["outcome"] = "quarantined"
             history.append(rec)
@@ -506,9 +538,13 @@ def supervise(
             status = "drained"
     result = SuperviseResult(exit_code=int(final_rc), status=status,
                              incarnations=history)
-    _emit_events(output_dir, [("supervise.exit",
-                               {"status": result.status,
-                                "exit_code": result.exit_code,
-                                "incarnations": len(history)})])
-    _merge_run_artifacts(output_dir, result)
+    _emit_events(output_dir,
+                 [("supervise.exit",
+                   {"status": result.status,
+                    "exit_code": result.exit_code,
+                    "incarnations": len(history),
+                    **({"worker": worker_id} if worker_id else {})})],
+                 events_name)
+    _merge_run_artifacts(output_dir, result, filename=supervise_name,
+                         fold_manifest=worker_id is None)
     return result

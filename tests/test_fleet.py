@@ -1,0 +1,671 @@
+"""Elastic fleet execution (runtime/fleet.py, ISSUE 10).
+
+Four layers:
+
+- spool/lease/commit unit tests (claim-by-rename, exclusion lists,
+  first-writer-wins, lease renewal) — stdlib-fast;
+- satellite tests: FailureLedger v3 worker stamps + v2→v3 normalization,
+  per-worker telemetry file suffixes, the preemption-notice guard, the
+  supervisor's fleet-worker mode, trace_report fleet invariants, and the
+  bench_compare ``fleet_recovery`` gate;
+- fast fleet integrations over stdlib-only FAKE workers (real subprocesses,
+  real supervision and leases, trivial unit compute): drain → resume, and
+  straggler speculation with a benign duplicate commit;
+- the ISSUE 10 acceptance chaos e2e on the real tiny-model synthetic
+  workers: 3 subprocess workers over 12 words, one worker ``die``d mid-word
+  and one wedged — every word completes exactly once, zero ``.corrupt``
+  files, the merged ``_events.jsonl`` is green under ``trace_report
+  --check``, and the killed worker's unit shows a lease-expiry → re-issue
+  chain in the merged ledger.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.runtime import fleet, resilience, supervise
+from taboo_brittleness_tpu.runtime.fleet import (
+    FleetSpool, LeaseKeeper, holder_token, unit_id)
+from taboo_brittleness_tpu.runtime.resilience import (
+    FailureLedger, RetryPolicy)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "obs", "fleet")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+FAST = RetryPolicy(max_retries=6, base_delay=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+    monkeypatch.delenv("TBX_WORKER_ID", raising=False)
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+
+
+def _spool(tmp_path) -> FleetSpool:
+    return FleetSpool(str(tmp_path / "spool")).ensure()
+
+
+# ---------------------------------------------------------------------------
+# Spool: claim-by-rename, exclusion, first-writer-wins, leases.
+# ---------------------------------------------------------------------------
+
+def test_unit_id_is_filesystem_safe():
+    assert unit_id("ship", {"layer": 31}) == "ship@L31"
+    assert "/" not in unit_id("a/b c", {"key": "16k/L9"})
+    assert unit_id("ship", {}) == "ship@r0"
+
+
+def test_claim_respects_exclusion_and_order(tmp_path):
+    sp = _spool(tmp_path)
+    sp.put("u0", {"word": "a"}, attempt=1, excluded=["w1-i0"])
+    sp.put("u1", {"word": "b"})
+    rec = sp.claim("w1-i0", "w1")
+    assert rec["uid"] == "u1"              # u0 excludes this holder
+    rec2 = sp.claim("w2-i0", "w2")
+    assert rec2["uid"] == "u0"             # a different holder may take it
+    assert rec2["attempt"] == 1
+    assert sp.claim("w2-i0", "w2") is None
+    # Claimed markers carry (uid, attempt, holder) for postmortems.
+    holders = {c["holder"] for c in sp.claimed_entries()}
+    assert holders == {"w1-i0", "w2-i0"}
+
+
+def test_claim_garbage_collects_resolved_units(tmp_path):
+    sp = _spool(tmp_path)
+    sp.put("u0", {"word": "a"})
+    assert sp.commit("u0", {"result": 1}, holder="w0-i0")
+    # A stale speculative re-issue of the already-committed unit:
+    sp.put("u0", {"word": "a"}, attempt=1)
+    assert sp.claim("w1-i0", "w1") is None  # skipped AND removed
+    assert sp.pending() == []
+
+
+def test_claim_fault_site_fires(tmp_path):
+    sp = _spool(tmp_path)
+    sp.put("u0", {"word": "a"})
+    inj = resilience.FaultInjector()
+    inj.arm("fleet.claim", mode="fail", times=1)
+    resilience.set_injector(inj)
+    with pytest.raises(resilience.InjectedFault):
+        sp.claim("w0-i0", "w0")
+    assert sp.claim("w0-i0", "w0")["uid"] == "u0"   # next attempt succeeds
+
+
+def test_commit_first_writer_wins(tmp_path):
+    sp = _spool(tmp_path)
+    assert sp.commit("u0", {"result": "first"}, holder="w0-i0") is True
+    assert sp.commit("u0", {"result": "second"}, holder="w1-i0") is False
+    with open(sp.done_path("u0")) as f:
+        assert json.load(f)["result"] == "first"
+    assert sp.duplicate_count() == 1
+    assert sp.done_uids() == ["u0"]
+
+
+def test_lease_keeper_renews_and_preserves_claim_time(tmp_path):
+    sp = _spool(tmp_path)
+    keeper = LeaseKeeper(sp, "u0", 0, "w0-i0", "w0", lease_s=0.3).start()
+    try:
+        first = sp.leases()[0]
+        time.sleep(0.35)
+        renewed = sp.leases()[0]
+    finally:
+        keeper.stop()
+    assert renewed["renewed_at"] > first["renewed_at"]
+    assert renewed["claimed_at"] == first["claimed_at"]
+    assert renewed["expires_at"] > first["expires_at"]
+    assert sp.leases() == []               # stop() releases the lease
+
+
+def test_lease_renew_fault_lets_lease_expire(tmp_path):
+    sp = _spool(tmp_path)
+    inj = resilience.FaultInjector()
+    inj.arm("fleet.lease_renew", mode="fail", times=None)
+    resilience.set_injector(inj)
+    keeper = LeaseKeeper(sp, "u0", 0, "w0-i0", "w0", lease_s=0.3).start()
+    try:
+        first = sp.leases()[0]
+        time.sleep(0.45)
+        stale = sp.leases()[0]
+    finally:
+        keeper.stop()
+    # Every renewal faulted: expires_at never advanced past the claim-time
+    # lease — the coordinator will expire and re-issue, which is benign.
+    assert stale["expires_at"] == first["expires_at"]
+
+
+def test_percentile():
+    assert fleet._percentile([], 75) == 0.0
+    assert fleet._percentile([1.0], 75) == 1.0
+    assert fleet._percentile([1, 2, 3, 4], 75) == 3
+
+
+# ---------------------------------------------------------------------------
+# Satellite: FailureLedger v3 — worker stamps + v2→v3 normalization.
+# ---------------------------------------------------------------------------
+
+def test_ledger_v3_stamps_worker(tmp_path):
+    path = str(tmp_path / "_failures.json")
+    led = FailureLedger(path=path, worker="w7")
+    led.record_retry("ship", "decode", OSError("x"), 1)
+    led.record_quarantine("moon", "decode", OSError("y"), 3)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["version"] == 3
+    assert data["worker"] == "w7"
+    assert data["retried"]["ship"]["worker"] == "w7"
+    assert data["quarantined"]["moon"]["worker"] == "w7"
+
+
+def test_ledger_without_worker_emits_no_worker_keys(tmp_path):
+    """Standalone (non-fleet) ledgers read exactly as v2 did, modulo the
+    version bump — no worker noise."""
+    path = str(tmp_path / "_failures.json")
+    led = FailureLedger(path=path)
+    led.record_retry("ship", "decode", OSError("x"), 1)
+    with open(path) as f:
+        data = json.load(f)
+    assert "worker" not in data
+    assert data["retried"]["ship"] == {"attempts": 1, "incarnation": 0}
+
+
+def test_ledger_v2_to_v3_normalization(tmp_path, monkeypatch):
+    """A v2 ledger (no worker stamps) loaded by a resume incarnation keeps
+    its entries unforged; a prior file that DID carry a top-level worker
+    propagates it onto its unstamped entries."""
+    path = str(tmp_path / "_failures.json")
+    with open(path, "w") as f:
+        json.dump({"version": 2, "incarnation": 0,
+                   "retried": {"ship": {"attempts": 2, "incarnation": 0}},
+                   "quarantined": {}}, f)
+    led = FailureLedger(path=path, incarnation=1, worker="w1")
+    assert led.retried == {"ship": {"attempts": 2, "incarnation": 0}}
+    led.record_retry("moon", "decode", OSError("x"), 1)
+    assert led.retried["moon"]["worker"] == "w1"
+
+    with open(path, "w") as f:
+        json.dump({"version": 3, "incarnation": 0, "worker": "w0",
+                   "retried": {"ship": {"attempts": 2, "incarnation": 0}},
+                   "quarantined": {}}, f)
+    led2 = FailureLedger(path=path, incarnation=1, worker="w1")
+    assert led2.retried["ship"]["worker"] == "w0"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-worker telemetry files + worker stamps + progress.
+# ---------------------------------------------------------------------------
+
+def test_sweep_observer_uses_worker_suffixed_files(tmp_path, monkeypatch):
+    from taboo_brittleness_tpu import obs
+
+    monkeypatch.setenv("TBX_WORKER_ID", "alpha")
+    out = str(tmp_path)
+    with obs.sweep_observer(out, pipeline="fleet-worker",
+                            words=["u0"]) as ob:
+        with ob.word("u0"):
+            pass
+    assert os.path.exists(os.path.join(out, "_events.alpha.jsonl"))
+    assert os.path.exists(os.path.join(out, "_progress.alpha.json"))
+    assert not os.path.exists(os.path.join(out, "_events.jsonl"))
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "_events.alpha.jsonl"))]
+    # Every event is stamped top-level with the worker; the run span also
+    # carries it as an attr (the per-worker lane key).
+    assert all(e.get("worker") == "alpha" for e in events)
+    run_starts = [e for e in events
+                  if e.get("ev") == "start" and e.get("kind") == "run"]
+    assert run_starts[0]["attrs"]["worker"] == "alpha"
+    with open(os.path.join(out, "_progress.alpha.json")) as f:
+        assert json.load(f)["worker"] == "alpha"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the preemption-notice guard.
+# ---------------------------------------------------------------------------
+
+def test_preempt_notice_guard_gauge_warn_and_manifest(tmp_path, monkeypatch):
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+    from taboo_brittleness_tpu.runtime.manifest import RunManifest
+
+    obs_metrics.reset()
+    monkeypatch.setenv("TBX_PREEMPT_NOTICE_S", "0.05")
+    out = str(tmp_path)
+    with obs.sweep_observer(out, pipeline="test", words=["slow"]) as ob:
+        with ob.word("slow"):
+            time.sleep(0.12)               # outlives the 0.05s notice
+    assert ob.preempt_margin_s is not None and ob.preempt_margin_s < 0
+    snap = obs_metrics.snapshot()
+    assert snap["gauges"]["sweep.preempt_margin_s"] == ob.preempt_margin_s
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "_events.jsonl"))]
+    warns = [e for e in events
+             if e.get("name") == "sweep.preempt_notice_exceeded"]
+    assert warns and warns[0]["attrs"]["word"] == "slow"
+    # The manifest hoists the gauge to a first-class field.
+    manifest = RunManifest(command="test")
+    assert manifest.to_dict()["preempt_margin_s"] == ob.preempt_margin_s
+    obs_metrics.reset()
+
+
+def test_preempt_margin_positive_within_notice(tmp_path, monkeypatch):
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset()
+    monkeypatch.setenv("TBX_PREEMPT_NOTICE_S", "30")
+    with obs.sweep_observer(str(tmp_path), pipeline="test",
+                            words=["fast"]) as ob:
+        with ob.word("fast"):
+            pass
+    assert ob.preempt_margin_s is not None and ob.preempt_margin_s > 0
+    events = [json.loads(line) for line in
+              open(os.path.join(str(tmp_path), "_events.jsonl"))]
+    assert not any(e.get("name") == "sweep.preempt_notice_exceeded"
+                   for e in events)
+    obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: supervise's fleet-worker mode (per-worker filenames).
+# ---------------------------------------------------------------------------
+
+_WORKER_FAKE_CHILD = r"""
+import json, os, sys, time
+
+out = sys.argv[1]
+wid = os.environ["TBX_WORKER_ID"]
+tmp = os.path.join(out, "tmp")
+with open(tmp, "w") as f:
+    json.dump({"v": 1, "pid": os.getpid(), "updated_at": time.time(),
+               "heartbeat_seconds": 0.05, "status": "done",
+               "worker": wid,
+               "incarnation": int(os.environ.get("TBX_INCARNATION", "0"))},
+              f)
+os.replace(tmp, os.path.join(out, f"_progress.{wid}.json"))
+sys.exit(0)
+"""
+
+
+def test_supervise_worker_mode_uses_per_worker_files(tmp_path):
+    out = str(tmp_path / "out")
+    os.makedirs(out)
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_WORKER_FAKE_CHILD)
+    res = supervise.supervise(
+        [sys.executable, child, out], out, worker_id="wk",
+        max_incarnations=2, poll_interval=0.02, grace=0.5,
+        wedge_after=1.0, policy=FAST)
+    assert res.ok
+    assert os.path.exists(os.path.join(out, "_supervise.wk.json"))
+    assert not os.path.exists(os.path.join(out, "_supervise.json"))
+    assert os.path.exists(os.path.join(out, "_progress.wk.json"))
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "_events.wk.jsonl"))]
+    launches = [e for e in events if e.get("name") == "supervise.launch"]
+    assert launches and launches[0]["attrs"]["worker"] == "wk"
+    assert not os.path.exists(os.path.join(out, "_events.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# trace_report: fleet invariants + per-worker lane rendering.
+# ---------------------------------------------------------------------------
+
+def test_committed_fleet_fixture_is_green():
+    path = os.path.join(FIXTURE_DIR, "_events.jsonl")
+    events = list(trace_report.iter_events(path))
+    assert trace_report.check(path) == []
+    assert trace_report.check_fleet(path, events) == []
+
+
+def test_fleet_fixture_renders_worker_lanes():
+    path = os.path.join(FIXTURE_DIR, "_events.jsonl")
+    out = trace_report.report(list(trace_report.iter_events(path)))
+    assert "fleet:" in out
+    assert "w1" in out and "dropped_leases" in out
+    assert "lease expired" in out and "re-issued" in out
+
+
+def _fleet_stream(tmp_path, points, name="_events.jsonl"):
+    """A minimal valid fleet event stream wrapping ``points``."""
+    path = str(tmp_path / name)
+    seq = 0
+    lines = []
+
+    def add(rec):
+        nonlocal seq
+        seq += 1
+        lines.append(json.dumps({"v": 1, "seq": seq, "t": float(seq),
+                                 **rec}))
+
+    add({"ev": "start", "kind": "run", "name": "sweep", "id": 1,
+         "attrs": {"pipeline": "fleet"}})
+    for name_, attrs in points:
+        add({"ev": "point", "kind": "point", "name": name_, "parent": 1,
+             "attrs": attrs})
+    add({"ev": "end", "kind": "run", "name": "sweep", "id": 1, "dur": 1.0,
+         "status": "ok"})
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def test_check_fleet_flags_double_commit(tmp_path):
+    path = _fleet_stream(tmp_path, [
+        ("fleet.claim", {"uid": "u0", "worker": "w0"}),
+        ("fleet.commit", {"uid": "u0", "worker": "w0", "duplicate": False}),
+        ("fleet.commit", {"uid": "u0", "worker": "w1", "duplicate": False}),
+        ("fleet.exit", {"status": "done"}),
+    ])
+    errors = trace_report.check_fleet(path,
+                                      list(trace_report.iter_events(path)))
+    assert any("first-writer-wins" in e for e in errors)
+
+
+def test_check_fleet_flags_unresolved_claim(tmp_path):
+    path = _fleet_stream(tmp_path, [
+        ("fleet.claim", {"uid": "u0", "worker": "w0"}),
+        ("fleet.exit", {"status": "done"}),
+    ])
+    errors = trace_report.check_fleet(path,
+                                      list(trace_report.iter_events(path)))
+    assert any("never committed or quarantined" in e for e in errors)
+
+
+def test_check_fleet_drained_run_tolerates_unresolved(tmp_path):
+    path = _fleet_stream(tmp_path, [
+        ("fleet.claim", {"uid": "u0", "worker": "w0"}),
+        ("fleet.lease_expired", {"uid": "u0", "holder": "w0-i0"}),
+        ("fleet.exit", {"status": "drained"}),
+    ])
+    assert trace_report.check_fleet(
+        path, list(trace_report.iter_events(path))) == []
+
+
+def test_check_fleet_flags_expiry_without_reissue(tmp_path):
+    path = _fleet_stream(tmp_path, [
+        ("fleet.claim", {"uid": "u0", "worker": "w0"}),
+        ("fleet.claim", {"uid": "u1", "worker": "w1"}),
+        ("fleet.commit", {"uid": "u0", "worker": "w0", "duplicate": False}),
+        ("fleet.commit", {"uid": "u1", "worker": "w1", "duplicate": False}),
+        ("fleet.lease_expired", {"uid": "u2", "holder": "w2-i0"}),
+        ("fleet.exit", {"status": "done"}),
+    ])
+    errors = trace_report.check_fleet(path,
+                                      list(trace_report.iter_events(path)))
+    assert any("never resolved to a re-issue" in e for e in errors)
+
+
+def test_check_fleet_flags_nonmonotone_worker_stream(tmp_path):
+    path = _fleet_stream(tmp_path, [
+        ("fleet.claim", {"uid": "u0", "worker": "w0"}),
+        ("fleet.commit", {"uid": "u0", "worker": "w0", "duplicate": False}),
+        ("fleet.exit", {"status": "done"}),
+    ])
+    with open(str(tmp_path / "_events.w0.jsonl"), "w") as f:
+        f.write(json.dumps({"v": 1, "seq": 5, "t": 0.0, "ev": "point",
+                            "kind": "point", "name": "x"}) + "\n")
+        f.write(json.dumps({"v": 1, "seq": 3, "t": 0.1, "ev": "point",
+                            "kind": "point", "name": "y"}) + "\n")
+    errors = trace_report.check_fleet(path,
+                                      list(trace_report.iter_events(path)))
+    assert any("worker stream seq" in e for e in errors)
+
+
+def test_check_fleet_noop_on_non_fleet_stream():
+    """The supervised-run fixture has no fleet events and no sibling worker
+    streams in its directory — the fleet gate must stay silent there."""
+    path = os.path.join(REPO, "tests", "fixtures", "obs", "_events.jsonl")
+    assert trace_report.check_fleet(
+        path, list(trace_report.iter_events(path))) == []
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the fleet_recovery regression gate.
+# ---------------------------------------------------------------------------
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_fleet_recovery_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2, {"fleet_recovery": {"recovery_seconds": 5.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_fleet_recovery_flags_regression(tmp_path):
+    _write_round(tmp_path, 1, {"fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2, {"fleet_recovery": {"recovery_seconds": 9.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("fleet_recovery.recovery_seconds" in r for r in regressions)
+
+
+def test_bench_compare_fleet_recovery_missing_is_skipped(tmp_path):
+    _write_round(tmp_path, 1, {"fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2, {})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("fleet_recovery.recovery_seconds" in line and "skipped" in line
+               for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# Fast fleet integrations over stdlib-only fake workers.
+# ---------------------------------------------------------------------------
+
+_FAKE_WORKER = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from taboo_brittleness_tpu.runtime import fleet, supervise
+
+supervise.install_drain_handlers()
+
+
+def unit_fn(unit):
+    time.sleep(float(unit.get("sleep", 0.05)))
+    return {{"word": unit.get("word"), "ok": True}}
+
+
+res = fleet.run_worker(sys.argv[1], sys.argv[2], unit_fn=unit_fn,
+                       lease_s=float(sys.argv[3]), poll_s=0.05)
+sys.exit(res.exit_code)
+"""
+
+
+def _fake_worker_argv(tmp_path, out, lease="2.0"):
+    path = str(tmp_path / "fake_worker.py")
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write(_FAKE_WORKER.format(repo=REPO))
+    return lambda wid: [sys.executable, path, out, wid, lease]
+
+
+def _units(n, sleep=0.05):
+    return [{"uid": f"u{i:02d}", "word": f"u{i:02d}", "sleep": sleep,
+             "readout": {"layer": 1}} for i in range(n)]
+
+
+def _fake_env(extra=None):
+    env = {"TBX_OBS_PROGRESS_S": "0.1", "TBX_SUPERVISE_BACKOFF_S": "0"}
+    env.update(extra or {})
+    return env
+
+
+def test_fleet_completes_and_merges(tmp_path):
+    out = str(tmp_path / "fleet")
+    units = _units(6)
+    res = fleet.run_fleet(
+        units, out, n_workers=2,
+        worker_argv=_fake_worker_argv(tmp_path, out),
+        worker_env=_fake_env(), lease_s=2.0, poll_s=0.1,
+        supervise_poll=0.1, grace=1.0, wedge_after=30.0,
+        max_incarnations=3, policy=FAST, spec_factor=0.0, max_wall_s=120.0)
+    assert res.status == "done" and res.exit_code == 0
+    assert res.committed == 6 and res.quarantined == 0
+    sp = FleetSpool(os.path.join(out, "spool"))
+    assert sorted(sp.done_uids()) == [f"u{i:02d}" for i in range(6)]
+    # Merged stream green under the full gate (schema + fleet invariants).
+    merged = os.path.join(out, "_events.jsonl")
+    events = list(trace_report.iter_events(merged))
+    assert trace_report.check(merged) == []
+    assert trace_report.check_fleet(merged, events) == []
+    assert os.path.exists(os.path.join(out, "_fleet.json"))
+
+
+def test_fleet_drain_exits_75_and_resumes(tmp_path):
+    out = str(tmp_path / "fleet")
+    argv = _fake_worker_argv(tmp_path, out)
+    # Slow units widen the drain window so some units stay pending.
+    units = _units(8, sleep=0.4)
+    timer = threading.Timer(1.2, supervise.request_drain)
+    timer.start()
+    try:
+        res = fleet.run_fleet(
+            units, out, n_workers=2, worker_argv=argv,
+            worker_env=_fake_env(), lease_s=2.0, poll_s=0.1,
+            supervise_poll=0.1, grace=2.0, wedge_after=30.0,
+            max_incarnations=3, policy=FAST, spec_factor=0.0,
+            max_wall_s=120.0)
+    finally:
+        timer.cancel()
+        supervise.reset_drain()
+    assert res.status == "drained"
+    assert res.exit_code == supervise.EXIT_DRAINED
+    sp = FleetSpool(os.path.join(out, "spool"))
+    assert 0 < len(sp.done_uids()) < 8      # partial, at unit boundaries
+
+    # Resume: the spool is durable — a fresh fleet finishes the rest.
+    res2 = fleet.run_fleet(
+        units, out, n_workers=2, worker_argv=argv,
+        worker_env=_fake_env(), lease_s=2.0, poll_s=0.1,
+        supervise_poll=0.1, grace=1.0, wedge_after=30.0,
+        max_incarnations=3, policy=FAST, spec_factor=0.0, max_wall_s=120.0)
+    assert res2.status == "done" and res2.exit_code == 0
+    assert sorted(sp.done_uids()) == [f"u{i:02d}" for i in range(8)]
+
+
+def test_fleet_speculation_rescues_straggler(tmp_path, monkeypatch):
+    """One unit sleeps 30s (the straggler); the percentile deadline trips,
+    a speculative copy goes to the other worker, the fleet finishes without
+    waiting for the original, and the eventual losing commit is benign."""
+    monkeypatch.setenv("TBX_FLEET_SPEC_MIN_S", "1")
+    out = str(tmp_path / "fleet")
+    units = _units(7, sleep=0.05)
+    units[3]["sleep"] = 30.0                # first claimant wedges on it
+    res = fleet.run_fleet(
+        units, out, n_workers=2,
+        worker_argv=_fake_worker_argv(tmp_path, out, lease="1.0"),
+        worker_env=_fake_env(), lease_s=1.0, poll_s=0.1,
+        supervise_poll=0.1, grace=1.0, wedge_after=60.0,
+        max_incarnations=3, policy=FAST,
+        spec_factor=2.0, spec_pct=75.0, max_wall_s=120.0)
+    assert res.status == "done" and res.exit_code == 0
+    assert res.committed == 7
+    assert res.speculated >= 1
+    sp = FleetSpool(os.path.join(out, "spool"))
+    assert sorted(sp.done_uids()) == [f"u{i:02d}" for i in range(7)]
+    # Exactly-once: one done file per unit regardless of the race; the
+    # straggler's own commit (if it landed before the stop) parked in
+    # duplicates/ rather than overwriting.
+    with open(sp.done_path("u03")) as f:
+        assert json.load(f)["uid"] == "u03"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 acceptance: the chaos e2e on real tiny-model workers.
+# ---------------------------------------------------------------------------
+
+def test_fleet_chaos_die_and_wedge_exactly_once(tmp_path):
+    """3 synthetic tiny-model worker subprocesses over 12 words; worker w1
+    is SIGKILL-equivalently killed mid-word (``die`` at its first commit)
+    and worker w2 wedges mid-word (60s ``delay`` with a fresh heartbeat —
+    the two-signal classifier's kill case).  The sweep must complete every
+    word exactly once with zero ``.corrupt`` files, a green merged event
+    stream, and the killed/wedged workers' units showing lease-expiry →
+    re-issue chains in the merged ledger."""
+    out = str(tmp_path / "fleet")
+    words = [f"word{i:02d}" for i in range(12)]
+    units = [{"uid": unit_id(w, {"layer": 1}), "word": w,
+              "readout": {"layer": 1}} for w in words]
+    plan = {"fleet.commit": [
+        {"mode": "die", "times": 1, "match": "w1", "incarnation": 0},
+        {"mode": "delay", "delay": 60.0, "times": 1, "match": "w2",
+         "incarnation": 0},
+    ]}
+    env = _fake_env({"JAX_PLATFORMS": "cpu",
+                     "TABOO_FAULT_PLAN": json.dumps(plan),
+                     "TBX_OBS_PROGRESS_S": "0.2"})
+
+    def argv(wid):
+        return [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                "--fleet-dir", out, "--worker-id", wid]
+
+    res = fleet.run_fleet(
+        units, out, n_workers=3, worker_argv=argv, worker_env=env,
+        spool_config={"mode": "synthetic", "words": words,
+                      "max_new_tokens": 3},
+        lease_s=3.0, poll_s=0.2, supervise_poll=0.2, grace=2.0,
+        # Wedge threshold above the tiny-model compile (~10s of legitimate
+        # event silence) but far below the 60s injected wedge.
+        wedge_after=15.0, max_incarnations=4, spec_factor=0.0,
+        policy=FAST, max_wall_s=500.0)
+
+    assert res.status == "done", res.to_dict()
+    assert res.exit_code == 0
+    # Exactly-once: every word committed, once, and nothing quarantined.
+    sp = FleetSpool(os.path.join(out, "spool"))
+    assert sorted(sp.done_uids()) == sorted(u["uid"] for u in units)
+    assert res.committed == 12 and res.quarantined == 0
+    # Both chaos victims dropped a lease; both units were re-issued.
+    assert res.lease_expiries >= 2, res.to_dict()
+    assert res.reissued >= 2
+    # Zero torn artifacts anywhere in the tree.
+    corrupt = [os.path.join(r, n) for r, _, names in os.walk(str(tmp_path))
+               for n in names if n.endswith(".corrupt")]
+    assert corrupt == []
+    # The killed worker burned an incarnation; so did the wedged one.
+    incs = {w["worker_id"]: w["incarnations"] for w in res.workers}
+    assert incs["w1"] >= 2 and incs["w2"] >= 2, incs
+
+    # Merged event stream: green under the full trace_report gate
+    # (schema + seq monotonicity + balanced spans + fleet invariants).
+    merged = os.path.join(out, "_events.jsonl")
+    events = list(trace_report.iter_events(merged))
+    assert trace_report.check(merged) == []
+    assert trace_report.check_fleet(merged, events) == []
+
+    # The ledger records the lease-expiry → re-issue chain per victim.
+    with open(os.path.join(out, "_failures.json")) as f:
+        ledger = json.load(f)
+    assert ledger["version"] == 3
+    chains = ledger["fleet"]["reissues"]
+    victims = {e["worker"] for chain in chains.values() for e in chain}
+    assert {"w1", "w2"} <= victims, chains
+    for chain in chains.values():
+        for entry in chain:
+            assert entry["reason"] == "lease-expired"
+            assert entry["to_attempt"] == entry["from_attempt"] + 1
+
+    # The wedged worker was killed by its supervisor for the two-signal
+    # reason, not a timeout: its per-worker supervise record says wedged.
+    with open(os.path.join(out, "_supervise.w2.json")) as f:
+        sup = json.load(f)
+    outcomes = [r["outcome"] for r in sup["incarnations"]]
+    assert "wedged" in outcomes, outcomes

@@ -59,6 +59,11 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # calibration/lens regression shows up here before the speedup moves).
     "spec_ab.spec_speedup": (0.25, True, 0.0),
     "spec_ab.accept_rate": (0.25, True, 0.0),
+    # Elastic-fleet recovery (bench.py fleet_recovery, ISSUE 10): the time
+    # from a worker death's lease expiry to the re-issued unit committing
+    # must not creep up.  Wide band (±50%): the path crosses subprocess
+    # relaunch + poll intervals, so run-to-run jitter is structural.
+    "fleet_recovery.recovery_seconds": (0.50, False, 0.0),
 }
 
 #: Absolute-budget metrics: (max allowed value).  Checked on the LATEST

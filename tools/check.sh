@@ -29,8 +29,14 @@ echo "== trace_report device-join gate (committed device-profile fixture)"
 python tools/trace_report.py tests/fixtures/obs/device/_events.jsonl \
   --check --device
 
+echo "== trace_report fleet gate (committed multi-worker fixture)"
+python tools/trace_report.py --check tests/fixtures/obs/fleet/_events.jsonl
+
 echo "== serve loadgen selfcheck (CPU smoke: tiny model, 32 requests)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
+
+echo "== fleet selfcheck (chaos smoke: 3 tiny workers, one killed mid-word)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
 
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \

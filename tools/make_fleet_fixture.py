@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Regenerate the committed multi-worker fleet fixture
+(tests/fixtures/obs/fleet/).
+
+Runs a REAL chaos fleet — tiny model, 3 subprocess workers, worker ``w1``
+killed by a ``die`` fault at its first commit (``runtime.fleet.selfcheck``,
+the same scenario ``tbx fleet --selfcheck`` gates) — then copies the merged
+``_events.jsonl``, the per-worker ``_events.<wid>.jsonl`` streams, and the
+merged ``_failures.json`` into the fixture directory.  The committed files
+are what ``trace_report --check`` holds the fleet schema to (tools/check.sh),
+so the fleet event vocabulary and merge invariants cannot drift silently.
+
+    JAX_PLATFORMS=cpu python tools/make_fleet_fixture.py
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+FIXTURE_DIR = os.path.join(_REPO, "tests", "fixtures", "obs", "fleet")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from taboo_brittleness_tpu.runtime import fleet
+
+    out = tempfile.mkdtemp(prefix="tbx_fleet_fixture_")
+    res = fleet.selfcheck(out_dir=out)
+    print(f"fleet run: {res.status}, {res.committed} committed, "
+          f"{res.reissued} re-issued, {res.lease_expiries} lease expirie(s)")
+
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    for old in glob.glob(os.path.join(FIXTURE_DIR, "_events*.jsonl")):
+        os.unlink(old)
+    copied = []
+    for src in sorted(glob.glob(os.path.join(out, "_events*.jsonl"))):
+        dst = os.path.join(FIXTURE_DIR, os.path.basename(src))
+        shutil.copyfile(src, dst)
+        copied.append(dst)
+    ledger = os.path.join(out, "_failures.json")
+    if os.path.exists(ledger):
+        shutil.copyfile(ledger, os.path.join(FIXTURE_DIR, "_failures.json"))
+        copied.append(os.path.join(FIXTURE_DIR, "_failures.json"))
+    for p in copied:
+        print(f"  -> {os.path.relpath(p, _REPO)}")
+
+    # Sanity: the committed fixture must be green under its own gate.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    rc = trace_report.main(
+        ["--check", os.path.join(FIXTURE_DIR, "_events.jsonl")])
+    if rc != 0:
+        print("make_fleet_fixture: regenerated fixture FAILS trace_report "
+              "--check", file=sys.stderr)
+        return rc
+    shutil.rmtree(out, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -345,6 +345,171 @@ def _device_section(profile: Dict[str, Any], spans: Dict[int, Span],
     return "\n".join(lines)
 
 
+def _fleet_points(points: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for p in points:
+        name = str(p.get("name", ""))
+        if name.startswith("fleet."):
+            by_name.setdefault(name, []).append(p)
+    return by_name
+
+
+def check_fleet(path: str, events: List[Dict[str, Any]]) -> List[str]:
+    """Fleet-execution invariants for ``--check`` (empty = clean; no-op on
+    non-fleet streams).  Gated over the merged ``_events.jsonl`` a fleet
+    run leaves behind (``runtime/fleet.py``):
+
+    - every claimed unit resolves: committed exactly ONCE (first-writer-wins
+      — duplicate commits must carry ``duplicate=true``) or quarantined,
+      unless the run drained;
+    - every lease-expiry marker resolves to a re-issue (or the unit had
+      already committed — an expiry racing a commit is dropped, not
+      re-issued — or the run drained);
+    - every per-worker sibling stream (``_events.<wid>.jsonl`` next to the
+      merged file) is individually parseable with strictly monotone seq —
+      the per-worker invariant the merge's renumbering relies on.
+    """
+    errors: List[str] = []
+    spans, points = build_spans(events)
+    fleet = _fleet_points(points)
+
+    # Sibling per-worker streams: individually seq-monotone.
+    d = os.path.dirname(os.path.abspath(path))
+    base = os.path.basename(path)
+    if base.endswith(".jsonl"):
+        import glob as _glob
+
+        for sib in sorted(_glob.glob(os.path.join(d, "_events.*.jsonl"))):
+            if os.path.abspath(sib) == os.path.abspath(path):
+                continue
+            last_seq = 0
+            try:
+                for i, ev in enumerate(iter_events(sib, strict=True),
+                                       start=1):
+                    seq = ev.get("seq", 0)
+                    if seq <= last_seq:
+                        errors.append(
+                            f"{sib}:{i}: worker stream seq {seq} not "
+                            f"increasing (prev {last_seq})")
+                    last_seq = seq
+            except ValueError as e:
+                errors.append(str(e))
+
+    if not fleet:
+        return errors
+
+    drained = any(
+        s.attrs.get("drained") for s in spans.values() if s.kind == "run")
+    exits = fleet.get("fleet.exit", [])
+    status = str((exits[-1].get("attrs") or {}).get("status", "done")
+                 if exits else "done")
+    incomplete_ok = drained or status in ("drained", "stalled")
+
+    def attr(p, key, default=None):
+        return (p.get("attrs") or {}).get(key, default)
+
+    committed: Dict[str, int] = {}
+    for p in fleet.get("fleet.commit", []):
+        if not attr(p, "duplicate", False):
+            uid = str(attr(p, "uid"))
+            committed[uid] = committed.get(uid, 0) + 1
+    quarantined = {str(attr(p, "uid"))
+                   for p in fleet.get("fleet.quarantine", [])}
+    for uid, n in sorted(committed.items()):
+        if n > 1:
+            errors.append(
+                f"{path}: unit {uid} committed {n} times without the "
+                "duplicate flag — first-writer-wins violated")
+    for p in fleet.get("fleet.claim", []):
+        uid = str(attr(p, "uid"))
+        if uid in committed or uid in quarantined:
+            continue
+        if not incomplete_ok:
+            errors.append(
+                f"{path}: unit {uid} claimed (worker "
+                f"{attr(p, 'worker')}) but never committed or quarantined")
+    reissued = {str(attr(p, "uid")) for p in fleet.get("fleet.reissue", [])}
+    for p in fleet.get("fleet.lease_expired", []):
+        uid = str(attr(p, "uid"))
+        if uid in reissued or uid in committed or uid in quarantined:
+            continue
+        if not incomplete_ok:
+            errors.append(
+                f"{path}: lease expiry for unit {uid} (holder "
+                f"{attr(p, 'holder')}) never resolved to a re-issue or a "
+                "drain")
+    return errors
+
+
+def _fleet_section(spans: Dict[int, Span],
+                   points: List[Dict[str, Any]]) -> str:
+    """Per-worker lane view of a fleet run: one row per worker pooling its
+    claims/commits/quarantines across incarnations, plus the coordinator's
+    expiry/re-issue/speculation markers — the "who dropped what, who picked
+    it up" summary."""
+    fleet = _fleet_points(points)
+
+    def attr(p, key, default=None):
+        return (p.get("attrs") or {}).get(key, default)
+
+    lines = ["fleet:"]
+    starts = fleet.get("fleet.start", [])
+    if starts:
+        a = starts[-1].get("attrs") or {}
+        lines.append(f"  {a.get('units', '?')} unit(s) over "
+                     f"{a.get('workers', '?')} worker(s), lease "
+                     f"{a.get('lease_s', '?')}s")
+    workers: Dict[str, Dict[str, int]] = {}
+
+    def lane(wid) -> Dict[str, int]:
+        return workers.setdefault(str(wid), {
+            "claims": 0, "commits": 0, "duplicates": 0, "quarantined": 0,
+            "dropped": 0, "incarnations": 0})
+
+    for p in fleet.get("fleet.claim", []):
+        lane(attr(p, "worker", "?"))["claims"] += 1
+    for p in fleet.get("fleet.commit", []):
+        cell = lane(attr(p, "worker", "?"))
+        cell["duplicates" if attr(p, "duplicate", False)
+             else "commits"] += 1
+    for p in fleet.get("fleet.quarantine", []):
+        lane(attr(p, "worker", "?"))["quarantined"] += 1
+    for p in fleet.get("fleet.lease_expired", []):
+        lane(attr(p, "worker", "?"))["dropped"] += 1
+    for s in spans.values():
+        if s.kind == "run" and s.attrs.get("worker"):
+            lane(s.attrs["worker"])["incarnations"] += 1
+    if workers:
+        header = ["worker", "claims", "commits", "dups", "quarantined",
+                  "dropped_leases", "incarnations"]
+        body = [[f"  {wid}"] + [str(cell[k]) for k in
+                ("claims", "commits", "duplicates", "quarantined",
+                 "dropped", "incarnations")]
+                for wid, cell in sorted(workers.items())]
+        lines.append(_table(header, body))
+    for p in fleet.get("fleet.lease_expired", []):
+        lines.append(
+            f"  t={_fmt_s(float(p.get('t', 0)))}s lease expired: "
+            f"{attr(p, 'uid')} (holder {attr(p, 'holder')})")
+    for p in fleet.get("fleet.reissue", []):
+        lines.append(
+            f"  t={_fmt_s(float(p.get('t', 0)))}s re-issued: "
+            f"{attr(p, 'uid')} attempt {attr(p, 'attempt')} "
+            f"excluding {attr(p, 'excluded')}")
+    for p in fleet.get("fleet.speculate", []):
+        lines.append(
+            f"  t={_fmt_s(float(p.get('t', 0)))}s speculated: "
+            f"{attr(p, 'uid')} (straggler holder {attr(p, 'holder')})")
+    for p in fleet.get("fleet.exit", []):
+        a = p.get("attrs") or {}
+        lines.append(
+            f"  exit: {a.get('status')} — {a.get('committed')} committed, "
+            f"{a.get('quarantined')} quarantined, {a.get('reissued')} "
+            f"re-issued, {a.get('duplicates')} duplicate commit(s)")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def check_device(profile_path: str, events: List[Dict[str, Any]]) -> List[str]:
     """Join-invariant violations for ``--check --device`` (empty = clean)."""
     errors: List[str] = []
@@ -480,6 +645,9 @@ def report(events: List[Dict[str, Any]], *,
     serve_runs = [r for r in runs if r.attrs.get("pipeline") == "serve"]
     if serve_runs:
         out.append(_serving_section(serve_runs, points))
+
+    if _fleet_points(points):
+        out.append(_fleet_section(spans, points))
 
     for run in runs:
         pipeline = run.attrs.get("pipeline", run.name)
@@ -696,6 +864,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.check:
         errors = check(args.events)
+        # Fleet invariants (runtime/fleet.py): no-op on non-fleet streams,
+        # so the gate applies wherever a merged fleet stream shows up.
+        errors += check_fleet(args.events, list(iter_events(args.events)))
         if device_path is not None:
             errors += check_device(device_path,
                                    list(iter_events(args.events)))
