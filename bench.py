@@ -1347,6 +1347,85 @@ def _fleet_recovery_bench(on_accel: bool) -> dict:
     }
 
 
+def _delta_switch_bench(on_accel: bool) -> dict:
+    """``delta_switch`` stage (BENCH_DELTA=1, CPU-smoke default-on): the
+    base-resident word-switch path (ISSUE 12).
+
+    Runs the REAL artifact path — pack each word as ``word − base`` deltas
+    (runtime/delta.py), write them with the same npz writer the cache uses,
+    then time warmed load→apply→ready cycles — and commits the numbers the
+    residency story is judged by: ``switch_ms`` (median cold-params word
+    switch over the resident base), ``delta_bytes_ratio`` (delta artifact
+    bytes vs a full checkpoint written by the SAME writer, so compression is
+    held equal), and ``words_resident``.  Self-contained on the tiny preset
+    by default: the stage measures the switch CONTROL path (artifact read +
+    in-graph apply), not model-size IO, and serializing a full bench-preset
+    checkpoint to /tmp each round would measure the disk instead."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+    from taboo_brittleness_tpu.runtime import native_io
+    from taboo_brittleness_tpu.serve.loadgen import synthetic_word_params
+
+    preset = os.environ.get("BENCH_DELTA_PRESET", "gemma2_tiny")
+    n_words = int(os.environ.get("BENCH_DELTA_WORDS", "3"))
+    reps = int(os.environ.get("BENCH_DELTA_REPS", "5"))
+    root = tempfile.mkdtemp(prefix="tbx_bench_delta_")
+    try:
+        cfg = gemma2.PRESETS[preset]
+        base = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+        named = deltalib.flatten_named(base)
+        full_path = os.path.join(root, "full.npz")
+        native_io.save_npz(full_path,
+                           {k: np.asarray(v) for k, v in named.items()})
+        full_bytes = os.path.getsize(full_path)
+
+        words = [f"word{i:02d}" for i in range(n_words)]
+        paths, delta_sizes = [], []
+        codec_counts: dict = {}
+        for w in words:
+            wp = synthetic_word_params(cfg, base, w)
+            payload, meta = deltalib.pack_params_delta(base, wp)
+            path = deltalib.delta_path(root, w)
+            delta_sizes.append(deltalib.save_delta(path, payload, meta))
+            paths.append(path)
+            for codec in meta["codecs"].values():
+                codec_counts[codec] = codec_counts.get(codec, 0) + 1
+
+        def switch(path: str) -> None:
+            payload, meta = deltalib.load_delta(path)
+            jax.block_until_ready(deltalib.apply_packed(base, payload, meta))
+
+        for path in paths:          # warm: compile apply + prime page cache
+            switch(path)
+        times_ms = []
+        for _ in range(reps):
+            for path in paths:
+                t0 = time.perf_counter()
+                switch(path)
+                times_ms.append((time.perf_counter() - t0) * 1e3)
+        total_delta = int(sum(delta_sizes))
+        return {
+            "switch_ms": round(float(np.median(times_ms)), 3),
+            "switch_ms_p90": round(float(np.percentile(times_ms, 90)), 3),
+            "delta_bytes": total_delta,
+            "full_bytes": int(full_bytes),
+            "delta_bytes_ratio": round(total_delta / (n_words * full_bytes),
+                                       4),
+            "words_resident": n_words,
+            "codecs": codec_counts,
+            "config": {"preset": preset, "words": n_words, "reps": reps},
+        }
+    except Exception as e:  # noqa: BLE001 — a broken stage must not void the round
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -1467,6 +1546,10 @@ def main() -> int:
     if os.environ.get("BENCH_FLEET", "1") == "1":
         fleet_stage = _fleet_recovery_bench(on_accel)
 
+    delta_stage = None
+    if os.environ.get("BENCH_DELTA", "1") == "1":
+        delta_stage = _delta_switch_bench(on_accel)
+
     device_profile = None
     if os.environ.get("BENCH_DEVICE_PROFILE",
                       "1" if on_accel else "0") == "1":
@@ -1552,6 +1635,16 @@ def main() -> int:
              "reissued_units": fleet_stage.get("reissued_units"),
              "duplicate_commits": fleet_stage.get("duplicate_commits")}
             if fleet_stage and "error" not in fleet_stage else None),
+        # Base-resident delta switch (runtime/delta.py, stage delta_switch):
+        # pack word−base deltas, then time warmed load→apply→ready word
+        # switches over the resident base — median latency, delta-vs-full
+        # byte ratio (same writer both sides), words resident; full stage in
+        # the detail block.
+        "delta_switch": (
+            {"switch_ms": delta_stage.get("switch_ms"),
+             "delta_bytes_ratio": delta_stage.get("delta_bytes_ratio"),
+             "words_resident": delta_stage.get("words_resident")}
+            if delta_stage and "error" not in delta_stage else None),
         # Serving SLO (serve subsystem): closed-loop loadgen over the
         # resident engine — pooled p50/p99 + goodput; per-scenario table in
         # the detail block "serve_latency".
@@ -1583,6 +1676,7 @@ def main() -> int:
             {"headline": headline, "sweep": sweep, "study": study,
              "obs_overhead": obs_ab, "serve_latency": serve_stage,
              "fleet_recovery": fleet_stage,
+             "delta_switch": delta_stage,
              "device_profile": device_profile},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract

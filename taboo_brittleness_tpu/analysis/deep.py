@@ -166,11 +166,9 @@ def _entry_nll_cached():
     return fn, (params, kv, kv, cache_valid, seqs, valid, pos, nmask)
 
 
-def _entry_serve_step():
-    # The serving subsystem's resident step program (one compiled step for
-    # every scenario; serve/engine.py).  Its per-step unembed + optional
-    # lens readout each materialize a transient [S, 1, V] f32 row — reviewed
-    # and baselined like the decode/NLL readouts.
+def _serve_abstract():
+    """Shared abstract serving state (cfg, params, sae, cache, state) for
+    the serve-step entries."""
     import jax
     import jax.numpy as jnp
 
@@ -209,13 +207,98 @@ def _entry_serve_step():
         latent_ids=sds((S, m), jnp.int32),
         basis=sds((S, D, r), jnp.float32),
         lens_target=sds((S,), jnp.int32),
+        word_id=sds((S,), jnp.int32),
     )
+    return cfg, params, sae, cache, state
+
+
+def _entry_serve_step():
+    # The serving subsystem's resident step program (one compiled step for
+    # every scenario; serve/engine.py).  Its per-step unembed + optional
+    # lens readout each materialize a transient [S, 1, V] f32 row — reviewed
+    # and baselined like the decode/NLL readouts.
+    from taboo_brittleness_tpu.serve import engine as serve_engine
+
+    cfg, params, sae, cache, state = _serve_abstract()
 
     def fn(p, s, c, st):
         return serve_engine.serve_step(p, cfg, s, c, st, sae_layer=1,
                                        proj_layer=1, tap_layer=2)
 
     return fn, (params, sae, cache, state)
+
+
+def _delta_abstract_names(params):
+    """Pick one xor leaf and one q8 leaf from the abstract param set (sorted
+    for determinism; the q8 leaf needs ndim >= 2 for a per-channel scale)."""
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    named = deltalib.flatten_named(params)
+    names = sorted(named)
+    xor_name = names[0]
+    q8_name = next(n for n in names[1:] if len(named[n].shape) >= 2)
+    return named, xor_name, q8_name
+
+
+def _entry_apply_delta():
+    # The base-resident word switch (runtime/delta.py, ISSUE 12): base +
+    # packed delta -> full word params as ONE program.  xor leaves bitcast
+    # through uint planes (exact), q8 leaves widen base to f32 for the
+    # dequantized add then narrow back — the widening is per-leaf transient,
+    # reviewed and baselined like the readout slabs.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    named, xor_name, q8_name = _delta_abstract_names(params)
+    sds = jax.ShapeDtypeStruct
+    b_x, b_q = named[xor_name], named[q8_name]
+    payload = {
+        xor_name: {"bits": sds(b_x.shape, deltalib._jnp_uint(b_x.dtype))},
+        q8_name: {"q": sds(b_q.shape, jnp.int8),
+                  "scale": sds((b_q.shape[-1],), jnp.float32)},
+    }
+    codecs = tuple(sorted([(xor_name, "xor"), (q8_name, "q8")]))
+
+    def fn(p, pl):
+        return deltalib.apply_delta(p, pl, codecs=codecs)
+
+    return fn, (params, payload)
+
+
+def _entry_serve_step_multi():
+    # The multi-word serving step (serve/engine.py, ISSUE 12): scan over the
+    # W-word delta bank, each iteration reconstructing that word's params
+    # in-graph and running the same forward core — W x the single-word
+    # step's readout transients, the documented price of one resident base.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+    from taboo_brittleness_tpu.serve import engine as serve_engine
+
+    cfg, params, sae, cache, state = _serve_abstract()
+    named, xor_name, q8_name = _delta_abstract_names(params)
+    sds = jax.ShapeDtypeStruct
+    W = 2
+    b_x, b_q = named[xor_name], named[q8_name]
+    bank = {
+        xor_name: {"bits": sds((W,) + tuple(b_x.shape),
+                               deltalib._jnp_uint(b_x.dtype))},
+        q8_name: {"q": sds((W,) + tuple(b_q.shape), jnp.int8),
+                  "scale": sds((W, b_q.shape[-1]), jnp.float32)},
+    }
+    codecs = tuple(sorted([(xor_name, "xor"), (q8_name, "q8")]))
+
+    def fn(p, s, bk, c, st):
+        return serve_engine.serve_step_multi(
+            p, cfg, s, bk, c, st, codecs=codecs,
+            sae_layer=1, proj_layer=1, tap_layer=2)
+
+    return fn, (params, sae, bank, cache, state)
 
 
 def _entry_fused_study():
@@ -339,6 +422,8 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("pipelines.interventions._residual_measure", _entry_residual_measure),
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),
+    ("serve.engine.serve_step_multi", _entry_serve_step_multi),
+    ("runtime.delta.apply_delta", _entry_apply_delta),
     ("runtime.fused.fused_study", _entry_fused_study),
     ("runtime.speculate.draft_step", _entry_spec_draft_step),
     ("runtime.speculate.verify_block", _entry_spec_verify_block),

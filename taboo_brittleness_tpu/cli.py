@@ -42,7 +42,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from taboo_brittleness_tpu import config as config_mod
 from taboo_brittleness_tpu.config import Config
@@ -154,7 +154,8 @@ def _loader(config: Config, args, mesh=None):
     from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager
 
     return CheckpointManager(config.model, checkpoint_root=args.checkpoint_root,
-                             mesh=mesh)
+                             mesh=mesh,
+                             delta_root=getattr(args, "delta_root", None))
 
 
 def _sae(config: Config, path: Optional[str]):
@@ -460,25 +461,67 @@ def _serve_engine(args, config: Config):
     from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
     from taboo_brittleness_tpu.serve.scheduler import default_scenarios
 
+    words = tuple(args.words or ())
     if args.synthetic:
+        if len(words) >= 2:
+            # Mixed-word smoke path: base + packed synthetic deltas, one
+            # multi-word step program (ISSUE 12).
+            return loadgen_mod.build_synthetic_multi_engine(
+                words=words, slots=args.slots,
+                max_new_tokens=args.max_new_tokens)
         return loadgen_mod.build_synthetic_engine(
-            slots=args.slots, max_new_tokens=args.max_new_tokens)
+            slots=args.slots, max_new_tokens=args.max_new_tokens,
+            word=words[0] if words else None)
 
     from taboo_brittleness_tpu.runtime.tokenizer import target_token_id
 
-    word = args.word or config.words[0]
-    params, cfg, tok = _loader(config, args)(word)
     sae = None
     if args.sae_npz or os.environ.get("TABOO_GEMMA_SCOPE_ROOT"):
         sae = _sae(config, args.sae_npz)
     layer = config.model.layer_idx
+    if len(words) >= 2:
+        # All words resident in ONE server: base loads once (streamed), the
+        # per-word artifacts under --delta-root stack into a [W, ...] bank.
+        import jax
+        import numpy as np
+
+        from taboo_brittleness_tpu.runtime import delta as deltalib
+
+        delta_root = args.delta_root or os.environ.get("TBX_DELTA_ROOT")
+        if not delta_root:
+            raise SystemExit("multi-word serve needs --delta-root (or "
+                             "TBX_DELTA_ROOT) with `tbx delta-pack` output")
+        mgr = _loader(config, args)
+        mgr.delta_root = delta_root
+        base_params, cfg, tok = mgr.base_triple()
+        packed = [deltalib.load_delta(deltalib.delta_path(delta_root, w))
+                  for w in words]
+        base_host = jax.tree_util.tree_map(np.asarray, base_params)
+        bank = deltalib.stack_bank(base_host, packed)
+        engine = ServeEngine(
+            base_params, cfg, tok,
+            engine_config=EngineConfig(
+                slots=args.slots, max_context=args.max_context,
+                prompt_cols=args.prompt_cols,
+                sae_layer=layer, proj_layer=layer, tap_layer=layer),
+            sae=sae, words=words, delta_bank=bank)
+        scenarios = default_scenarios(max_new_tokens=args.max_new_tokens)
+        if sae is None:
+            scenarios.pop("sae_ablate", None)
+        # Lens readout target is a single token id per server; with mixed
+        # words it tracks the FIRST configured word (per-request targets are
+        # a follow-up once the readout rides per-slot).
+        return engine, scenarios, target_token_id(tok, words[0])
+
+    word = (words[0] if words else None) or args.word or config.words[0]
+    params, cfg, tok = _loader(config, args)(word)
     engine = ServeEngine(
         params, cfg, tok,
         engine_config=EngineConfig(
             slots=args.slots, max_context=args.max_context,
             prompt_cols=args.prompt_cols,
             sae_layer=layer, proj_layer=layer, tap_layer=layer),
-        sae=sae)
+        sae=sae, words=(word,))
     scenarios = default_scenarios(max_new_tokens=args.max_new_tokens)
     if sae is None:
         scenarios.pop("sae_ablate", None)
@@ -492,6 +535,13 @@ def _serve_common(p: argparse.ArgumentParser) -> None:
                         "path; no checkpoint IO)")
     p.add_argument("--word", default=None,
                    help="taboo checkpoint to serve (default: first config word)")
+    p.add_argument("--words", nargs="*", default=None,
+                   help="serve SEVERAL words from one resident base + delta "
+                        "bank (requires --delta-root unless --synthetic); "
+                        "one word behaves like --word")
+    p.add_argument("--delta-root", default=None,
+                   help="directory of `tbx delta-pack` artifacts "
+                        "(default: $TBX_DELTA_ROOT)")
     p.add_argument("--checkpoint-root", default=None)
     p.add_argument("--sae-npz", default=os.environ.get("TABOO_SAE_NPZ"))
     p.add_argument("--slots", type=int, default=8,
@@ -536,10 +586,11 @@ def cmd_loadgen(args) -> int:
         for part in args.mix.split(","):
             name, _, w = part.partition("=")
             mix[name.strip()] = float(w) if w else 1.0
+    words = tuple(args.words or ()) or None
     if args.spool:
         report = loadgen_mod.run_spool(
             args.spool, n_requests=args.n, seed=args.seed, rate=args.rate,
-            concurrency=args.concurrency, mix=mix,
+            concurrency=args.concurrency, mix=mix, words=words,
             timeout_s=args.timeout)
     else:
         config = _load(args)
@@ -547,7 +598,7 @@ def cmd_loadgen(args) -> int:
         report = loadgen_mod.run_inprocess(
             engine, n_requests=args.n, seed=args.seed, rate=args.rate,
             concurrency=args.concurrency, mix=mix, scenarios=scenarios,
-            lens_target_id=lens_tgt)
+            words=words, lens_target_id=lens_tgt)
     if args.report:
         from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
 
@@ -556,6 +607,100 @@ def cmd_loadgen(args) -> int:
     print(json.dumps(report))
     dropped = report["goodput"]["admitted"] - report["goodput"]["completed"]
     return 0 if dropped == 0 else 1
+
+
+def cmd_delta_pack(args) -> int:
+    """Pack word checkpoints as base-resident deltas (``runtime.delta``):
+    per-leaf zero/q8/xor codec against one base snapshot, written as
+    versioned, atomically-replaced ``<word>.delta.npz`` artifacts that
+    ``CheckpointManager`` (TBX_DELTA=1) and multi-word ``tbx serve`` stream
+    instead of full checkpoints."""
+    import jax
+
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    if args.selfcheck:
+        # Hermetic CI smoke: tiny model, synthetic word, pack -> artifact ->
+        # apply -> BIT-exact forward (the exactness contract end to end).
+        import tempfile
+
+        import jax.numpy as jnp
+
+        from taboo_brittleness_tpu.models import gemma2
+        from taboo_brittleness_tpu.serve.loadgen import synthetic_word_params
+
+        cfg = gemma2.PRESETS["gemma2_tiny"]
+        base = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+        word_params = synthetic_word_params(cfg, base, "ship")
+        payload, meta = deltalib.pack_params_delta(base, word_params)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = deltalib.delta_path(tmp, "ship")
+            artifact_bytes = deltalib.save_delta(path, payload, meta)
+            loaded_payload, loaded_meta = deltalib.load_delta(path)
+        applied = deltalib.apply_packed(base, loaded_payload, loaded_meta)
+        ids = (jnp.arange(12, dtype=jnp.int32) % cfg.vocab_size)[None, :]
+        want = gemma2.forward(word_params, cfg, ids).logits
+        got = gemma2.forward(applied, cfg, ids).logits
+        exact = bool(jnp.array_equal(want, got))
+        counts: Dict[str, int] = {}
+        for codec in meta["codecs"].values():
+            counts[codec] = counts.get(codec, 0) + 1
+        # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict JSON)
+        print(json.dumps({
+            "selfcheck": "ok" if exact else "FAIL",
+            "bit_exact_forward": exact,
+            "codec_version": meta["codec_version"],
+            "codecs": counts,
+            "delta_bytes": meta["delta_bytes"],
+            "param_bytes": meta["param_bytes"],
+            "artifact_bytes": artifact_bytes,
+        }))
+        return 0 if exact else 1
+
+    from taboo_brittleness_tpu.models.params import (
+        from_safetensors_dir_streamed, infer_config_from_hf_config_json)
+    from taboo_brittleness_tpu.runtime.checkpoints import (
+        DEFAULT_DELTA_BASE, resolve_snapshot_dir)
+
+    config = _load(args)
+    base_id = args.base or os.environ.get("TBX_DELTA_BASE",
+                                          DEFAULT_DELTA_BASE)
+    out_root = (args.out or os.environ.get("TBX_DELTA_ROOT")
+                or os.path.join("results", "deltas"))
+    snap = resolve_snapshot_dir(base_id, args.checkpoint_root)
+    cfg = infer_config_from_hf_config_json(
+        snap, dtype=config.model.dtype, param_dtype=config.model.param_dtype)
+    base = from_safetensors_dir_streamed(snap, cfg)
+    rows = []
+    for word in (args.words or config.words):
+        wsnap = resolve_snapshot_dir(
+            config.model.checkpoint_template.format(word=word),
+            args.checkpoint_root)
+        wcfg = infer_config_from_hf_config_json(
+            wsnap, dtype=config.model.dtype,
+            param_dtype=config.model.param_dtype)
+        word_params = from_safetensors_dir_streamed(wsnap, wcfg)
+        payload, meta = deltalib.pack_params_delta(
+            base, word_params, atol=args.atol)
+        meta["word"] = word
+        meta["base"] = base_id
+        size = deltalib.save_delta(
+            deltalib.delta_path(out_root, word), payload, meta)
+        rows.append({
+            "word": word,
+            "artifact_bytes": size,
+            "delta_bytes": meta["delta_bytes"],
+            "param_bytes": meta["param_bytes"],
+            "bytes_ratio": round(meta["delta_bytes"]
+                                 / max(1, meta["param_bytes"]), 6),
+            "quantized_leaves": sorted(meta["quantized"]),
+        })
+        del word_params, payload
+    # tbx: TBX009-ok — CLI stdout contract (pack summary JSON)
+    print(json.dumps({"base": base_id, "out": out_root,
+                      "codec_version": deltalib.DELTA_CODEC_VERSION,
+                      "atol": args.atol, "packed": rows}))
+    return 0
 
 
 def cmd_profile(args) -> int:
@@ -941,6 +1086,37 @@ def build_parser() -> argparse.ArgumentParser:
                     help="CPU-sized CI smoke: tiny model, 32 requests, "
                          "asserts goodput == admitted + histogram schema")
     lg.set_defaults(fn=cmd_loadgen)
+
+    dp = sub.add_parser(
+        "delta-pack",
+        help="pack word checkpoints as base-resident deltas "
+             "(zero/q8/xor codec, versioned artifacts)",
+        description="Pack each taboo word checkpoint as `word - base` with "
+                    "a per-leaf codec: untouched leaves drop out (zero), "
+                    "quantizable leaves store int8 + per-channel scales "
+                    "(q8, kept only when the applied reconstruction is "
+                    "bit-exact or within --atol), the rest store exact XOR "
+                    "bit planes. Artifacts feed CheckpointManager's "
+                    "TBX_DELTA=1 base-resident mode and multi-word "
+                    "`tbx serve --words ... --delta-root ...`.")
+    dp.add_argument("-c", "--config", default="configs/default.yaml")
+    dp.add_argument("--base", default=None,
+                    help="base snapshot repo id (default: $TBX_DELTA_BASE "
+                         "or google/gemma-2-9b-it)")
+    dp.add_argument("--words", nargs="*", default=None,
+                    help="words to pack (default: all in config)")
+    dp.add_argument("--checkpoint-root", default=None)
+    dp.add_argument("--out", default=None,
+                    help="artifact directory (default: $TBX_DELTA_ROOT or "
+                         "results/deltas)")
+    dp.add_argument("--atol", type=float, default=0.0,
+                    help="allow q8 leaves whose applied reconstruction is "
+                         "within this max-abs error (0 = bit-exact only; "
+                         "relaxations are recorded per leaf in the header)")
+    dp.add_argument("--selfcheck", action="store_true",
+                    help="hermetic CI smoke: tiny model, pack -> apply -> "
+                         "bit-exact forward; prints a JSON verdict")
+    dp.set_defaults(fn=cmd_delta_pack)
 
     pf = sub.add_parser(
         "profile",

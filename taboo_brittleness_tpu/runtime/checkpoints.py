@@ -28,25 +28,38 @@ from typing import Dict, Optional, Sequence, Tuple
 from taboo_brittleness_tpu.config import Config, ModelConfig
 from taboo_brittleness_tpu.models import gemma2
 from taboo_brittleness_tpu.models.params import (
-    from_safetensors_dir,
+    from_safetensors_dir_streamed,
     infer_config_from_hf_config_json,
 )
 from taboo_brittleness_tpu.runtime import resilience
 from taboo_brittleness_tpu.runtime.tokenizer import HFTokenizer, TokenizerLike
 
+#: Default base model for delta-resident mode: every taboo checkpoint is a
+#: finetune of this one snapshot (reference src/models.py).
+DEFAULT_DELTA_BASE = "google/gemma-2-9b-it"
+
 
 def resolve_snapshot_dir(repo_id: str, checkpoint_root: Optional[str] = None) -> str:
     """Find a local HF-snapshot directory for ``repo_id`` or raise."""
     basename = repo_id.split("/")[-1]
-    word_suffix = basename.split("-")[-1]
     candidates = []
     root = checkpoint_root or os.environ.get("TABOO_CHECKPOINT_ROOT")
     if root:
-        candidates += [os.path.join(root, basename), os.path.join(root, word_suffix),
-                       os.path.join(root, repo_id.replace("/", "--"))]
-    hub = os.path.expanduser(
-        os.environ.get("HF_HOME", "~/.cache/huggingface"))
-    hub_dir = os.path.join(hub, "hub", f"models--{repo_id.replace('/', '--')}", "snapshots")
+        parts = basename.split("-")
+        # Every hyphen-suffix of the basename, LONGEST first, so a
+        # multi-token word ("...-taboo-ice-cream") resolves <root>/ice-cream
+        # before a bare <root>/cream could shadow it.
+        suffixes = ["-".join(parts[i:]) for i in range(1, len(parts))]
+        candidates += [os.path.join(root, basename)]
+        candidates += [os.path.join(root, s) for s in suffixes]
+        candidates += [os.path.join(root, repo_id.replace("/", "--"))]
+    # HF_HUB_CACHE points at the hub cache itself; HF_HOME at its parent.
+    hub_dir_root = os.path.expanduser(
+        os.environ.get("HF_HUB_CACHE")
+        or os.path.join(os.environ.get("HF_HOME", "~/.cache/huggingface"),
+                        "hub"))
+    hub_dir = os.path.join(hub_dir_root,
+                           f"models--{repo_id.replace('/', '--')}", "snapshots")
     if os.path.isdir(hub_dir):
         snaps = sorted(os.listdir(hub_dir))
         candidates += [os.path.join(hub_dir, s) for s in snaps]
@@ -74,13 +87,26 @@ class CheckpointManager:
                  checkpoint_root: Optional[str] = None, capacity: int = 1,
                  mesh=None,
                  retry_policy: Optional[resilience.RetryPolicy] = None,
-                 load_deadline: Optional[float] = None):
+                 load_deadline: Optional[float] = None,
+                 delta_root: Optional[str] = None,
+                 base_id: Optional[str] = None):
         self.model_cfg = model_cfg
         self.checkpoint_root = checkpoint_root
         self.capacity = max(1, capacity)
         self.mesh = mesh  # when set, params are placed per parallel.mesh policy
         self.retry_policy = retry_policy
         self.load_deadline = load_deadline
+        # Base-resident delta mode (ISSUE 12): when a delta root is set —
+        # explicitly or via TBX_DELTA=1 + TBX_DELTA_ROOT — the base snapshot
+        # loads ONCE (streamed, mesh-sharded) and pins; word loads stream
+        # only the packed delta and apply it in-graph.
+        if delta_root is None and os.environ.get("TBX_DELTA") == "1":
+            delta_root = os.environ.get("TBX_DELTA_ROOT") or None
+        self.delta_root = delta_root
+        self.base_id = base_id or os.environ.get(
+            "TBX_DELTA_BASE", DEFAULT_DELTA_BASE)
+        self._base_lock = threading.Lock()
+        self._base_triple: Optional[Tuple] = None
         self._cache: "OrderedDict[str, Tuple]" = OrderedDict()
         self._pending: Dict[str, threading.Thread] = {}
         self._pending_results: Dict[str, Tuple] = {}
@@ -88,17 +114,52 @@ class CheckpointManager:
     def repo_id(self, word: str) -> str:
         return self.model_cfg.checkpoint_template.format(word=word)
 
+    def base_triple(self) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
+        """The pinned base (params, cfg, tok); loaded once, thread-safe.
+
+        Prefetch threads call ``_load_triple`` concurrently with the main
+        thread, so the once-only base load needs a lock — the streamed read
+        of an 18.5 GB snapshot is exactly the work the delta path exists to
+        not repeat.
+        """
+        with self._base_lock:
+            if self._base_triple is None:
+                snap = resolve_snapshot_dir(self.base_id, self.checkpoint_root)
+                cfg = infer_config_from_hf_config_json(
+                    snap, dtype=self.model_cfg.dtype,
+                    param_dtype=self.model_cfg.param_dtype)
+                params = from_safetensors_dir_streamed(
+                    snap, cfg, mesh=self.mesh)
+                tok = HFTokenizer.from_pretrained(snap)
+                self._base_triple = (params, cfg, tok)
+            return self._base_triple
+
     def _load_triple(self, word: str) -> Tuple[gemma2.Params, gemma2.Gemma2Config, TokenizerLike]:
         resilience.fire("checkpoint.read", word=word)
+        if self.delta_root is not None:
+            return self._load_triple_delta(word)
         snap = resolve_snapshot_dir(self.repo_id(word), self.checkpoint_root)
         cfg = infer_config_from_hf_config_json(
             snap, dtype=self.model_cfg.dtype, param_dtype=self.model_cfg.param_dtype)
-        params = from_safetensors_dir(snap, cfg)
-        if self.mesh is not None:
-            from taboo_brittleness_tpu.parallel import mesh as meshlib
-
-            params = meshlib.shard_params(params, cfg, self.mesh)
+        # Streamed: one stacked leaf materializes at a time (vs the whole
+        # state dict + a converted copy), placed straight onto the mesh —
+        # no unsharded full-model stopover on host or device.
+        params = from_safetensors_dir_streamed(snap, cfg, mesh=self.mesh)
         tok = HFTokenizer.from_pretrained(snap)
+        return (params, cfg, tok)
+
+    def _load_triple_delta(self, word: str) -> Tuple:
+        """Delta path: stream the packed delta (~100x less IO than the full
+        snapshot) and apply it to the resident base as one jitted program.
+        Runs inside the same retry/deadline/fault plumbing as a full load —
+        ``checkpoint.read`` has already fired for this attempt."""
+        from taboo_brittleness_tpu.runtime import delta as deltalib
+
+        base_params, cfg, tok = self.base_triple()
+        path = deltalib.delta_path(self.delta_root, word)
+        payload, meta = deltalib.load_delta(path)
+        params = deltalib.apply_packed(
+            base_params, payload, meta, route=self.mesh is None)
         return (params, cfg, tok)
 
     def _load_guarded(self, word: str) -> Tuple:

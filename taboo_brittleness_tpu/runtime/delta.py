@@ -1,0 +1,406 @@
+"""Base-resident delta checkpoints: pack ``word − base``, apply in-graph.
+
+All 20 taboo checkpoints are finetunes of ONE Gemma-2-9B-IT base, yet the
+sweep streams 20 full ~18.5 GB snapshots from host storage — bench r05 shows
+checkpoint load is the hard floor under ``measured_study_seconds_per_word``.
+This module stores each word as a compressed per-leaf delta against the base
+(DECA's compressed-stream + near-memory-decompress stance, arXiv:2505.19349):
+the base loads once (streamed, mesh-sharded) and pins in HBM; a word switch
+streams only the small delta artifact and applies it as ONE jitted,
+AOT-registered program — a millisecond dispatch instead of a storage read.
+
+Codec (``DELTA_CODEC_VERSION``), chosen **per leaf** at pack time:
+
+- ``zero`` — the word leaf is bit-identical to the base leaf; no payload.
+- ``q8``   — int8 quantized delta + per-channel (last-axis) f32 scales,
+  ``word = cast(f32(base) + f32(q) * scale)``.  Kept only when that applied
+  reconstruction is BIT-EXACT in the storage dtype, or — with an explicit
+  ``atol`` — within the recorded allclose bound (never silently).
+- ``xor``  — dense exact fallback: the XOR of the two leaves' raw bit
+  patterns, applied with a bitcast–xor–bitcast.  Exact by construction for
+  any float dtype, and highly compressible for near-identical weights (the
+  shared sign/exponent bits zero out).
+
+The artifact is the repo's spool-friendly atomic format (``runtime.cache``
+idiom): one ``.npz`` written tmp-then-rename via ``native_io.save_npz``,
+with a ``__meta__`` JSON header (codec version, per-leaf codecs, shapes,
+quantization bound) riding inside the archive as a uint8 array.
+
+Equivalence contract: for leaves stored ``zero``/``xor``/bit-exact ``q8``
+the applied params are ``array_equal`` to the full checkpoint — decode
+tokens and lens probabilities match bit-for-bit (gated in
+tests/test_delta.py).  Any leaf kept quantized under a nonzero ``atol`` is
+listed in the header's ``quantized`` block with its measured max abs error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DELTA_CODEC_VERSION = 1
+
+#: npz key separator between leaf name and payload field ("layers.q::bits").
+_KEY_SEP = "::"
+
+#: storage float dtype -> same-width unsigned dtype for the xor codec.
+_UINT_OF = {
+    np.dtype("float32"): np.uint32,
+    np.dtype("float16"): np.uint16,
+    np.dtype("float64"): np.uint64,
+}
+
+
+def _uint_dtype(dtype) -> Any:
+    dtype = np.dtype(dtype)
+    if dtype in _UINT_OF:
+        return _UINT_OF[dtype]
+    if dtype.itemsize == 2:          # bfloat16 (ml_dtypes) and friends
+        return np.uint16
+    raise TypeError(f"no xor-codec bit width for dtype {dtype}")
+
+
+# ---------------------------------------------------------------------------
+# Pytree <-> named flat leaves.
+# ---------------------------------------------------------------------------
+
+
+def _path_name(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return ".".join(parts)
+
+
+def flatten_named(params) -> Dict[str, Any]:
+    """``{"embed": leaf, "layers.q": leaf, ...}`` in canonical tree order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {_path_name(path): leaf for path, leaf in flat}
+
+
+def _unflatten_like(params, named: Dict[str, Any]):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [named[_path_name(path)] for path, _ in flat])
+
+
+# ---------------------------------------------------------------------------
+# Pack (host, numpy).
+# ---------------------------------------------------------------------------
+
+
+def _quantize_leaf(d: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel (last axis) symmetric int8: returns (q, scale[C])."""
+    reduce_axes = tuple(range(d.ndim - 1))
+    peak = np.max(np.abs(d), axis=reduce_axes) if reduce_axes \
+        else np.abs(d)
+    scale = (peak / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.round(d / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def pack_params_delta(
+    base_params: Any,
+    word_params: Any,
+    *,
+    atol: float = 0.0,
+) -> Tuple[Dict[str, Dict[str, np.ndarray]], Dict[str, Any]]:
+    """Pack ``word − base`` per leaf; returns ``(payload, meta)``.
+
+    ``payload`` maps leaf name -> {"q", "scale"} (q8) or {"bits"} (xor);
+    ``zero`` leaves carry no payload.  The codec decision is made against
+    the APPLIED reconstruction: ``q8`` survives only when
+    ``cast(f32(base) + f32(q)·scale)`` is bit-identical to the word leaf in
+    the storage dtype — or, with ``atol > 0``, within that bound (recorded
+    per leaf in ``meta["quantized"]``; never a silent relaxation).  A leaf
+    is also kept ``q8`` only when it is smaller than its ``xor`` form, so
+    the codec never inflates the artifact to quantize a tiny leaf.
+    """
+    base = {k: np.asarray(v) for k, v in flatten_named(base_params).items()}
+    word = {k: np.asarray(v) for k, v in flatten_named(word_params).items()}
+    if set(base) != set(word):
+        raise ValueError(
+            f"base/word leaf sets differ: {sorted(set(base) ^ set(word))}")
+
+    payload: Dict[str, Dict[str, np.ndarray]] = {}
+    codecs: Dict[str, str] = {}
+    quantized: Dict[str, float] = {}
+    param_bytes = 0
+    delta_bytes = 0
+    for name in sorted(base):
+        b, w = base[name], word[name]
+        if b.shape != w.shape or b.dtype != w.dtype:
+            raise ValueError(
+                f"leaf {name}: base {b.shape}/{b.dtype} vs word "
+                f"{w.shape}/{w.dtype} — not deltas of one base")
+        param_bytes += w.nbytes
+        u = _uint_dtype(b.dtype)
+        bb, wb = b.view(u), w.view(u)
+        if np.array_equal(bb, wb):
+            codecs[name] = "zero"
+            continue
+        d = w.astype(np.float32) - b.astype(np.float32)
+        q, scale = _quantize_leaf(d)
+        recon = (b.astype(np.float32)
+                 + q.astype(np.float32) * scale).astype(b.dtype)
+        q8_bytes = q.nbytes + scale.nbytes
+        q8_ok = (q8_bytes < wb.nbytes
+                 and np.array_equal(recon.view(u), wb))
+        err = float(np.max(np.abs(recon.astype(np.float32)
+                                  - w.astype(np.float32))))
+        if q8_ok:
+            codecs[name] = "q8"
+            payload[name] = {"q": q, "scale": scale}
+            delta_bytes += q8_bytes
+        elif atol > 0.0 and q8_bytes < wb.nbytes and err <= atol:
+            codecs[name] = "q8"
+            payload[name] = {"q": q, "scale": scale}
+            quantized[name] = err
+            delta_bytes += q8_bytes
+        else:
+            codecs[name] = "xor"
+            bits = bb ^ wb
+            payload[name] = {"bits": bits}
+            delta_bytes += bits.nbytes
+
+    meta = {
+        "codec_version": DELTA_CODEC_VERSION,
+        "codecs": codecs,
+        "atol": float(atol),
+        "quantized": quantized,          # leaf -> measured max abs error
+        "shapes": {k: list(v.shape) for k, v in word.items()},
+        "dtypes": {k: str(v.dtype) for k, v in word.items()},
+        "param_bytes": int(param_bytes),
+        "delta_bytes": int(delta_bytes),
+    }
+    return payload, meta
+
+
+# ---------------------------------------------------------------------------
+# Artifact IO — the cache.py atomic-write idiom (tmp .npz + os.replace,
+# __meta__ JSON header riding inside the archive).
+# ---------------------------------------------------------------------------
+
+
+def delta_path(root: str, word: str) -> str:
+    return os.path.join(root, f"{word}.delta.npz")
+
+
+def save_delta(path: str, payload: Dict[str, Dict[str, np.ndarray]],
+               meta: Dict[str, Any]) -> int:
+    """Atomic write; returns the artifact's on-disk byte size."""
+    from taboo_brittleness_tpu.runtime import native_io, resilience
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for name, fields in payload.items():
+        for field, arr in fields.items():
+            # bfloat16-width bit planes are stored via their uint view; the
+            # npz layer only ever sees plain numpy dtypes.
+            arrays[f"{name}{_KEY_SEP}{field}"] = np.asarray(arr)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode("utf-8"), dtype=np.uint8)
+    # (".npz"-suffixed tmp name: numpy's savez fallback appends ".npz" to
+    # any other name and the rename would miss the real file — cache.py.)
+    tmp = f"{path}.tmp.npz"
+    native_io.save_npz(tmp, arrays)
+    os.replace(tmp, path)
+    resilience.fire("cache.write", path=path)
+    return os.path.getsize(path)
+
+
+def load_delta(path: str) -> Tuple[Dict[str, Dict[str, np.ndarray]],
+                                   Dict[str, Any]]:
+    """Read one delta artifact; raises on a version the codec cannot apply
+    (permanent — a retry cannot fix a format mismatch)."""
+    with np.load(path) as z:
+        if "__meta__" not in z:
+            raise ValueError(f"{path}: not a delta artifact (no __meta__)")
+        meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+        version = meta.get("codec_version")
+        if version != DELTA_CODEC_VERSION:
+            raise ValueError(
+                f"{path}: delta codec version {version} != supported "
+                f"{DELTA_CODEC_VERSION}")
+        payload: Dict[str, Dict[str, np.ndarray]] = {}
+        for key in z.files:
+            if key == "__meta__":
+                continue
+            name, _, field = key.rpartition(_KEY_SEP)
+            payload.setdefault(name, {})[field] = z[key]
+    return payload, meta
+
+
+def codecs_tuple(meta: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    """The jit-static form of the header's per-leaf codec map."""
+    return tuple(sorted(meta["codecs"].items()))
+
+
+# ---------------------------------------------------------------------------
+# In-graph apply.
+# ---------------------------------------------------------------------------
+
+
+def _jnp_uint(dtype) -> Any:
+    return jnp.dtype(_uint_dtype(np.dtype(dtype)))
+
+
+def reconstruct_named(
+    base_named: Dict[str, jax.Array],
+    payload: Dict[str, Dict[str, jax.Array]],
+    codecs: Tuple[Tuple[str, str], ...],
+) -> Dict[str, jax.Array]:
+    """Apply one word's delta to named base leaves (traced; shared by the
+    checkpoint-manager apply and the serve engine's per-word bank slice)."""
+    out = dict(base_named)
+    for name, codec in codecs:
+        if codec == "zero":
+            continue
+        b = base_named[name]
+        p = payload[name]
+        if codec == "xor":
+            u = _jnp_uint(b.dtype)
+            bits = lax.bitcast_convert_type(b, u) ^ p["bits"].astype(u)
+            out[name] = lax.bitcast_convert_type(bits, b.dtype)
+        elif codec == "q8":
+            d = p["q"].astype(jnp.float32) * p["scale"].astype(jnp.float32)
+            out[name] = (b.astype(jnp.float32) + d).astype(b.dtype)
+        else:
+            raise ValueError(f"unknown delta codec {codec!r} for leaf {name}")
+    return out
+
+
+def reconstruct_params(base_params, payload, codecs):
+    """Pytree form of :func:`reconstruct_named`."""
+    named = reconstruct_named(flatten_named(base_params), payload, codecs)
+    return _unflatten_like(base_params, named)
+
+
+@partial(jax.jit, static_argnames=("codecs",))
+def apply_delta(base, payload, *, codecs):
+    """ONE jitted program: base + packed delta -> full word params.
+
+    ``base`` is NOT donated — it stays resident for the next word.  The
+    payload's int8/bit-plane buffers cannot alias the float outputs either
+    (dtype mismatch; XLA rejects the donation with a warning), so nothing
+    is donated: the program's only allocations are the changed leaves.
+    Registered with the AOT registry (``delta.apply``) so every word switch
+    after the first is a dispatch against one warmed executable.
+    """
+    return reconstruct_params(base, payload, codecs)
+
+
+def apply_packed(base_params, payload: Dict[str, Dict[str, np.ndarray]],
+                 meta: Dict[str, Any], *, route: bool = True):
+    """Host entry: device the payload, apply through the AOT registry.
+
+    ``route=False`` takes the plain jit path (mesh-sharded bases — compiled
+    executables are specialized to shardings; see runtime/aot.py).
+    """
+    from taboo_brittleness_tpu.runtime import aot
+
+    codecs = codecs_tuple(meta)
+    dynamic = dict(base=base_params,
+                   payload=jax.tree_util.tree_map(jnp.asarray, payload))
+    static = dict(codecs=codecs)
+    if route and aot.enabled():
+        # Build-if-absent keeps the first switch's compile out of the miss
+        # counter; every later same-shape switch is a registry hit.
+        aot.entry("delta.apply", apply_delta).build(
+            dynamic, static, execute=False)
+    return aot.dispatch("delta.apply", apply_delta,
+                        dynamic=dynamic, static=static, route=route)
+
+
+# ---------------------------------------------------------------------------
+# Serve-side bank: W words stacked on a leading axis, one codec layout.
+# ---------------------------------------------------------------------------
+
+
+def stack_bank(
+    base_params: Any,
+    packed: Sequence[Tuple[Dict[str, Dict[str, np.ndarray]], Dict[str, Any]]],
+) -> Tuple[Tuple[Tuple[str, str], ...], Dict[str, Dict[str, np.ndarray]]]:
+    """Stack per-word payloads into a ``[W, ...]`` delta bank.
+
+    Words may disagree per leaf (one word's ``q8`` is another's ``zero``);
+    the bank needs ONE static codec layout so the serve step's scan slices a
+    uniform pytree.  Unification is exact:
+
+    - all-``zero`` leaves are dropped from the bank (base used directly);
+    - ``q8``+``zero`` mixes keep ``q8`` (a zero word gets ``q=0`` — the
+      identity-at-zero trick applied to weights);
+    - any mix involving ``xor`` coerces every word to ``xor`` (a q8 word's
+      bits come from its reconstructed leaf, so the coerced bank reproduces
+      the exact same leaf values the word's own codec would).
+    """
+    if not packed:
+        raise ValueError("stack_bank needs at least one packed word")
+    base = {k: np.asarray(v) for k, v in flatten_named(base_params).items()}
+    names = sorted(base)
+    for _, meta in packed:
+        if meta.get("codec_version") != DELTA_CODEC_VERSION:
+            raise ValueError("delta codec version mismatch in bank input")
+        missing = set(meta["codecs"]) ^ set(names)
+        if missing:
+            raise ValueError(f"bank leaf sets differ: {sorted(missing)}")
+
+    codecs: List[Tuple[str, str]] = []
+    bank: Dict[str, Dict[str, np.ndarray]] = {}
+    for name in names:
+        per_word = [meta["codecs"][name] for _, meta in packed]
+        kinds = set(per_word)
+        b = base[name]
+        u = _uint_dtype(b.dtype)
+        if kinds == {"zero"}:
+            codecs.append((name, "zero"))
+            continue
+        if kinds <= {"q8", "zero"}:
+            qs, scales = [], []
+            for payload, _ in packed:
+                fields = payload.get(name)
+                if fields is None:                      # zero word: identity
+                    qs.append(np.zeros(b.shape, np.int8))
+                    scales.append(np.ones(b.shape[-1:] or (1,),
+                                          np.float32)
+                                  if b.ndim else np.ones((), np.float32))
+                else:
+                    qs.append(fields["q"])
+                    scales.append(fields["scale"])
+            codecs.append((name, "q8"))
+            bank[name] = {"q": np.stack(qs), "scale": np.stack(scales)}
+            continue
+        # Coerce to xor: reconstruct each word's leaf bits exactly.
+        bits = []
+        for payload, _meta in packed:
+            codec = _meta["codecs"][name]
+            fields = payload.get(name)
+            if codec == "zero":
+                bits.append(np.zeros(b.shape, u))
+            elif codec == "xor":
+                bits.append(fields["bits"].astype(u, copy=False))
+            else:  # q8 -> exact word leaf -> xor bits
+                recon = (b.astype(np.float32)
+                         + fields["q"].astype(np.float32)
+                         * fields["scale"]).astype(b.dtype)
+                bits.append(b.view(u) ^ recon.view(u))
+        codecs.append((name, "xor"))
+        bank[name] = {"bits": np.stack(bits)}
+    return tuple(codecs), bank
+
+
+def bank_words(bank: Dict[str, Dict[str, np.ndarray]]) -> int:
+    """W, from any stacked leaf (0 for an empty bank — every word == base)."""
+    for fields in bank.values():
+        for arr in fields.values():
+            return int(arr.shape[0])
+    return 0

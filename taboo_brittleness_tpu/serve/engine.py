@@ -75,6 +75,7 @@ class SlotState(NamedTuple):
     latent_ids: jax.Array   # [S, m] int32 — SAE latents to ablate (-1 inert)
     basis: jax.Array        # [S, D, r] f32 — projection basis (0 inert)
     lens_target: jax.Array  # [S] int32 — lens readout token id (-1 off)
+    word_id: jax.Array      # [S] int32 — delta-bank word index (0 = first/base)
 
     @classmethod
     def zeros(cls, cfg: Gemma2Config, slots: int, prompt_cols: int,
@@ -92,6 +93,7 @@ class SlotState(NamedTuple):
             latent_ids=jnp.full((S, latent_slots), -1, jnp.int32),
             basis=jnp.zeros((S, cfg.hidden_size, proj_rank), jnp.float32),
             lens_target=jnp.full((S,), -1, jnp.int32),
+            word_id=jnp.zeros((S,), jnp.int32),
         )
 
 
@@ -126,40 +128,28 @@ def _serve_edit(h: jax.Array, idx: jax.Array, ep: Dict[str, Any]) -> jax.Array:
     return h
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
-                          "stop_ids"),
-         donate_argnames=("cache", "state"))
-def serve_step(
+def _forward_core(
     params: Params,
     cfg: Gemma2Config,
     sae: Optional[sae_ops.SAEParams],
     cache: KVCache,
     state: SlotState,
+    alive: jax.Array,
     *,
     sae_layer: int,
     proj_layer: int,
     tap_layer: int,
-    stop_ids: Tuple[int, ...] = STOP_IDS,
-) -> Tuple[KVCache, SlotState, StepOut]:
-    """Advance every live slot by one token — prefill and decode unified.
+) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """One forward over the slot batch under validity mask ``alive``:
+    (new cache, per-slot argmax [S], per-slot lens prob [S]).
 
-    Semantics per slot (S-wide, branch-free):
-
-    - feed ``input_tok`` at ``pos``; its K/V land at the slot's own column
-      ``pos`` (``cache_positions``);
-    - the forward's argmax becomes the slot's next input UNLESS the slot is
-      still inside its prompt, in which case the next prompt token does
-      (teacher-forced prefill at chunk size 1);
-    - a slot past its prompt EMITS the argmax; emitting a stop id or
-      exhausting ``max_gen`` finishes the session (the stop token itself is
-      kept, matching ``greedy_decode``);
-    - inactive/finished slots freeze: pad input, invalid attention, no
-      state advance — their cache rows stay masked and untouched.
+    Every per-slot output depends only on that slot's own inputs and cache
+    row (attention is per-row; the matmuls reduce over feature axes), so the
+    multi-word step below can run this per word with ``alive`` narrowed to
+    that word's slots and merge rows — bit-identical to a single-word engine
+    stepping those slots alone.
     """
     S = state.input_tok.shape[0]
-    alive = state.active & ~state.done
-
     ep: Dict[str, Any] = {
         "latent_ids": state.latent_ids,
         "basis": state.basis,
@@ -203,7 +193,19 @@ def serve_step(
         lambda _: jnp.zeros((S,), jnp.float32),
         (res.carry_tap, state.lens_target))
     lens_prob = jnp.where(lens_on, lens_prob, 0.0)
+    return res.cache, samp, lens_prob
 
+
+def _advance(
+    state: SlotState,
+    alive: jax.Array,
+    samp: jax.Array,
+    lens_prob: jax.Array,
+    stop_ids: Tuple[int, ...],
+) -> Tuple[SlotState, StepOut]:
+    """Slot bookkeeping after a forward: prompt teacher-forcing, emission,
+    stop/budget detection, freezes.  Pure [S]-wide data plumbing — shared
+    verbatim by the single-word and multi-word steps."""
     in_prompt = state.pos + 1 < state.prompt_len              # next tok forced
     next_from_prompt = jnp.take_along_axis(
         state.prompt_buf,
@@ -228,7 +230,125 @@ def serve_step(
     out = StepOut(
         tok=jnp.where(emitted, samp, chat.PAD_ID),
         emitted=emitted, finished=finished, lens_prob=lens_prob)
-    return res.cache, new_state, out
+    return new_state, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
+                          "stop_ids"),
+         donate_argnames=("cache", "state"))
+def serve_step(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    cache: KVCache,
+    state: SlotState,
+    *,
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+    stop_ids: Tuple[int, ...] = STOP_IDS,
+) -> Tuple[KVCache, SlotState, StepOut]:
+    """Advance every live slot by one token — prefill and decode unified.
+
+    Semantics per slot (S-wide, branch-free):
+
+    - feed ``input_tok`` at ``pos``; its K/V land at the slot's own column
+      ``pos`` (``cache_positions``);
+    - the forward's argmax becomes the slot's next input UNLESS the slot is
+      still inside its prompt, in which case the next prompt token does
+      (teacher-forced prefill at chunk size 1);
+    - a slot past its prompt EMITS the argmax; emitting a stop id or
+      exhausting ``max_gen`` finishes the session (the stop token itself is
+      kept, matching ``greedy_decode``);
+    - inactive/finished slots freeze: pad input, invalid attention, no
+      state advance — their cache rows stay masked and untouched.
+    """
+    alive = state.active & ~state.done
+    new_cache, samp, lens_prob = _forward_core(
+        params, cfg, sae, cache, state, alive,
+        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+    new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+    return new_cache, new_state, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "codecs", "sae_layer", "proj_layer",
+                          "tap_layer", "stop_ids"),
+         donate_argnames=("cache", "state"))
+def serve_step_multi(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    bank: Dict[str, Dict[str, jax.Array]],
+    cache: KVCache,
+    state: SlotState,
+    *,
+    codecs: Tuple[Tuple[str, str], ...],
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+    stop_ids: Tuple[int, ...] = STOP_IDS,
+) -> Tuple[KVCache, SlotState, StepOut]:
+    """``serve_step`` over MIXED-WORD traffic: base params + a stacked
+    ``[W, ...]`` delta bank, word identity per slot as data (ISSUE 12).
+
+    A ``lax.scan`` over the bank's word axis reconstructs word ``w``'s
+    params in-graph (``runtime.delta.reconstruct_params`` — exact by the
+    codec contract) and runs the IDENTICAL forward the single-word step
+    runs, with the validity mask narrowed to that word's slots; each word's
+    slot rows (cache K/V/valid, argmax, lens prob) are merged by mask.
+    Compute is W× the single-word step — the explicit price of holding one
+    base instead of W full checkpoints resident; slots of absent words
+    simply freeze.  Bit-exactness vs a single-word engine per slot follows
+    from the per-row independence documented on ``_forward_core``.
+
+    ``params`` (the resident base) and ``bank`` are NOT donated — they
+    persist across every step; ``cache``/``state`` advance in place.
+    """
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    alive = state.active & ~state.done
+
+    if not any(codec != "zero" for _, codec in codecs):
+        # Degenerate bank: every word bit-equals the base — one plain step.
+        new_cache, samp, lens_prob = _forward_core(
+            params, cfg, sae, cache, state, alive,
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+        return new_cache, new_state, out
+
+    W = next(arr.shape[0] for fields in bank.values()
+             for arr in fields.values())
+    S = state.input_tok.shape[0]
+    length0 = cache.length
+
+    def body(carry, word_slice):
+        cache_c, samp_acc, lens_acc = carry
+        w, payload_w = word_slice
+        sel = alive & (state.word_id == w)
+        params_w = deltalib.reconstruct_params(params, payload_w, codecs)
+        new_cache, samp, lens_prob = _forward_core(
+            params_w, cfg, sae, cache_c, state, sel,
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        sel_r = sel[None, :, None, None, None]
+        merged = KVCache(
+            k=jnp.where(sel_r, new_cache.k, cache_c.k),
+            v=jnp.where(sel_r, new_cache.v, cache_c.v),
+            valid=jnp.where(sel[:, None], new_cache.valid, cache_c.valid),
+            length=length0,           # advanced once, after the scan
+        )
+        return (merged,
+                jnp.where(sel, samp, samp_acc),
+                jnp.where(sel, lens_prob, lens_acc)), None
+
+    (new_cache, samp, lens_prob), _ = lax.scan(
+        body,
+        (cache, jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.float32)),
+        (jnp.arange(W, dtype=jnp.int32), bank))
+    new_cache = new_cache._replace(length=length0 + 1)
+    new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+    return new_cache, new_state, out
 
 
 @dataclasses.dataclass
@@ -257,7 +377,9 @@ class ServeEngine:
 
     def __init__(self, params: Params, cfg: Gemma2Config, tok, *,
                  engine_config: Optional[EngineConfig] = None,
-                 sae: Optional[sae_ops.SAEParams] = None):
+                 sae: Optional[sae_ops.SAEParams] = None,
+                 words: Sequence[str] = (),
+                 delta_bank: Optional[Tuple] = None):
         self.params = params
         self.cfg = cfg
         self.tok = tok
@@ -267,6 +389,27 @@ class ServeEngine:
             raise ValueError("prompt_cols must leave room to generate "
                              f"(prompt_cols={self.ec.prompt_cols} >= "
                              f"max_context={self.ec.max_context})")
+        # Mixed-word serving (ISSUE 12): ``params`` is the resident BASE and
+        # ``delta_bank`` the ``runtime.delta.stack_bank`` result — (codec
+        # layout, {leaf: stacked [W, ...] payload}) for ``words`` in order.
+        # Word identity then rides per slot as data (``SlotState.word_id``)
+        # through ONE compiled multi-word step.
+        self.words = tuple(words)
+        if delta_bank is not None and len(self.words) < 1:
+            raise ValueError("delta_bank requires the words it stacks")
+        if delta_bank is not None:
+            bank_codecs, bank = delta_bank
+            self.delta_codecs: Tuple[Tuple[str, str], ...] = tuple(bank_codecs)
+            self.delta_bank = jax.tree_util.tree_map(jnp.asarray, bank)
+        else:
+            self.delta_codecs = ()
+            self.delta_bank = None
+        self.multi = self.delta_bank is not None
+        #: AOT registry key of THIS engine's step program — the serve
+        #: summary's zero-recompile gate reads it instead of assuming the
+        #: single-word name.
+        self.aot_name = "serve.step.multi" if self.multi else "serve.step"
+        self._step_fn = serve_step_multi if self.multi else serve_step
         self.state = SlotState.zeros(
             cfg, self.ec.slots, self.ec.prompt_cols,
             self.ec.latent_slots, self.ec.proj_rank)
@@ -277,14 +420,20 @@ class ServeEngine:
     # -- program plumbing ---------------------------------------------------
 
     def _static(self) -> Dict[str, Any]:
-        return dict(cfg=self.cfg, sae_layer=self.ec.sae_layer,
-                    proj_layer=self.ec.proj_layer,
-                    tap_layer=self.ec.tap_layer,
-                    stop_ids=self.ec.stop_ids)
+        static = dict(cfg=self.cfg, sae_layer=self.ec.sae_layer,
+                      proj_layer=self.ec.proj_layer,
+                      tap_layer=self.ec.tap_layer,
+                      stop_ids=self.ec.stop_ids)
+        if self.multi:
+            static["codecs"] = self.delta_codecs
+        return static
 
     def _dynamic(self) -> Dict[str, Any]:
-        return dict(params=self.params, sae=self.sae,
-                    cache=self.cache, state=self.state)
+        dynamic = dict(params=self.params, sae=self.sae,
+                       cache=self.cache, state=self.state)
+        if self.multi:
+            dynamic["bank"] = self.delta_bank
+        return dynamic
 
     def warm_start(self) -> Dict[str, Any]:
         """Trace+compile the step program ahead of the first request (the
@@ -292,7 +441,7 @@ class ServeEngine:
         split and installs the executable, so every subsequent ``step()`` is
         a registry HIT and ``misses`` stays 0).  ``execute=False``: a warm-up
         execution would consume the donated state/cache buffers."""
-        entry = aot.entry("serve.step", serve_step)
+        entry = aot.entry(self.aot_name, self._step_fn)
         return entry.build(self._dynamic(), self._static(), execute=False)
 
     def step(self) -> StepOut:
@@ -310,14 +459,26 @@ class ServeEngine:
         """
         from taboo_brittleness_tpu.obs import profile as obs_profile
 
-        with obs_profile.annotate("serve.step", fn=serve_step):
+        with obs_profile.annotate(self.aot_name, fn=self._step_fn):
             self.cache, self.state, out = aot.dispatch(
-                "serve.step", serve_step,
+                self.aot_name, self._step_fn,
                 dynamic=self._dynamic(), static=self._static())
             self.steps += 1
             # tbx: TBX001-ok — host control point: the scheduler needs emitted/
             # finished flags each step to recycle slots (one [S]-wide pull).
             return jax.device_get(out)
+
+    # -- word identity ------------------------------------------------------
+
+    def word_index(self, word: Optional[str]) -> Optional[int]:
+        """Slot ``word_id`` for a request's word, or None = unknown here
+        (the scheduler rejects those at submit).  ``None`` requests serve
+        word 0 — a single-word engine's only resident checkpoint."""
+        if word is None:
+            return 0
+        if word in self.words:
+            return self.words.index(word) if self.multi else 0
+        return None
 
     # -- admission / recycle ------------------------------------------------
 
@@ -333,7 +494,8 @@ class ServeEngine:
               max_new: int,
               latent_ids: Sequence[int] = (),
               basis: Optional[np.ndarray] = None,
-              lens_target: int = -1) -> None:
+              lens_target: int = -1,
+              word_id: int = 0) -> None:
         """Install a session into ``slot``: write its prompt page, its
         intervention rows, and invalidate the slot's KV row.  The first
         prompt token becomes the slot's next input at position 0."""
@@ -346,6 +508,9 @@ class ServeEngine:
         if len(latent_ids) > self.ec.latent_slots:
             raise ValueError(f"{len(latent_ids)} latents > latent_slots="
                              f"{self.ec.latent_slots}")
+        if word_id < 0 or (self.multi and word_id >= len(self.words)):
+            raise ValueError(f"word_id={word_id} outside the engine's "
+                             f"{len(self.words)}-word bank")
         ids = np.asarray(list(prompt_ids), np.int32)
         buf = np.zeros((P,), np.int32)
         buf[:n] = ids
@@ -373,6 +538,7 @@ class ServeEngine:
             latent_ids=s.latent_ids.at[slot].set(jnp.asarray(lat)),
             basis=s.basis.at[slot].set(jnp.asarray(bas)),
             lens_target=s.lens_target.at[slot].set(int(lens_target)),
+            word_id=s.word_id.at[slot].set(int(word_id)),
         )
         # Recycle the KV page: the row's stale columns must never attend.
         self.cache = self.cache._replace(

@@ -63,12 +63,15 @@ def build_schedule(
     mix: Dict[str, float],
     scenarios: Dict[str, Scenario],
     prompts: Sequence[str],
+    words: Optional[Sequence[str]] = None,
 ) -> List[Tuple[float, Request]]:
     """The seeded arrival plan: [(arrival_offset_seconds, Request)].
 
-    Deterministic given (seed, rate, mix, prompts): the same plan replays
-    byte-identically, so a latency regression between rounds is the server's,
-    not the generator's.
+    Deterministic given (seed, rate, mix, prompts, words): the same plan
+    replays byte-identically, so a latency regression between rounds is the
+    server's, not the generator's.  ``words`` (multi-word serving, ISSUE 12)
+    round-robins the taboo word per request — uniform mixed-word traffic
+    against one resident server.
     """
     rng = random.Random(f"loadgen:{seed}")
     names = sorted(mix)
@@ -78,11 +81,13 @@ def build_schedule(
     for i in range(n_requests):
         t += rng.expovariate(rate) if rate > 0 else 0.0
         name = rng.choices(names, weights=weights, k=1)[0]
+        word = words[i % len(words)] if words else None
         out.append((t, Request(
             id=f"r{i:04d}-{name}",
             prompt=prompts[i % len(prompts)],
             scenario=scenarios[name],
-            seed=seed * 10_000 + i)))
+            seed=seed * 10_000 + i,
+            word=word)))
     return out
 
 
@@ -118,6 +123,7 @@ def run_inprocess(
     mix: Optional[Dict[str, float]] = None,
     scenarios: Optional[Dict[str, Scenario]] = None,
     prompts: Sequence[str] = ("Give me a hint",),
+    words: Optional[Sequence[str]] = None,
     lens_target_id: int = -1,
     queue_limit: int = 64,
     clock: Callable[[], float] = time.monotonic,
@@ -127,7 +133,7 @@ def run_inprocess(
     scenarios = scenarios or default_scenarios()
     mix = mix or {name: 1.0 for name in scenarios}
     plan = build_schedule(n_requests, seed=seed, rate=rate, mix=mix,
-                          scenarios=scenarios, prompts=prompts)
+                          scenarios=scenarios, prompts=prompts, words=words)
     sched = SlotScheduler(engine, queue_limit=queue_limit,
                           lens_target_id=lens_target_id, clock=clock)
     engine.warm_start()
@@ -180,6 +186,7 @@ def run_spool(
     mix: Optional[Dict[str, float]] = None,
     scenarios: Optional[Dict[str, Scenario]] = None,
     prompts: Sequence[str] = ("Give me a hint",),
+    words: Optional[Sequence[str]] = None,
     timeout_s: float = 300.0,
     poll_s: float = 0.02,
     clock: Callable[[], float] = time.monotonic,
@@ -194,7 +201,7 @@ def run_spool(
     mix = mix or {name: 1.0 for name in scenarios}
     spool = RequestSpool(spool_dir)
     plan = build_schedule(n_requests, seed=seed, rate=rate, mix=mix,
-                          scenarios=scenarios, prompts=prompts)
+                          scenarios=scenarios, prompts=prompts, words=words)
 
     lat: Dict[str, List[float]] = {}
     submit_at: Dict[str, float] = {}
@@ -210,7 +217,8 @@ def run_spool(
             _, req = pending.pop(0)
             rid = spool.put({"id": req.id, "prompt": req.prompt,
                              "scenario": req.scenario.name,
-                             "seed": req.seed})
+                             "seed": req.seed,
+                             **({"word": req.word} if req.word else {})})
             submit_at[rid] = clock()
             scenario_of[rid] = req.scenario.name
             awaiting.append(rid)
@@ -243,11 +251,41 @@ def run_spool(
 # ---------------------------------------------------------------------------
 
 
+def synthetic_word_params(cfg, base_params, word: str, *, seed: int = 7):
+    """A deterministic per-word 'finetune' of ``base_params``: a few leaves
+    perturbed by noise seeded from the WORD ITSELF — identical across
+    processes, so a loadgen client and a serve subprocess agree on what word
+    "ship" means without shipping arrays.  Touching only a subset of leaves
+    leaves the rest bit-equal to base — exactly the sparse-delta structure
+    ``runtime.delta`` exploits (zero codec for untouched leaves)."""
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+
+    targets = ("embed", "final_norm", "layers.gate")
+    key = jax.random.PRNGKey(
+        (seed * 1_000_003 + zlib.crc32(word.encode("utf-8"))) & 0x7FFFFFFF)
+
+    def mod(path, leaf):
+        name = ".".join(str(getattr(k, "key", k)) for k in path)
+        if name not in targets:
+            return leaf
+        k = jax.random.fold_in(key, targets.index(name))
+        noise = 0.02 * jax.random.normal(k, leaf.shape, jnp.float32)
+        return (leaf.astype(jnp.float32) + noise).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(mod, base_params)
+
+
 def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
-                           max_new_tokens: int = 6):
+                           max_new_tokens: int = 6,
+                           word: Optional[str] = None):
     """Tiny-model engine for hermetic runs: gemma2_tiny + WordTokenizer +
     a small random SAE — the same stack the supervised-execution e2e uses.
-    Returns (engine, scenarios, lens_target_id)."""
+    Returns (engine, scenarios, lens_target_id).  ``word`` swaps in that
+    word's :func:`synthetic_word_params` finetune — the single-word
+    reference arm the multi-word bit-for-bit tests compare against."""
     import jax
 
     from taboo_brittleness_tpu.models import gemma2
@@ -258,6 +296,8 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
 
     cfg = gemma2.PRESETS["gemma2_tiny"]
     params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
+    if word is not None:
+        params = synthetic_word_params(cfg, params, word, seed=seed)
     words = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
              "Give", "me", "a", "the", "about"]
     tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
@@ -270,7 +310,49 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
             slots=slots, max_context=48, prompt_cols=24,
             latent_slots=4, proj_rank=2,
             sae_layer=tap, proj_layer=tap, tap_layer=tap),
-        sae=sae)
+        sae=sae, words=(word,) if word is not None else ())
+    scenarios = default_scenarios(max_new_tokens=max_new_tokens,
+                                  ablate_latents=(0, 1, 2, 3), proj_rank=2)
+    return engine, scenarios, target_token_id(tok, "ship")
+
+
+def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
+                                 slots: int = 4, seed: int = 7,
+                                 max_new_tokens: int = 6):
+    """The multi-word arm: ONE engine holding the synthetic base plus a
+    stacked delta bank for ``words`` (each word's params =
+    :func:`synthetic_word_params`, packed exactly).  Same tokenizer, SAE,
+    scenarios and envelope as :func:`build_synthetic_engine`, so per-word
+    responses are comparable bit-for-bit against the single-word arm.
+    Returns (engine, scenarios, lens_target_id)."""
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+    from taboo_brittleness_tpu.runtime.tokenizer import (
+        WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    base = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
+    packed = [deltalib.pack_params_delta(
+        base, synthetic_word_params(cfg, base, w, seed=seed))
+        for w in words]
+    bank = deltalib.stack_bank(base, packed)
+    vocab = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+             "Give", "me", "a", "the", "about"]
+    tok = WordTokenizer(vocab, vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(seed + 1),
+                              cfg.hidden_size, 64)
+    tap = min(2, cfg.num_layers - 1)
+    engine = ServeEngine(
+        base, cfg, tok,
+        engine_config=EngineConfig(
+            slots=slots, max_context=48, prompt_cols=24,
+            latent_slots=4, proj_rank=2,
+            sae_layer=tap, proj_layer=tap, tap_layer=tap),
+        sae=sae, words=tuple(words), delta_bank=bank)
     scenarios = default_scenarios(max_new_tokens=max_new_tokens,
                                   ablate_latents=(0, 1, 2, 3), proj_rank=2)
     return engine, scenarios, target_token_id(tok, "ship")

@@ -99,6 +99,7 @@ class Request:
     scenario: Scenario
     seed: int = 0
     submitted_at: float = 0.0      # monotonic; stamped by submit()
+    word: Optional[str] = None     # taboo word; None = the engine's default
 
 
 @dataclasses.dataclass
@@ -106,6 +107,7 @@ class Response:
     id: str
     scenario: str
     ok: bool
+    word: Optional[str] = None
     text: str = ""
     tokens: List[int] = dataclasses.field(default_factory=list)
     finish: str = ""               # eos | budget | quarantined
@@ -184,6 +186,15 @@ class SlotScheduler:
                       scenario=req.scenario.name,
                       reason="draining" if self.draining else "queue-full")
             return False
+        if self.engine.word_index(req.word) is None:
+            # Admission is by (word, scenario): a word this engine does not
+            # hold resident is an explicit rejection, not a silent default.
+            self.rejected += 1
+            obs_metrics.counter("serve.rejected").inc()
+            obs.event("serve.reject", request=req.id,
+                      scenario=req.scenario.name, word=req.word,
+                      reason="unknown-word")
+            return False
         ids = self._encode(req)
         if not self.engine.capacity_ok(len(ids), req.scenario.max_new_tokens):
             self.rejected += 1
@@ -236,12 +247,14 @@ class SlotScheduler:
             req = self._queue.popleft()
             now = self._clock()
             sc = req.scenario
+            word_id = self.engine.word_index(req.word)
             self.engine.admit(
                 slot, self._encode(req),
                 max_new=sc.max_new_tokens,
                 latent_ids=sc.ablate_latents,
                 basis=self._basis(req),
-                lens_target=(self.lens_target_id if sc.lens_readout else -1))
+                lens_target=(self.lens_target_id if sc.lens_readout else -1),
+                word_id=0 if word_id is None else word_id)
             self._sessions[slot] = _Session(request=req, slot=slot,
                                             admitted_at=now)
             self.admitted += 1
@@ -249,7 +262,8 @@ class SlotScheduler:
             obs_metrics.counter("serve.admitted").inc()
             obs_metrics.histogram("serve.queue_wait").observe(queue_wait)
             obs.event("serve.admit", request=req.id, slot=slot,
-                      scenario=sc.name, queue_seconds=round(queue_wait, 4))
+                      scenario=sc.name, queue_seconds=round(queue_wait, 4),
+                      **({"word": req.word} if req.word else {}))
         obs_metrics.gauge("serve.in_flight").set(len(self._sessions))
         obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
 
@@ -306,7 +320,7 @@ class SlotScheduler:
         req = sess.request
         ok = exc is None
         resp = Response(
-            id=req.id, scenario=req.scenario.name, ok=ok,
+            id=req.id, scenario=req.scenario.name, ok=ok, word=req.word,
             text=self.engine.tok.decode(sess.tokens) if sess.tokens else "",
             tokens=list(sess.tokens), finish=finish, steps=sess.steps,
             queue_seconds=round(sess.admitted_at - req.submitted_at, 6),
@@ -328,6 +342,7 @@ class SlotScheduler:
                   scenario=req.scenario.name, finish=finish,
                   steps=sess.steps, ok=ok,
                   latency_seconds=resp.latency_seconds,
+                  **({"word": req.word} if req.word else {}),
                   **({"error": resp.error} if resp.error else {}))
         if self.on_complete is not None:
             self.on_complete(resp)

@@ -18,8 +18,11 @@ Layout under ``<output_dir>``::
     _serve.json                    exit summary incl. AOT step-program stats
 
 Request schema: ``{"id": str, "prompt": str, "scenario": str,
-"seed": int?, "max_new_tokens": int?}`` — ``scenario`` names an entry of the
-server's scenario table (``scheduler.default_scenarios``).
+"seed": int?, "max_new_tokens": int?, "word": str?}`` — ``scenario`` names
+an entry of the server's scenario table (``scheduler.default_scenarios``);
+``word`` selects one of a multi-word engine's resident taboo words (absent =
+the engine's default; a word the engine does not hold is rejected
+explicitly).
 
 Lifecycle contracts:
 
@@ -176,9 +179,11 @@ def _to_request(payload: Dict[str, Any],
     max_new = payload.get("max_new_tokens")
     if max_new is not None:
         sc = dataclasses.replace(sc, max_new_tokens=int(max_new))
+    word = payload.get("word")
     return Request(id=str(payload.get("id") or uuid.uuid4().hex[:12]),
                    prompt=str(payload.get("prompt", "")),
-                   scenario=sc, seed=int(payload.get("seed", 0) or 0))
+                   scenario=sc, seed=int(payload.get("seed", 0) or 0),
+                   word=str(word) if word is not None else None)
 
 
 def serve_forever(
@@ -290,7 +295,7 @@ def serve_forever(
             "admitted": sched.admitted,
             "rejected": sched.rejected,
             "quarantined": sched.quarantined,
-            "aot": _step_program_stats(),
+            "aot": _step_program_stats(engine),
         }
         try:
             atomic_json_dump(summary,
@@ -314,7 +319,11 @@ def serve_forever(
                        steps=engine.steps)
 
 
-def _step_program_stats() -> Dict[str, Any]:
+def _step_program_stats(engine: ServeEngine) -> Dict[str, Any]:
     from taboo_brittleness_tpu.runtime import aot
 
-    return dict(aot.stats().get("serve.step", {}))
+    # The engine names its own step program ("serve.step" single-word,
+    # "serve.step.multi" delta-bank) — read whichever this engine ran so
+    # the zero-recompile gate follows the program it actually dispatched.
+    return dict(aot.stats().get(getattr(engine, "aot_name", "serve.step"),
+                                {}))

@@ -1,0 +1,449 @@
+"""Base-resident delta checkpoints (runtime/delta.py, ISSUE 12).
+
+Covers: the per-leaf codec round trips (zero / q8 / xor) and the artifact
+format (version gate, atomic write); the EXACTNESS GATE — delta-packed-then-
+applied params produce bit-identical decode tokens and lens probabilities vs
+the full checkpoint across none/SAE/projection scenarios; the serve-side
+bank unification; and the CheckpointManager residency satellites
+(``resolve_snapshot_dir`` fixes, delta mode, capacity > 1 LRU semantics).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from taboo_brittleness_tpu.config import ModelConfig
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.runtime import checkpoints as ck
+from taboo_brittleness_tpu.runtime import delta as deltalib
+from taboo_brittleness_tpu.serve.loadgen import synthetic_word_params
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    base = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, base
+
+
+def _bits_equal(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    if a.dtype != b.dtype or a.shape != b.shape:
+        return False
+    u = deltalib._uint_dtype(a.dtype) if a.dtype.kind not in "iub" else None
+    return np.array_equal(a.view(u) if u else a, b.view(u) if u else b)
+
+
+def _assert_params_bit_equal(got, want):
+    g = deltalib.flatten_named(got)
+    w = deltalib.flatten_named(want)
+    assert set(g) == set(w)
+    for name in w:
+        assert _bits_equal(g[name], w[name]), name
+
+
+# ---------------------------------------------------------------------------
+# Codec round trips.
+# ---------------------------------------------------------------------------
+
+def test_pack_apply_round_trip_mixed_codecs(tiny):
+    cfg, base = tiny
+    word = synthetic_word_params(cfg, base, "ship")
+    payload, meta = deltalib.pack_params_delta(base, word)
+    kinds = set(meta["codecs"].values())
+    # synthetic finetunes touch 3 leaves and leave the rest bit-equal — the
+    # sparse structure the delta exists for.
+    assert "zero" in kinds and kinds <= {"zero", "q8", "xor"}
+    assert meta["delta_bytes"] < meta["param_bytes"]
+    assert meta["quantized"] == {}          # no atol -> nothing lossy
+    applied = deltalib.apply_packed(base, payload, meta, route=False)
+    _assert_params_bit_equal(applied, word)
+
+
+def test_pack_base_against_itself_is_all_zero(tiny):
+    cfg, base = tiny
+    payload, meta = deltalib.pack_params_delta(base, base)
+    assert set(meta["codecs"].values()) == {"zero"}
+    assert payload == {} and meta["delta_bytes"] == 0
+    applied = deltalib.apply_packed(base, payload, meta, route=False)
+    _assert_params_bit_equal(applied, base)
+
+
+def test_q8_exact_acceptance():
+    # Deltas crafted as m * 2^-12 with max |m| = 127: the per-channel scale
+    # is exactly 2^-12, q recovers m exactly, and the f32 reconstruction is
+    # bit-exact — the q8 codec must accept WITHOUT any atol relaxation.
+    rng = np.random.default_rng(0)
+    m = rng.integers(-127, 128, size=(16, 8)).astype(np.float32)
+    m[0, :] = 127.0                                   # peak pins the scale
+    base = {"w": np.zeros((16, 8), np.float32)}
+    word = {"w": (m * 2.0 ** -12).astype(np.float32)}
+    payload, meta = deltalib.pack_params_delta(base, word)
+    assert meta["codecs"] == {"w": "q8"}
+    assert meta["quantized"] == {}
+    np.testing.assert_array_equal(payload["w"]["scale"],
+                                  np.full((8,), 2.0 ** -12, np.float32))
+    applied = deltalib.apply_packed(base, payload, meta, route=False)
+    _assert_params_bit_equal(applied, word)
+
+
+def test_q8_lossy_needs_explicit_atol_and_is_recorded():
+    rng = np.random.default_rng(1)
+    base = {"w": np.zeros((64, 8), np.float32)}
+    word = {"w": rng.standard_normal((64, 8)).astype(np.float32)}
+
+    # Without atol a non-exact quantization falls back to the exact codec.
+    _, exact_meta = deltalib.pack_params_delta(base, word)
+    assert exact_meta["codecs"] == {"w": "xor"}
+
+    payload, meta = deltalib.pack_params_delta(base, word, atol=1.0)
+    assert meta["codecs"] == {"w": "q8"}
+    err = meta["quantized"]["w"]            # never silent: bound on record
+    assert 0.0 < err <= 1.0
+    applied = deltalib.apply_packed(base, payload, meta, route=False)
+    got = np.asarray(deltalib.flatten_named(applied)["w"])
+    assert float(np.max(np.abs(got - word["w"]))) <= err + 1e-7
+
+
+def test_pack_rejects_mismatched_trees(tiny):
+    base = {"a": np.zeros((2,), np.float32)}
+    with pytest.raises(ValueError, match="leaf sets differ"):
+        deltalib.pack_params_delta(base, {"a": base["a"], "b": base["a"]})
+    with pytest.raises(ValueError, match="not deltas of one base"):
+        deltalib.pack_params_delta(base, {"a": np.zeros((3,), np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# Artifact: version gate, atomic write.
+# ---------------------------------------------------------------------------
+
+def _packed_tiny(tiny, word="ship"):
+    cfg, base = tiny
+    return deltalib.pack_params_delta(
+        base, synthetic_word_params(cfg, base, word))
+
+
+def test_save_load_round_trip_and_version_gate(tiny, tmp_path):
+    payload, meta = _packed_tiny(tiny)
+    path = deltalib.delta_path(str(tmp_path), "ship")
+    size = deltalib.save_delta(path, payload, meta)
+    assert size == os.path.getsize(path) > 0
+    payload2, meta2 = deltalib.load_delta(path)
+    assert meta2 == meta
+    assert set(payload2) == set(payload)
+    for name, fields in payload.items():
+        for field, arr in fields.items():
+            np.testing.assert_array_equal(payload2[name][field],
+                                          np.asarray(arr))
+
+    # An artifact from a future codec is a PERMANENT error, not garbage out.
+    bad = dict(meta, codec_version=deltalib.DELTA_CODEC_VERSION + 1)
+    bad_path = deltalib.delta_path(str(tmp_path), "future")
+    deltalib.save_delta(bad_path, payload, bad)
+    with pytest.raises(ValueError, match="codec version"):
+        deltalib.load_delta(bad_path)
+
+    # A random npz is not a delta artifact.
+    np.savez(str(tmp_path / "junk.npz"), x=np.zeros(3))
+    with pytest.raises(ValueError, match="__meta__"):
+        deltalib.load_delta(str(tmp_path / "junk.npz"))
+
+
+def test_save_delta_is_atomic(tiny, tmp_path, monkeypatch):
+    payload, meta = _packed_tiny(tiny)
+    path = deltalib.delta_path(str(tmp_path), "ship")
+
+    def boom(src, dst):
+        raise OSError("simulated crash at publish")
+
+    monkeypatch.setattr(deltalib.os, "replace", boom)
+    with pytest.raises(OSError):
+        deltalib.save_delta(path, payload, meta)
+    assert not os.path.exists(path)         # no torn artifact at the target
+    monkeypatch.undo()
+
+    deltalib.save_delta(path, payload, meta)
+    assert os.path.exists(path)
+    assert [n for n in os.listdir(tmp_path) if ".tmp" in n] == []
+
+
+# ---------------------------------------------------------------------------
+# THE EXACTNESS GATE: applied params == full checkpoint, observably.
+# ---------------------------------------------------------------------------
+
+def test_delta_applied_matches_full_checkpoint_decode_and_lens(tiny):
+    """Delta-packed-then-applied params must yield bit-identical decode
+    tokens AND lens probabilities vs the full checkpoint, across the study's
+    intervention scenarios (none / SAE ablation / projection removal)."""
+    from taboo_brittleness_tpu.ops import lens as lens_ops
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.pipelines.interventions import (
+        projection_edit, sae_ablation_edit)
+    from taboo_brittleness_tpu.runtime import decode
+
+    cfg, base = tiny
+    word = synthetic_word_params(cfg, base, "ship")
+    payload, meta = deltalib.pack_params_delta(base, word)
+    applied = deltalib.apply_packed(base, payload, meta, route=False)
+
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(1, cfg.vocab_size, size=n)) for n in (4, 6)]
+    padded, valid, pos = decode.pad_prompts(prompts)
+    args = (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(pos))
+    tap = min(2, cfg.num_layers - 1)
+    sae = sae_ops.init_random(jax.random.PRNGKey(8), cfg.hidden_size, 64)
+    basis, _ = np.linalg.qr(rng.standard_normal((cfg.hidden_size, 2)))
+    scenarios = {
+        "none": {},
+        "sae_ablation": dict(
+            edit_fn=sae_ablation_edit,
+            edit_params={"sae": sae, "layer": tap,
+                         "latent_ids": jnp.asarray([0, 1], jnp.int32)}),
+        "projection": dict(
+            edit_fn=projection_edit,
+            edit_params={"basis": jnp.asarray(basis, jnp.float32),
+                         "layer": tap}),
+    }
+    targets = jnp.zeros((len(prompts),), jnp.int32)
+    for name, kw in scenarios.items():
+        full = decode.greedy_decode(word, cfg, *args, max_new_tokens=4, **kw)
+        got = decode.greedy_decode(applied, cfg, *args, max_new_tokens=4,
+                                   **kw)
+        np.testing.assert_array_equal(np.asarray(full.tokens),
+                                      np.asarray(got.tokens),
+                                      err_msg=f"tokens diverge: {name}")
+        seq_valid = full.sequence_valid
+        lens_pos = jnp.maximum(jnp.cumsum(seq_valid, axis=1) - 1, 0)
+
+        def lens_probs(p):
+            res = lens_ops.lens_forward(
+                p, cfg, full.sequences, targets, tap_layer=tap, top_k=3,
+                positions=lens_pos, attn_validity=seq_valid)
+            return np.asarray(res.tap.target_prob)
+
+        np.testing.assert_array_equal(lens_probs(word), lens_probs(applied),
+                                      err_msg=f"lens probs diverge: {name}")
+
+
+# ---------------------------------------------------------------------------
+# Serve-side bank unification.
+# ---------------------------------------------------------------------------
+
+def test_stack_bank_reconstructs_each_word(tiny):
+    cfg, base = tiny
+    words = ("ship", "moon", "glass")
+    packed = [deltalib.pack_params_delta(
+        base, synthetic_word_params(cfg, base, w)) for w in words]
+    codecs, bank = deltalib.stack_bank(base, packed)
+    assert deltalib.bank_words(bank) == len(words)
+    # all-zero leaves are dropped: the bank holds only changed leaves
+    changed = {n for n, c in codecs if c != "zero"}
+    assert set(bank) == changed and changed
+    for i, w in enumerate(words):
+        word_payload = jax.tree_util.tree_map(lambda a: a[i], bank)
+        recon = deltalib.reconstruct_params(base, word_payload, codecs)
+        _assert_params_bit_equal(
+            recon, synthetic_word_params(cfg, base, w))
+
+
+def test_stack_bank_q8_zero_mix_uses_identity_rows():
+    rng = np.random.default_rng(2)
+    m = rng.integers(-127, 128, size=(8, 4)).astype(np.float32)
+    m[0, :] = 127.0
+    base = {"w": np.zeros((8, 4), np.float32)}
+    q8_word = {"w": (m * 2.0 ** -12).astype(np.float32)}
+    packed = [deltalib.pack_params_delta(base, q8_word),
+              deltalib.pack_params_delta(base, base)]      # zero word
+    codecs, bank = deltalib.stack_bank(base, packed)
+    assert dict(codecs)["w"] == "q8"
+    np.testing.assert_array_equal(bank["w"]["q"][1],
+                                  np.zeros((8, 4), np.int8))
+    for i, word in enumerate((q8_word, base)):
+        recon = deltalib.reconstruct_params(
+            base, jax.tree_util.tree_map(lambda a: a[i], bank), codecs)
+        _assert_params_bit_equal(recon, word)
+
+
+def test_stack_bank_xor_mix_coerces_exactly():
+    rng = np.random.default_rng(3)
+    m = rng.integers(-127, 128, size=(8, 4)).astype(np.float32)
+    m[0, :] = 127.0
+    base = {"w": np.zeros((8, 4), np.float32)}
+    q8_word = {"w": (m * 2.0 ** -12).astype(np.float32)}
+    xor_word = {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+    packed = [deltalib.pack_params_delta(base, q8_word),
+              deltalib.pack_params_delta(base, xor_word)]
+    assert packed[0][1]["codecs"] == {"w": "q8"}
+    assert packed[1][1]["codecs"] == {"w": "xor"}
+    codecs, bank = deltalib.stack_bank(base, packed)
+    assert dict(codecs)["w"] == "xor"       # one static layout, exact
+    for i, word in enumerate((q8_word, xor_word)):
+        recon = deltalib.reconstruct_params(
+            base, jax.tree_util.tree_map(lambda a: a[i], bank), codecs)
+        _assert_params_bit_equal(recon, word)
+
+
+# ---------------------------------------------------------------------------
+# resolve_snapshot_dir satellites.
+# ---------------------------------------------------------------------------
+
+def _mk_snapshot(path):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        f.write("{}")
+
+
+def test_resolve_snapshot_multi_hyphen_word(tmp_path, monkeypatch):
+    monkeypatch.delenv("TABOO_CHECKPOINT_ROOT", raising=False)
+    root = str(tmp_path / "ckpts")
+    _mk_snapshot(os.path.join(root, "cream"))       # would shadow below
+    _mk_snapshot(os.path.join(root, "ice-cream"))
+    got = ck.resolve_snapshot_dir(
+        "bcywinski/gemma-2-9b-it-taboo-ice-cream", root)
+    assert os.path.basename(got) == "ice-cream"     # longest suffix wins
+    # single-token words still resolve by bare word
+    _mk_snapshot(os.path.join(root, "ship"))
+    got = ck.resolve_snapshot_dir("bcywinski/gemma-2-9b-it-taboo-ship", root)
+    assert os.path.basename(got) == "ship"
+
+
+def test_resolve_snapshot_honors_hf_hub_cache(tmp_path, monkeypatch):
+    monkeypatch.delenv("TABOO_CHECKPOINT_ROOT", raising=False)
+    hub = str(tmp_path / "my-hub-cache")
+    snap = os.path.join(hub, "models--google--gemma-2-9b-it",
+                        "snapshots", "abc123")
+    _mk_snapshot(snap)
+    monkeypatch.setenv("HF_HUB_CACHE", hub)
+    assert ck.resolve_snapshot_dir("google/gemma-2-9b-it") == snap
+    monkeypatch.delenv("HF_HUB_CACHE")
+    monkeypatch.setenv("HF_HOME", str(tmp_path / "nowhere"))
+    with pytest.raises(FileNotFoundError):
+        ck.resolve_snapshot_dir("google/gemma-2-9b-it")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: delta residency mode.
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_manager_delta_mode_streams_base_once(
+        tiny, tmp_path, monkeypatch):
+    cfg, base = tiny
+    words = ("ship", "moon")
+    for w in words:
+        payload, meta = deltalib.pack_params_delta(
+            base, synthetic_word_params(cfg, base, w))
+        deltalib.save_delta(deltalib.delta_path(str(tmp_path), w),
+                            payload, meta)
+
+    streams = []
+    monkeypatch.setattr(ck, "resolve_snapshot_dir",
+                        lambda repo_id, root=None: "/base-snap")
+    monkeypatch.setattr(ck, "infer_config_from_hf_config_json",
+                        lambda snap, **kw: cfg)
+
+    def fake_stream(snap, c, mesh=None):
+        streams.append(snap)
+        return base
+
+    monkeypatch.setattr(ck, "from_safetensors_dir_streamed", fake_stream)
+    monkeypatch.setattr(ck.HFTokenizer, "from_pretrained",
+                        staticmethod(lambda snap: "base-tok"))
+
+    mgr = ck.CheckpointManager(ModelConfig(), capacity=2,
+                               delta_root=str(tmp_path))
+    for w in words:
+        params, got_cfg, tok = mgr.load(w)
+        assert got_cfg is cfg and tok == "base-tok"
+        _assert_params_bit_equal(params, synthetic_word_params(cfg, base, w))
+    # the 18.5 GB read happened ONCE; word loads streamed only deltas
+    assert streams == ["/base-snap"]
+    # a word with no delta artifact is a load error, not silence
+    with pytest.raises(FileNotFoundError):
+        mgr.load("nowhere")
+
+
+def test_checkpoint_manager_delta_env_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("TBX_DELTA", raising=False)
+    monkeypatch.delenv("TBX_DELTA_ROOT", raising=False)
+    assert ck.CheckpointManager(ModelConfig()).delta_root is None
+    monkeypatch.setenv("TBX_DELTA_ROOT", str(tmp_path))
+    assert ck.CheckpointManager(ModelConfig()).delta_root is None
+    monkeypatch.setenv("TBX_DELTA", "1")
+    mgr = ck.CheckpointManager(ModelConfig())
+    assert mgr.delta_root == str(tmp_path)
+    assert mgr.base_id == ck.DEFAULT_DELTA_BASE
+    monkeypatch.setenv("TBX_DELTA_BASE", "org/other-base")
+    assert ck.CheckpointManager(ModelConfig()).base_id == "org/other-base"
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: capacity > 1 (LRU ordering, prefetch interplay).
+# ---------------------------------------------------------------------------
+
+def _stub_mgr(monkeypatch, capacity):
+    mgr = ck.CheckpointManager(ModelConfig(), capacity=capacity)
+    calls = []
+
+    def fake_load(word):
+        calls.append(word)
+        return (f"params-{word}", "cfg", "tok")
+
+    monkeypatch.setattr(mgr, "_load_triple", fake_load)
+    return mgr, calls
+
+
+def test_lru_eviction_ordering_under_interleaved_load_prefetch(monkeypatch):
+    mgr, calls = _stub_mgr(monkeypatch, capacity=2)
+    mgr.load("a")
+    mgr.load("b")                  # cache (old -> new): a, b
+    mgr.load("a")                  # touch: b, a
+    mgr.prefetch("c")
+    mgr.load("c")                  # evicts b (LRU), keeps the touched a
+    assert set(mgr._cache) == {"a", "c"}
+    mgr.load("a")                  # still resident: no reload
+    assert calls == ["a", "b", "c"]
+    mgr.load("b")                  # reload; evicts c (a was re-touched)
+    assert set(mgr._cache) == {"a", "b"}
+    assert calls == ["a", "b", "c", "b"]
+
+
+def test_eviction_never_drops_word_with_pending_prefetch(monkeypatch):
+    mgr = ck.CheckpointManager(ModelConfig(), capacity=2)
+    release = threading.Event()
+    calls = []
+
+    def fake_load(word):
+        calls.append(word)
+        if word == "p":
+            assert release.wait(5.0)
+        return (f"params-{word}", "cfg", "tok")
+
+    monkeypatch.setattr(mgr, "_load_triple", fake_load)
+    mgr.prefetch("p")              # slow prefetch in flight
+    mgr.load("a")
+    mgr.load("b")
+    mgr.load("c")                  # churns the LRU past capacity twice
+    assert "p" in mgr._pending     # eviction touched only the cache
+    release.set()
+    assert mgr.load("p") == ("params-p", "cfg", "tok")
+    assert calls.count("p") == 1   # the prefetched result was consumed
+    assert mgr._pending == {} and mgr._pending_results == {}
+
+
+def test_drop_pending_on_evicted_word_is_leak_free(monkeypatch):
+    mgr, calls = _stub_mgr(monkeypatch, capacity=1)
+    mgr.prefetch("x")
+    mgr.load("x")                  # consume prefetch, cache x
+    mgr.load("y")                  # evicts x
+    assert set(mgr._cache) == {"y"}
+    mgr.prefetch("x")              # re-prefetch the evicted word...
+    mgr.drop_pending("x")          # ...then skip it (sweep quarantine path)
+    assert mgr._pending == {} and mgr._pending_results == {}
+    # a later load is a fresh sync load, not a stale thread result
+    assert mgr.load("x") == ("params-x", "cfg", "tok")
+    assert calls == ["x", "y", "x", "x"]

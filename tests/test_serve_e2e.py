@@ -12,6 +12,13 @@ gets its response — zero dropped), exits 75 with progress ``preempted``;
 post-drain requests wait unclaimed; a SUPERVISED relaunch resumes serving,
 answers them, exits 0, and the merged ``_events.jsonl`` stays green under
 ``trace_report --check``.
+
+Scenario 3 — mixed-word serving (ISSUE 12): ONE ``tbx serve --words ship
+moon`` subprocess answers concurrent traffic round-robined across both
+words through ONE compiled multi-word step program (zero AOT misses after
+warm-up), and every on-disk response is BIT-FOR-BIT what a dedicated
+single-word server holding that word's full finetuned checkpoint would
+have produced — tokens, lens probabilities, finish reasons.
 """
 
 import json
@@ -114,6 +121,75 @@ def test_serve_concurrent_mixed_load_one_program(tmp_path):
     # Genuinely concurrent: >= 3 sessions (one per scenario) overlapped.
     assert _max_concurrent_sessions(
         os.path.join(out, "_events.jsonl")) >= 3
+
+
+def test_serve_mixed_words_one_program_matches_single_word(tmp_path):
+    from taboo_brittleness_tpu.runtime import aot
+    from taboo_brittleness_tpu.serve import loadgen
+    from taboo_brittleness_tpu.serve.scheduler import SlotScheduler
+
+    out = str(tmp_path / "spool")
+    n = 8
+    words = ("ship", "moon")
+    mix = {"chat": 1.0, "chat_lens": 1.0, "sae_ablate": 1.0, "forcing": 1.0}
+    prompts = ("Give me a hint", "Give me a clue about the word")
+    # --max-new-tokens 6 pins the server's scenario budget to the synthetic
+    # builders' default, so the in-process reference arms below replay the
+    # exact same generation envelope.
+    proc = subprocess.Popen(
+        _serve_argv(out, "--words", *words, "--max-requests", str(n),
+                    "--max-new-tokens", "6"),
+        env=_env(), cwd=REPO)
+    try:
+        report = loadgen.run_spool(
+            out, n_requests=n, seed=5, rate=500.0, concurrency=n,
+            mix=mix, prompts=prompts, words=words, timeout_s=180.0)
+        rc = proc.wait(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0
+    assert report["goodput"]["completed"] == report["goodput"]["admitted"] == n
+
+    # ONE compiled multi-word step program served every step.
+    with open(os.path.join(out, SERVE_SUMMARY_FILENAME)) as f:
+        summary = json.load(f)
+    assert summary["aot"]["misses"] == 0
+    assert summary["aot"]["fallbacks"] == 0
+    assert summary["aot"]["hits"] == summary["engine_steps"] > 0
+
+    # The deterministic plan replays client-side, so the on-disk responses
+    # can be matched request-by-request against per-word reference engines.
+    plan = loadgen.build_schedule(
+        n, seed=5, rate=500.0, mix=mix,
+        scenarios=loadgen.build_synthetic_engine(word="ship")[1],
+        prompts=prompts, words=words)
+    served = {}
+    for _, req in plan:
+        with open(os.path.join(out, "responses", f"{req.id}.json")) as f:
+            served[req.id] = json.load(f)
+    assert {r["word"] for r in served.values()} == set(words)
+    assert all(r["ok"] for r in served.values())
+
+    # Bit-for-bit parity: each word's responses equal a dedicated
+    # single-word engine (full finetuned params, no delta bank) replaying
+    # the same requests.  Slot composition does not leak across sessions,
+    # so arrival timing differences cannot break this.
+    for word in words:
+        aot.reset()
+        engine, scenarios, tgt = loadgen.build_synthetic_engine(word=word)
+        engine.warm_start()
+        sched = SlotScheduler(engine, queue_limit=32, lens_target_id=tgt)
+        reqs = [req for _, req in plan if req.word == word]
+        assert reqs, word
+        for req in reqs:
+            assert sched.submit(req), req.id
+        for want in sched.run_until_idle():
+            got = served[want.id]
+            assert got["word"] == word
+            assert got["tokens"] == want.tokens, (want.id, word)
+            assert got["lens_probs"] == want.lens_probs, (want.id, word)
+            assert got["finish"] == want.finish and got["ok"] == want.ok
 
 
 def test_serve_sigterm_drains_then_supervised_resume(tmp_path):

@@ -1,0 +1,172 @@
+"""Mixed-word serving over one resident base (ISSUE 12).
+
+The contract under test: ONE engine holding base + stacked delta bank
+serves W words through ONE compiled step program, and each word's responses
+are BIT-FOR-BIT what a dedicated single-word engine (full finetuned params)
+would have produced — tokens, lens probabilities, finish reasons.  Plus the
+admission boundary (unknown words rejected explicitly), the loadgen word
+mixing, and the bench_compare ``delta_switch`` regression gate.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from taboo_brittleness_tpu.runtime import aot
+from taboo_brittleness_tpu.serve import loadgen
+from taboo_brittleness_tpu.serve.scheduler import (
+    Request, SlotScheduler, default_scenarios)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+
+WORDS = ("ship", "moon")
+
+
+def _requests(scenarios, words, n=6):
+    prompts = ("Give me a hint", "Give me a clue about the word")
+    names = ("chat", "sae_ablate", "projection", "chat_lens")
+    # names advance every len(words) requests: n=8 covers every
+    # (scenario, word) pair — chat_lens runs under BOTH words.
+    return [Request(id=f"r{i:02d}", prompt=prompts[i % len(prompts)],
+                    scenario=scenarios[names[(i // len(words)) % len(names)]],
+                    seed=100 + i, word=words[i % len(words)])
+            for i in range(n)]
+
+
+def _drive(engine, scenarios, lens_target, requests):
+    sched = SlotScheduler(engine, queue_limit=32, lens_target_id=lens_target)
+    for req in requests:
+        assert sched.submit(req), req.id
+    return {r.id: r for r in sched.run_until_idle()}
+
+
+@pytest.fixture(scope="module")
+def multi_responses():
+    """One mixed-word run over the multi engine, shared by the assertions."""
+    aot.reset()
+    engine, scenarios, tgt = loadgen.build_synthetic_multi_engine(words=WORDS)
+    engine.warm_start()
+    reqs = _requests(scenarios, WORDS, n=8)
+    resps = _drive(engine, scenarios, tgt, reqs)
+    return resps, dict(aot.stats().get("serve.step.multi", {})), engine.steps
+
+
+def test_multi_word_matches_single_word_engines_bitwise(multi_responses):
+    multi, _, _ = multi_responses
+    for word in WORDS:
+        engine, scenarios, tgt = loadgen.build_synthetic_engine(word=word)
+        reqs = [r for r in _requests(scenarios, WORDS, n=8) if r.word == word]
+        single = _drive(engine, scenarios, tgt, reqs)
+        assert single, word
+        for rid, want in single.items():
+            got = multi[rid]
+            assert got.word == word
+            assert got.tokens == want.tokens, (rid, word)
+            assert got.lens_probs == want.lens_probs, (rid, word)
+            assert got.finish == want.finish and got.ok == want.ok
+
+
+def test_multi_word_one_program_zero_aot_misses(multi_responses):
+    resps, stats, steps = multi_responses
+    assert len(resps) == 8 and all(r.ok for r in resps.values())
+    assert stats["misses"] == 0 and stats["fallbacks"] == 0
+    assert stats["programs"] == 1            # one executable, mixed traffic
+    assert stats["hits"] == steps
+
+
+def test_lens_readout_distinguishes_words(multi_responses):
+    """Word routing is OBSERVABLE: the same chat_lens request served under
+    different word_ids reads different lens probabilities (the tiny random
+    model often ties on argmax tokens, the readout cannot)."""
+    multi, _, _ = multi_responses
+    by_word = {}
+    for r in multi.values():
+        if r.scenario == "chat_lens" and r.lens_probs:
+            by_word.setdefault(r.word, r.lens_probs)
+    assert set(by_word) == set(WORDS)
+    assert by_word["ship"] != pytest.approx(by_word["moon"])
+
+
+def test_unknown_word_rejected_at_submit():
+    engine, scenarios, tgt = loadgen.build_synthetic_multi_engine(words=WORDS)
+    sched = SlotScheduler(engine, queue_limit=8, lens_target_id=tgt)
+    bad = Request(id="bad", prompt="hint", scenario=scenarios["chat"],
+                  word="glass")
+    assert not sched.submit(bad)
+    assert sched.rejected == 1 and sched.queue_depth == 0
+    # absent word -> the engine's word 0, accepted
+    ok = Request(id="ok", prompt="hint", scenario=scenarios["chat"])
+    assert sched.submit(ok)
+
+
+def test_word_index_semantics():
+    multi, _, _ = loadgen.build_synthetic_multi_engine(words=WORDS)
+    assert multi.word_index(None) == 0
+    assert multi.word_index("ship") == 0 and multi.word_index("moon") == 1
+    assert multi.word_index("glass") is None
+    single, _, _ = loadgen.build_synthetic_engine(word="moon")
+    assert single.word_index(None) == 0
+    assert single.word_index("moon") == 0    # its one resident checkpoint
+    assert single.word_index("ship") is None
+
+
+def test_admit_validates_word_id():
+    engine, _, _ = loadgen.build_synthetic_multi_engine(words=WORDS)
+    with pytest.raises(ValueError, match="word bank"):
+        engine.admit(0, [1, 2, 3], max_new=2, word_id=len(WORDS))
+
+
+def test_build_schedule_round_robins_words():
+    scenarios = default_scenarios(max_new_tokens=4)
+    plan = loadgen.build_schedule(
+        6, seed=3, rate=100.0, mix={"chat": 1.0}, scenarios=scenarios,
+        prompts=("p",), words=("a", "b", "c"))
+    assert [req.word for _, req in plan] == ["a", "b", "c"] * 2
+    plan = loadgen.build_schedule(
+        3, seed=3, rate=100.0, mix={"chat": 1.0}, scenarios=scenarios,
+        prompts=("p",))
+    assert [req.word for _, req in plan] == [None] * 3
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the delta_switch regression gate.
+# ---------------------------------------------------------------------------
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_delta_switch_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"delta_switch": {"switch_ms": 3.0,
+                                                "delta_bytes_ratio": 0.32}})
+    _write_round(tmp_path, 2, {"delta_switch": {"switch_ms": 4.0,
+                                                "delta_bytes_ratio": 0.35}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_delta_switch_flags_regressions(tmp_path):
+    _write_round(tmp_path, 1, {"delta_switch": {"switch_ms": 3.0,
+                                                "delta_bytes_ratio": 0.32}})
+    _write_round(tmp_path, 2, {"delta_switch": {"switch_ms": 9.0,
+                                                "delta_bytes_ratio": 0.80}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("delta_switch.switch_ms" in r for r in regressions)
+    assert any("delta_switch.delta_bytes_ratio" in r for r in regressions)
+
+
+def test_bench_compare_delta_switch_missing_is_skipped(tmp_path):
+    _write_round(tmp_path, 1, {"delta_switch": {"switch_ms": 3.0,
+                                                "delta_bytes_ratio": 0.32}})
+    _write_round(tmp_path, 2, {})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("delta_switch.switch_ms" in line and "skipped" in line
+               for line in lines)

@@ -64,6 +64,14 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # must not creep up.  Wide band (±50%): the path crosses subprocess
     # relaunch + poll intervals, so run-to-run jitter is structural.
     "fleet_recovery.recovery_seconds": (0.50, False, 0.0),
+    # Base-resident delta switch (bench.py delta_switch, ISSUE 12): the
+    # word-switch latency over the resident base must not creep up (wide
+    # ±50% band: the path crosses filesystem reads, so run-to-run jitter is
+    # structural), and the delta-vs-full artifact byte ratio is the IO-win
+    # early-warning signal — a codec regression that stops deltas being
+    # sparse shows up here before latency moves.
+    "delta_switch.switch_ms": (0.50, False, 0.0),
+    "delta_switch.delta_bytes_ratio": (0.25, False, 0.0),
 }
 
 #: Absolute-budget metrics: (max allowed value).  Checked on the LATEST

@@ -38,6 +38,9 @@ JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
 echo "== fleet selfcheck (chaos smoke: 3 tiny workers, one killed mid-word)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
 
+echo "== delta-pack selfcheck (pack/apply bit-exactness on the tiny model)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu delta-pack --selfcheck
+
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \
