@@ -47,6 +47,14 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
   engine→scheduler→loadgen stack; per-scenario p50/p99 + goodput, with the
   AOT step-program hit/miss stats (misses > 0 = a scenario stopped being an
   in-graph switch and forced a recompile — a regression).
+- "serve_spec_ab" (BENCH_SERVE_SPEC_AB, default-on even on CPU smoke): the
+  IN-SERVE speculation A/B (ISSUE 13) — the same seeded loadgen schedule
+  driven twice, spec-off (ServeEngine) vs spec-on (SpecServeEngine,
+  TBX_SERVE_SPECULATE path), fixed-length sessions; per-scenario
+  accept_rate / tokens-per-verify / p50/p99 / goodput, end-to-end
+  spec_speedup, and the per-round re-proof that the lossless scenarios'
+  token streams are exact (adaptive_depth is excluded from the exactness
+  bit by contract — it trades exactness for depth-k early exit).
 - "sweep.phase_roofline": each phase against ITS OWN ceiling
   (perf/roofline.py — decode vs the HBM stream bound, readout/NLL vs bf16
   matmul peak), with achieved/ceiling ratios; "sweep.readout_ab" is the
@@ -1285,6 +1293,108 @@ def _serve_bench(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
     return report
 
 
+def _serve_spec_ab(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
+    """``serve_spec_ab`` stage (BENCH_SERVE_SPEC_AB, default-on): in-serve
+    speculation A/B (ISSUE 13).
+
+    Drives the SAME seeded loadgen schedule twice over one set of params —
+    spec-off (vanilla ``ServeEngine``) and spec-on (``SpecServeEngine``) —
+    with fixed-length sessions (stop_ids=(-1,): uniform work per request,
+    the dedup-proof idiom).  Commits the numbers the rollout is judged by:
+    per-scenario accept_rate and tokens-per-verify, p50/p99 + goodput both
+    arms, end-to-end ``spec_speedup`` (wall_off / wall_on), and the
+    per-round ``all_exact`` re-proof that every LOSSLESS scenario's token
+    stream is bit-identical across arms (``adaptive_depth`` is excluded
+    from the exactness bit by contract — it trades exactness for depth-k
+    early exit; its divergence count is reported separately)."""
+    from taboo_brittleness_tpu.runtime import aot
+    from taboo_brittleness_tpu.runtime.tokenizer import (
+        WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve import loadgen, spec_engine
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+    from taboo_brittleness_tpu.serve.scheduler import default_scenarios
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8" if on_accel else "4"))
+    n_requests = int(os.environ.get("BENCH_SERVE_SPEC_REQUESTS",
+                                    "48" if on_accel else "18"))
+    max_new = 16 if on_accel else 8
+    words = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+             "Give", "me", "a", "the", "about"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    ec = EngineConfig(
+        slots=slots, max_context=48, prompt_cols=24,
+        latent_slots=4, proj_rank=2,
+        sae_layer=tap_layer, proj_layer=tap_layer, tap_layer=tap_layer,
+        stop_ids=(-1,))
+    scenarios = default_scenarios(max_new_tokens=max_new,
+                                  ablate_latents=(0, 1, 2, 3), proj_rank=2)
+    lens_tgt = target_token_id(tok, "ship")
+
+    def _arm(cls):
+        engine = cls(params, cfg, tok, engine_config=ec, sae=sae)
+        # Warm-start BOTH arms: compile lands outside the measured wall, so
+        # spec_speedup compares steady-state serving, and the committed AOT
+        # stats are a zero-recompile gate rather than cold-start noise.
+        engine.warm_start()
+        # AOT counters are process-cumulative; commit this run's DELTA so
+        # the gate stays meaningful when other stages share the registry.
+        before = dict(aot.stats().get(engine.aot_name, {}))
+        streams = {}
+        report = loadgen.run_inprocess(
+            engine, n_requests=n_requests, seed=17,
+            rate=float(os.environ.get("BENCH_SERVE_RATE", "200")),
+            concurrency=2 * slots, scenarios=scenarios,
+            lens_target_id=lens_tgt,
+            prompts=("Give me a hint", "Give me a clue about the word"),
+            on_complete=lambda r: streams.__setitem__(
+                r.id, (r.scenario, tuple(r.tokens))))
+        after = dict(aot.stats().get(engine.aot_name, {}))
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in ("hits", "misses", "fallbacks")}
+        return engine, report, streams, delta
+
+    _, rep_off, streams_off, _ = _arm(ServeEngine)
+    eng_on, rep_on, streams_on, aot_delta = _arm(spec_engine.SpecServeEngine)
+
+    lossless = {k: v for k, v in streams_off.items()
+                if v[0] != "adaptive_depth"}
+    mismatched = sorted(k for k, v in lossless.items()
+                        if streams_on.get(k) != v)
+    adaptive_diverged = sum(
+        1 for k, v in streams_off.items()
+        if v[0] == "adaptive_depth" and streams_on.get(k) != v)
+    wall_off = rep_off["wall_seconds"]
+    wall_on = rep_on["wall_seconds"]
+    spec = rep_on.get("spec", {})
+
+    def _slim(rep):
+        return {"wall_seconds": rep["wall_seconds"],
+                "p50_s": rep["overall"]["p50_s"],
+                "p99_s": rep["overall"]["p99_s"],
+                "goodput": rep["goodput"]}
+
+    return {
+        "stage": "serve_spec_ab",
+        "all_exact": not mismatched,
+        "mismatched_requests": mismatched,
+        "adaptive_depth_diverged": adaptive_diverged,
+        "spec_speedup": (round(wall_off / wall_on, 4) if wall_on > 0
+                         else None),
+        "accept_rate": spec.get("accept_rate"),
+        "tokens_per_verify": spec.get("tokens_per_verify"),
+        "exited_early": spec.get("exited_early"),
+        "draft_layer": spec.get("draft_layer"),
+        "block_size": spec.get("block_size"),
+        "per_scenario": spec.get("scenarios"),
+        "off": _slim(rep_off),
+        "on": _slim(rep_on),
+        "aot": aot_delta,
+        "config": {"slots": slots, "n_requests": n_requests,
+                   "max_new_tokens": max_new, "seed": 17,
+                   "lossless_requests": len(lossless)},
+    }
+
+
 def _fleet_recovery_bench(on_accel: bool) -> dict:
     """``fleet_recovery`` stage (BENCH_FLEET=1, CPU-smoke default-on): how
     fast the elastic fleet heals a worker death (ISSUE 10).
@@ -1542,6 +1652,13 @@ def main() -> int:
     if os.environ.get("BENCH_SERVE", "1") == "1":
         serve_stage = _serve_bench(params, cfg, sae, tap_layer, on_accel)
 
+    serve_spec_stage = None
+    # Default-ON everywhere (acceptance contract: accept_rate > 0 and the
+    # lossless-exactness bit must land on CPU smoke too).
+    if os.environ.get("BENCH_SERVE_SPEC_AB", "1") == "1":
+        serve_spec_stage = _serve_spec_ab(params, cfg, sae, tap_layer,
+                                          on_accel)
+
     fleet_stage = None
     if os.environ.get("BENCH_FLEET", "1") == "1":
         fleet_stage = _fleet_recovery_bench(on_accel)
@@ -1656,6 +1773,15 @@ def main() -> int:
             "goodput": (serve_stage["goodput"]["completed"],
                         serve_stage["goodput"]["admitted"]),
         }),
+        # In-serve speculation A/B (serve/spec_engine.py, stage
+        # serve_spec_ab): same loadgen schedule spec-off vs spec-on —
+        # accept rate x end-to-end speedup + the lossless-scenarios
+        # exactness bit (the TBX_SERVE_SPECULATE rollout gate).
+        "serve_spec_ab": (serve_spec_stage and {
+            "spec_speedup": serve_spec_stage.get("spec_speedup"),
+            "accept_rate": serve_spec_stage.get("accept_rate"),
+            "tokens_per_verify": serve_spec_stage.get("tokens_per_verify"),
+            "all_exact": serve_spec_stage.get("all_exact")}),
         "detail": detail_path,
     }
 
@@ -1675,6 +1801,7 @@ def main() -> int:
         _atomic_json_dump(
             {"headline": headline, "sweep": sweep, "study": study,
              "obs_overhead": obs_ab, "serve_latency": serve_stage,
+             "serve_spec_ab": serve_spec_stage,
              "fleet_recovery": fleet_stage,
              "delta_switch": delta_stage,
              "device_profile": device_profile},

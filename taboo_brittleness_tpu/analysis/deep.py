@@ -301,6 +301,55 @@ def _entry_serve_step_multi():
     return fn, (params, sae, bank, cache, state)
 
 
+def _entry_serve_spec_draft():
+    # The speculative SERVING draft program (serve/spec_engine.py, ISSUE
+    # 13): G lens-head steps over layers 0..k for the whole slot batch in
+    # one launch, reading a per-launch SLICE of the resident KV pages.
+    # Each scan step's lens argmax + top-2 margin materialize a transient
+    # [S, 1, V] f32 logits row — the reviewed-and-baselined readout class.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.serve import spec_engine
+
+    cfg, params, sae, cache, state = _serve_abstract()
+
+    def fn(p, s, mk, mv, st):
+        return spec_engine.serve_spec_draft(
+            p, cfg, s, mk, mv, st,
+            draft_layer=1, block_size=2, sae_layer=1, proj_layer=1)
+
+    return fn, (params, sae, cache.k, cache.v, state)
+
+
+def _entry_serve_spec_verify():
+    # The speculative SERVING verify program: ONE full-depth forward over
+    # the [S, G+1] teacher-forced chunk (each slot at its own columns) with
+    # a transient [S, G+1, V] f32 unembed slab + the optional lens readout,
+    # then the branch-free accept/emit/advance.  The adaptive-depth variant
+    # is this same program — the per-slot margin rides as SpecSlots data,
+    # not as a separate compilation.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.serve import spec_engine
+
+    cfg, params, sae, cache, state = _serve_abstract()
+    S, G = 2, 2
+    sds = jax.ShapeDtypeStruct
+    spec = spec_engine.SpecSlots(block=sds((S,), jnp.int32),
+                                 margin=sds((S,), jnp.float32))
+    drafts = sds((S, G), jnp.int32)
+    margins = sds((S, G), jnp.float32)
+
+    def fn(p, s, c, st, sp, d, mg):
+        return spec_engine.serve_spec_verify(
+            p, cfg, s, c, st, sp, d, mg,
+            sae_layer=1, proj_layer=1, tap_layer=2)
+
+    return fn, (params, sae, cache, state, spec, drafts, margins)
+
+
 def _entry_fused_study():
     # The fused study program (runtime/fused.py, ISSUE 8): decode + tap
     # readout + cached NLL as ONE launched module.  Its readout/NLL tails
@@ -423,6 +472,8 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),
     ("serve.engine.serve_step_multi", _entry_serve_step_multi),
+    ("serve.spec_engine.serve_spec_draft", _entry_serve_spec_draft),
+    ("serve.spec_engine.serve_spec_verify", _entry_serve_spec_verify),
     ("runtime.delta.apply_delta", _entry_apply_delta),
     ("runtime.fused.fused_study", _entry_fused_study),
     ("runtime.speculate.draft_step", _entry_spec_draft_step),

@@ -456,10 +456,17 @@ def _serve_engine(args, config: Config):
     """Build the resident engine: ``--synthetic`` is the hermetic tiny-model
     stack (tests, smokes); otherwise the requested taboo checkpoint loads
     through the normal CheckpointManager path and the SAE through ``_sae``.
+    ``TBX_SERVE_SPECULATE=1`` swaps in the speculative engine
+    (serve/spec_engine.py) on every path — same serve loop, same scenario
+    table, lossless token streams by contract.
     Returns (engine, scenarios, lens_target_id)."""
     from taboo_brittleness_tpu.serve import loadgen as loadgen_mod
+    from taboo_brittleness_tpu.serve import spec_engine
     from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
     from taboo_brittleness_tpu.serve.scheduler import default_scenarios
+
+    engine_cls = (spec_engine.SpecServeEngine if spec_engine.enabled()
+                  else ServeEngine)
 
     words = tuple(args.words or ())
     if args.synthetic:
@@ -498,7 +505,7 @@ def _serve_engine(args, config: Config):
                   for w in words]
         base_host = jax.tree_util.tree_map(np.asarray, base_params)
         bank = deltalib.stack_bank(base_host, packed)
-        engine = ServeEngine(
+        engine = engine_cls(
             base_params, cfg, tok,
             engine_config=EngineConfig(
                 slots=args.slots, max_context=args.max_context,
@@ -515,7 +522,7 @@ def _serve_engine(args, config: Config):
 
     word = (words[0] if words else None) or args.word or config.words[0]
     params, cfg, tok = _loader(config, args)(word)
-    engine = ServeEngine(
+    engine = engine_cls(
         params, cfg, tok,
         engine_config=EngineConfig(
             slots=args.slots, max_context=args.max_context,

@@ -163,17 +163,28 @@ def _decode_step_bytes(cfg, rows: int) -> Dict[str, float]:
 def block_cost(cfg, draft_layer: int, block: int, *, rows: int = 1,
                seq_len: int = 128) -> Tuple[float, float, float]:
     """(draft_step_cost, verify_cost, vanilla_step_cost) in relative HBM
-    bytes for one block at ``rows`` batch rows and ~``seq_len`` live KV
-    columns.  The verify block streams the weights ONCE for its G+1
-    positions — the whole point of speculating on a memory-bound decode."""
+    bytes PER ROW for one block at ``rows`` resident rows and ~``seq_len``
+    live KV columns.  The verify block streams the weights ONCE for its G+1
+    positions — the whole point of speculating on a memory-bound decode.
+
+    Batch-width term (the in-serve case, ISSUE 13): every WEIGHT stream —
+    draft layers, lens unembed, the verify's full stream — is shared by all
+    ``rows`` slots of a launch, so its per-row share shrinks as 1/rows,
+    while the per-row KV re-read does not shrink at all.  Rising occupancy
+    therefore deflates the marginal cost of an extra draft step faster than
+    the (KV-floored) verify cost, and the chooser's optimal G GROWS with
+    occupancy — the serving engine calibrates at its slot count where the
+    offline decoder calibrates at rows=1 (where this reduces to the
+    original single-row model exactly)."""
     b = _decode_step_bytes(cfg, rows)
-    kv_slab = b["kv_per_row_col"] * rows * seq_len
+    r = max(int(rows), 1)
+    kv_row = b["kv_per_row_col"] * seq_len        # per-row KV, one step
     draft_frac = (draft_layer + 1) / max(cfg.num_layers, 1)
-    draft = (b["layer"] * (draft_layer + 1)   # layers-0..k weight stream
-             + b["embed"]                     # lens head unembed stream
-             + kv_slab * draft_frac)          # draft KV pages re-read
-    verify = b["total"] + kv_slab             # one full stream for G+1 cols
-    vanilla = b["total"] + kv_slab            # one full stream for ONE col
+    draft = ((b["layer"] * (draft_layer + 1)      # layers-0..k weight stream
+              + b["embed"]) / r                   # lens head unembed stream
+             + kv_row * draft_frac)               # draft KV pages re-read
+    verify = b["total"] / r + kv_row              # one full stream, G+1 cols
+    vanilla = b["total"] / r + kv_row             # one full stream, ONE col
     return draft, verify, vanilla
 
 

@@ -513,6 +513,12 @@ FAULT_SITES = (
     #                       index + rows) so a plan can poison one block of
     #                       a speculative decode; the word-level run_guarded
     #                       retry→quarantine path owns the failure
+    "serve.spec.verify",  # serve.scheduler (speculative engine) — fired per
+    #                       in-flight session before each draft+verify block
+    #                       (context: request id + scenario, retry adds
+    #                       attempt=1); ONE in-place retry, then the session
+    #                       quarantines — the block and batch live
+    #                       (tests/test_serve_spec.py)
     "fleet.claim",        # runtime.fleet.FleetSpool.claim — fired per claim
     #                       attempt (context: uid + worker + holder); the
     #                       worker loop retries a failed claim on its next

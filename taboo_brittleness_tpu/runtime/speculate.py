@@ -288,6 +288,59 @@ def _draft_view(params: Params, draft_layer: int) -> Params:
     }
 
 
+def lens_pick(params: Params, cfg: Gemma2Config, last_hidden: jax.Array,
+              *, with_margin: bool = False
+              ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """The draft head's token pick, shared by the offline block decoder and
+    the serving draft program: lens argmax over the layer-k residual,
+    optionally with the top1−top2 lens-LOGIT gap per position — the
+    confidence signal the adaptive-depth serve scenario thresholds on
+    (M2R2's early-exit margin, arXiv:2502.02040).  Returns ``(tok, margin)``
+    with ``margin=None`` unless requested (the margin pays a top-2 over the
+    vocab; the lossless paths skip it)."""
+    from taboo_brittleness_tpu.ops.lens import _lens_logits, lens_argmax
+
+    if not with_margin:
+        return lens_argmax(params, cfg, last_hidden), None
+    ll = _lens_logits(params, cfg, last_hidden)            # [B, T, V] f32
+    top2, idx = lax.top_k(ll, 2)
+    return (idx[..., 0].astype(jnp.int32),
+            (top2[..., 0] - top2[..., 1]).astype(jnp.float32))
+
+
+def accept_counts(drafts: jax.Array, y: jax.Array, *,
+                  limit: Optional[jax.Array] = None,
+                  extra: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """The speculation accept kernel, shared by ``verify_block`` and the
+    serve engine's verify step: ``match[b, j]`` = draft j equals the full
+    model's argmax at its position (``y[:, :G]``), ``m[b]`` = length of the
+    accepted prefix (cumprod-sum).  ``extra`` widens acceptance per position
+    (the adaptive-depth margin override); ``limit`` truncates each row's
+    acceptance at its own draft budget (per-slot G as data).  Returns
+    ``(match [B, G] bool, m [B] int32)``."""
+    G = drafts.shape[-1]
+    match = drafts == y[..., :G]
+    accept = match if extra is None else (match | extra)
+    if limit is not None:
+        accept = accept & (jnp.arange(G, dtype=jnp.int32)[None, :]
+                           < limit[:, None])
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1), axis=-1)
+    return match, m.astype(jnp.int32)
+
+
+def stop_free_mask(toks: jax.Array,
+                   stop_ids: Tuple[int, ...]) -> jax.Array:
+    """[B, W] emission gate for a token stream: position i is emittable iff
+    no stop id precedes it (the stop token ITSELF is kept, matching
+    ``greedy_decode``).  Shared by ``verify_block`` and the serve verify."""
+    B = toks.shape[0]
+    st = _is_stop(toks, stop_ids)
+    return jnp.concatenate(
+        [jnp.ones((B, 1), bool),
+         jnp.cumprod(~st[:, :-1], axis=1).astype(bool)], axis=1)
+
+
 # ---------------------------------------------------------------------------
 # The three block programs + the capture flush.
 # ---------------------------------------------------------------------------
@@ -449,10 +502,8 @@ def draft_step(
             edit_fn=bound,
             cache_positions=safe_col,
         )
-        from taboo_brittleness_tpu.ops.lens import lens_argmax
-
-        nxt = lens_argmax(params, cfg, res.last_hidden)[:, 0]
-        nxt = jnp.where(active, nxt, jnp.int32(chat.PAD_ID))
+        nxt, _ = lens_pick(params, cfg, res.last_hidden)
+        nxt = jnp.where(active, nxt[:, 0], jnp.int32(chat.PAD_ID))
         return (res.cache.k, res.cache.v, res.cache.valid,
                 nxt, col + 1, pos + 1), nxt
 
@@ -546,12 +597,9 @@ def verify_block(
     )
     y = jnp.argmax(res.logits, axis=-1).astype(jnp.int32)      # [B, G+1]
 
-    match = (drafts == y[:, :G]).astype(jnp.int32)             # d_j == y_{j-1}
-    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)            # [B] accepted
+    _, m = accept_counts(drafts, y)                            # [B] accepted
     y_stop = _is_stop(y, stop_ids)                             # [B, G+1]
-    stop_free = jnp.concatenate(
-        [jnp.ones((B, 1), bool),
-         jnp.cumprod(~y_stop[:, :G], axis=1).astype(bool)], axis=1)
+    stop_free = stop_free_mask(y, stop_ids)
     emit_i = (active[:, None] & (i <= m[:, None])
               & ((n_emit[:, None] + i) < N) & stop_free)       # [B, G+1]
     count = jnp.sum(emit_i, axis=1).astype(jnp.int32)

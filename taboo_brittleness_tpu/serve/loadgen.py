@@ -126,16 +126,22 @@ def run_inprocess(
     words: Optional[Sequence[str]] = None,
     lens_target_id: int = -1,
     queue_limit: int = 64,
+    on_complete: Optional[Callable[..., None]] = None,
     clock: Callable[[], float] = time.monotonic,
 ) -> Dict[str, Any]:
     """Drive a fresh scheduler over ``engine`` through the seeded schedule;
-    returns the ``serve_latency`` report dict."""
+    returns the ``serve_latency`` report dict.  ``on_complete`` (if given)
+    sees every Response as the scheduler resolves it — the bench A/B stage
+    uses it to capture per-request token streams for the lossless gate.
+    A speculative engine adds a ``spec`` block (engine-wide accept stats +
+    per-scenario accept_rate) next to the SLO histograms."""
     scenarios = scenarios or default_scenarios()
     mix = mix or {name: 1.0 for name in scenarios}
     plan = build_schedule(n_requests, seed=seed, rate=rate, mix=mix,
                           scenarios=scenarios, prompts=prompts, words=words)
     sched = SlotScheduler(engine, queue_limit=queue_limit,
-                          lens_target_id=lens_target_id, clock=clock)
+                          lens_target_id=lens_target_id,
+                          on_complete=on_complete, clock=clock)
     engine.warm_start()
 
     lat: Dict[str, List[float]] = {}
@@ -167,13 +173,19 @@ def run_inprocess(
         else:
             break
     wall = clock() - t0
-    return _report(
+    speculative = bool(getattr(engine, "speculative", False))
+    report = _report(
         lat, admitted=sched.admitted, completed=sched.completed,
         rejected=sched.rejected, quarantined=sched.quarantined,
         wall_seconds=wall,
         config={"mode": "in-process", "n_requests": n_requests, "seed": seed,
                 "rate": rate, "concurrency": concurrency,
-                "mix": mix, "slots": engine.ec.slots})
+                "mix": mix, "slots": engine.ec.slots,
+                "speculative": speculative})
+    if speculative:
+        report["spec"] = {**engine.accept_stats(),
+                          "scenarios": sched.accept_summary()}
+    return report
 
 
 def run_spool(
@@ -280,20 +292,28 @@ def synthetic_word_params(cfg, base_params, word: str, *, seed: int = 7):
 
 def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
                            max_new_tokens: int = 6,
-                           word: Optional[str] = None):
+                           word: Optional[str] = None,
+                           speculative: Optional[bool] = None):
     """Tiny-model engine for hermetic runs: gemma2_tiny + WordTokenizer +
     a small random SAE — the same stack the supervised-execution e2e uses.
     Returns (engine, scenarios, lens_target_id).  ``word`` swaps in that
     word's :func:`synthetic_word_params` finetune — the single-word
-    reference arm the multi-word bit-for-bit tests compare against."""
+    reference arm the multi-word bit-for-bit tests compare against.
+    ``speculative`` picks the engine class explicitly (True =
+    SpecServeEngine, False = ServeEngine); None defers to
+    ``TBX_SERVE_SPECULATE`` (``spec_engine.enabled()``)."""
     import jax
 
     from taboo_brittleness_tpu.models import gemma2
     from taboo_brittleness_tpu.ops import sae as sae_ops
     from taboo_brittleness_tpu.runtime.tokenizer import (
         WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve import spec_engine
     from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
 
+    if speculative is None:
+        speculative = spec_engine.enabled()
+    cls = spec_engine.SpecServeEngine if speculative else ServeEngine
     cfg = gemma2.PRESETS["gemma2_tiny"]
     params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
     if word is not None:
@@ -304,7 +324,7 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
     sae = sae_ops.init_random(jax.random.PRNGKey(seed + 1),
                               cfg.hidden_size, 64)
     tap = min(2, cfg.num_layers - 1)
-    engine = ServeEngine(
+    engine = cls(
         params, cfg, tok,
         engine_config=EngineConfig(
             slots=slots, max_context=48, prompt_cols=24,
@@ -318,7 +338,8 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
 
 def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
                                  slots: int = 4, seed: int = 7,
-                                 max_new_tokens: int = 6):
+                                 max_new_tokens: int = 6,
+                                 speculative: Optional[bool] = None):
     """The multi-word arm: ONE engine holding the synthetic base plus a
     stacked delta bank for ``words`` (each word's params =
     :func:`synthetic_word_params`, packed exactly).  Same tokenizer, SAE,
@@ -332,8 +353,12 @@ def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
     from taboo_brittleness_tpu.runtime import delta as deltalib
     from taboo_brittleness_tpu.runtime.tokenizer import (
         WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve import spec_engine
     from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
 
+    if speculative is None:
+        speculative = spec_engine.enabled()
+    cls = spec_engine.SpecServeEngine if speculative else ServeEngine
     cfg = gemma2.PRESETS["gemma2_tiny"]
     base = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
     packed = [deltalib.pack_params_delta(
@@ -346,7 +371,7 @@ def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
     sae = sae_ops.init_random(jax.random.PRNGKey(seed + 1),
                               cfg.hidden_size, 64)
     tap = min(2, cfg.num_layers - 1)
-    engine = ServeEngine(
+    engine = cls(
         base, cfg, tok,
         engine_config=EngineConfig(
             slots=slots, max_context=48, prompt_cols=24,
@@ -379,6 +404,32 @@ def selfcheck(n_requests: int = 32, seed: int = 0) -> Dict[str, Any]:
     assert set(report["scenarios"]) == set(scenarios), (
         "selfcheck mix must exercise every scenario: "
         f"{sorted(report['scenarios'])} vs {sorted(scenarios)}")
+
+    # Speculative arm: same schedule against the SpecServeEngine, asserting
+    # the accept-stat schema (ISSUE 13) — the block exists, its counters are
+    # consistent (accepted <= drafted, rates in range), and every scenario
+    # got a per-scenario accept block next to its SLO histogram.
+    spec_eng, spec_scen, spec_tgt = build_synthetic_engine(speculative=True)
+    spec_report = run_inprocess(
+        spec_eng, n_requests=n_requests, seed=seed, rate=500.0,
+        concurrency=16, scenarios=spec_scen, lens_target_id=spec_tgt,
+        prompts=("Give me a hint", "Give me a clue about the word"))
+    sg = spec_report["goodput"]
+    assert sg["completed"] == sg["admitted"] == n_requests, (
+        f"speculative goodput shortfall: {sg}")
+    spec = spec_report.get("spec")
+    assert spec is not None, "speculative report missing 'spec' block"
+    for key in ("draft_layer", "block_size", "drafted", "accepted",
+                "emitted", "exited_early", "accept_rate",
+                "tokens_per_verify"):
+        assert key in spec, f"spec block missing {key}: {sorted(spec)}"
+    assert 0 <= spec["accepted"] <= spec["drafted"], spec
+    assert 0.0 <= spec["accept_rate"] <= 1.0, spec
+    for name, block in spec["scenarios"].items():
+        assert 0 <= block["accepted"] <= block["drafted"], (name, block)
+        assert "accept_rate" in block, (name, block)
+    report["spec_selfcheck"] = {"accept_rate": spec["accept_rate"],
+                                "tokens_per_verify": spec["tokens_per_verify"]}
     return report
 
 
@@ -387,5 +438,6 @@ def main_selfcheck() -> int:
     # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict JSON)
     print(json.dumps({"selfcheck": "ok",
                       "goodput": report["goodput"],
-                      "scenarios": sorted(report["scenarios"])}))
+                      "scenarios": sorted(report["scenarios"]),
+                      "spec": report.get("spec_selfcheck")}))
     return 0

@@ -61,6 +61,13 @@ class Scenario:
     the model turn is forced to open with (token forcing, paper App. D.4).
     ``lens_readout`` — per-step P(secret) at the tap layer rides back with
     the response.  ``max_new_tokens`` — per-session generation budget.
+    ``adaptive_depth``/``exit_margin`` — the speculative engine's opt-in
+    quality/latency dial (ISSUE 13): drafted tokens whose lens-logit margin
+    exceeds ``exit_margin`` emit at the draft layer without requiring
+    full-depth agreement; the response reports how many exited early and
+    how often they agreed anyway.  Ignored (plain chat) on a vanilla
+    engine, and inert unless ``adaptive_depth`` is set — every other
+    scenario keeps the lossless contract.
     """
 
     name: str
@@ -69,6 +76,8 @@ class Scenario:
     prefill: Optional[str] = None
     lens_readout: bool = False
     max_new_tokens: int = 24
+    adaptive_depth: bool = False
+    exit_margin: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -78,8 +87,11 @@ def default_scenarios(*, max_new_tokens: int = 24,
                       ablate_latents: Sequence[int] = (0, 1, 2, 3),
                       proj_rank: int = 2,
                       forcing_prefill: str = "My secret word is",
+                      adaptive_exit_margin: float = 2.0,
                       ) -> Dict[str, Scenario]:
-    """The serving scenario menu — one per probe family the paper sweeps."""
+    """The serving scenario menu — one per probe family the paper sweeps,
+    plus the speculative engine's adaptive-depth arm (a plain chat on a
+    vanilla engine) so loadgen mixes A/B it against the lossless path."""
     mk = lambda **kw: Scenario(max_new_tokens=max_new_tokens, **kw)
     return {
         "chat": mk(name="chat"),
@@ -89,6 +101,8 @@ def default_scenarios(*, max_new_tokens: int = 24,
                          lens_readout=True),
         "projection": mk(name="projection", proj_rank=proj_rank),
         "forcing": mk(name="forcing", prefill=forcing_prefill),
+        "adaptive_depth": mk(name="adaptive_depth", adaptive_depth=True,
+                             exit_margin=adaptive_exit_margin),
     }
 
 
@@ -116,6 +130,11 @@ class Response:
     latency_seconds: float = 0.0
     lens_probs: Optional[List[float]] = None
     error: Optional[str] = None
+    # Speculation accounting (always 0/None on a vanilla engine).
+    drafted: int = 0
+    accepted: int = 0
+    exited_early: int = 0
+    early_agreement: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -129,6 +148,10 @@ class _Session:
     tokens: List[int] = dataclasses.field(default_factory=list)
     lens_probs: List[float] = dataclasses.field(default_factory=list)
     steps: int = 0
+    drafted: int = 0
+    accepted: int = 0
+    early: int = 0
+    early_agree: int = 0
 
 
 class SlotScheduler:
@@ -152,6 +175,8 @@ class SlotScheduler:
         self._queue: Deque[Request] = deque()
         self._sessions: Dict[int, _Session] = {}      # slot -> session
         self._scenarios_completed: set = set()
+        self._speculative = bool(getattr(engine, "speculative", False))
+        self._accept: Dict[str, Dict[str, int]] = {}  # scenario -> totals
         self.draining = False
         self.admitted = 0
         self.rejected = 0
@@ -248,13 +273,19 @@ class SlotScheduler:
             now = self._clock()
             sc = req.scenario
             word_id = self.engine.word_index(req.word)
+            extra: Dict[str, Any] = {}
+            if self._speculative:
+                # The adaptive-depth dial is per REQUEST: lossless (-1)
+                # unless the scenario opts in with its own margin.
+                extra["exit_margin"] = (sc.exit_margin if sc.adaptive_depth
+                                        else -1.0)
             self.engine.admit(
                 slot, self._encode(req),
                 max_new=sc.max_new_tokens,
                 latent_ids=sc.ablate_latents,
                 basis=self._basis(req),
                 lens_target=(self.lens_target_id if sc.lens_readout else -1),
-                word_id=0 if word_id is None else word_id)
+                word_id=0 if word_id is None else word_id, **extra)
             self._sessions[slot] = _Session(request=req, slot=slot,
                                             admitted_at=now)
             self.admitted += 1
@@ -286,6 +317,8 @@ class SlotScheduler:
             try:
                 resilience.fire("serve.step", request=sess.request.id,
                                 scenario=sess.request.scenario.name)
+                if self._speculative:
+                    self._fire_spec_verify(sess)
             except Exception as exc:  # noqa: BLE001 — quarantine one session
                 responses.append(self._finish(slot, "quarantined", exc=exc))
         if not self._sessions:
@@ -294,9 +327,21 @@ class SlotScheduler:
 
         out = self.engine.step()
         obs_metrics.counter("serve.steps").inc()
+        multi_col = hasattr(out, "toks")      # SpecStepOut: [S, G+1] columns
         for slot, sess in list(self._sessions.items()):
             sess.steps += 1
-            if bool(out.emitted[slot]):
+            if multi_col:
+                for j in range(out.toks.shape[1]):
+                    if bool(out.emit[slot, j]):
+                        sess.tokens.append(int(out.toks[slot, j]))
+                        if sess.request.scenario.lens_readout:
+                            sess.lens_probs.append(
+                                float(out.lens_prob[slot, j]))
+                sess.drafted += int(out.drafted[slot])
+                sess.accepted += int(out.accepted[slot])
+                sess.early += int(out.early[slot])
+                sess.early_agree += int(out.early_agree[slot])
+            elif bool(out.emitted[slot]):
                 sess.tokens.append(int(out.tok[slot]))
                 if sess.request.scenario.lens_readout:
                     sess.lens_probs.append(float(out.lens_prob[slot]))
@@ -306,6 +351,23 @@ class SlotScheduler:
                     self._finish(slot, "eos" if stop_hit else "budget"))
         self._after_step(responses)
         return responses
+
+    def _fire_spec_verify(self, sess: _Session) -> None:
+        """The ``serve.spec.verify`` fault site, with ONE in-place retry:
+        a transient fault (``times: 1`` plan) costs a retry event and the
+        block proceeds; a persistent one (``times >= 2`` or mode ``die``)
+        propagates and quarantines exactly this session — the batch and
+        every other slot keep decoding."""
+        ctx = dict(request=sess.request.id,
+                   scenario=sess.request.scenario.name)
+        try:
+            resilience.fire("serve.spec.verify", **ctx)
+        except resilience.InjectedPermanentFault:
+            raise
+        except Exception as exc:  # noqa: BLE001 — transient: retry once
+            obs.event("serve.spec.retry", request=sess.request.id,
+                      error=f"{type(exc).__name__}: {exc}"[:200])
+            resilience.fire("serve.spec.verify", attempt=1, **ctx)
 
     def _after_step(self, responses: List[Response]) -> None:
         if responses:
@@ -327,7 +389,11 @@ class SlotScheduler:
             latency_seconds=round(now - req.submitted_at, 6),
             lens_probs=(list(sess.lens_probs)
                         if req.scenario.lens_readout else None),
-            error=f"{type(exc).__name__}: {exc}"[:300] if exc else None)
+            error=f"{type(exc).__name__}: {exc}"[:300] if exc else None,
+            drafted=sess.drafted, accepted=sess.accepted,
+            exited_early=sess.early,
+            early_agreement=(round(sess.early_agree / sess.early, 4)
+                             if sess.early else None))
         if ok:
             self.completed += 1
             self._scenarios_completed.add(req.scenario.name)
@@ -335,18 +401,53 @@ class SlotScheduler:
             obs_metrics.histogram(
                 f"serve.latency.{req.scenario.name}").observe(
                 resp.latency_seconds)
+            if self._speculative:
+                agg = self._accept.setdefault(req.scenario.name, {
+                    "responses": 0, "emitted": 0, "steps": 0,
+                    "drafted": 0, "accepted": 0,
+                    "exited_early": 0, "early_agree": 0})
+                agg["responses"] += 1
+                agg["emitted"] += len(sess.tokens)
+                agg["steps"] += sess.steps
+                agg["drafted"] += sess.drafted
+                agg["accepted"] += sess.accepted
+                agg["exited_early"] += sess.early
+                agg["early_agree"] += sess.early_agree
         else:
             self.quarantined += 1
             obs_metrics.counter("serve.quarantined").inc()
+        spec_attrs = ({"drafted": sess.drafted, "accepted": sess.accepted,
+                       "emitted": len(sess.tokens),
+                       "exited_early": sess.early}
+                      if self._speculative else {})
         obs.event("serve.complete", request=req.id, slot=slot,
                   scenario=req.scenario.name, finish=finish,
                   steps=sess.steps, ok=ok,
                   latency_seconds=resp.latency_seconds,
+                  **spec_attrs,
                   **({"word": req.word} if req.word else {}),
                   **({"error": resp.error} if resp.error else {}))
         if self.on_complete is not None:
             self.on_complete(resp)
         return resp
+
+    def accept_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-scenario speculation accounting over COMPLETED sessions —
+        the accept_rate block ``_serve.json`` carries next to the SLO
+        histograms (empty on a vanilla engine).  ``accepted_per_step`` is
+        the device-time view: accepted draft tokens per verify launch."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, agg in sorted(self._accept.items()):
+            d: Dict[str, Any] = dict(agg)
+            d["accept_rate"] = (round(agg["accepted"] / agg["drafted"], 4)
+                                if agg["drafted"] else 0.0)
+            d["accepted_per_step"] = (round(agg["accepted"] / agg["steps"], 4)
+                                      if agg["steps"] else 0.0)
+            if agg["exited_early"]:
+                d["early_agreement"] = round(
+                    agg["early_agree"] / agg["exited_early"], 4)
+            out[name] = d
+        return out
 
     def latency_percentiles(self) -> Dict[str, Dict[str, Any]]:
         """Rolling per-scenario latency percentiles from the SLO histograms

@@ -297,6 +297,13 @@ def serve_forever(
             "quarantined": sched.quarantined,
             "aot": _step_program_stats(engine),
         }
+        if getattr(engine, "speculative", False):
+            # Speculative serving (ISSUE 13): per-scenario accept_rate next
+            # to the SLO histograms, plus the engine-wide accept stats.
+            summary["spec"] = {
+                **engine.accept_stats(),
+                "scenarios": sched.accept_summary(),
+            }
         try:
             atomic_json_dump(summary,
                              os.path.join(output_dir, SERVE_SUMMARY_FILENAME))

@@ -1,0 +1,733 @@
+"""Speculative serving: per-slot draft/verify INSIDE the continuous batch.
+
+The marriage the ROADMAP called the single biggest lever on served
+tokens/sec: PR 9 proved the lens-head draft/verify loop lossless for
+offline decode, PR 6's ``serve_step`` still advances one token per slot per
+launch, and decode is HBM-bound (bench r05) — so batching alone cannot move
+the per-token weight-stream floor, but verifying G drafted tokens in ONE
+full-depth forward amortizes it G+1-fold on accepted runs.
+
+How it composes from what already exists (nothing here forks a kernel):
+
+- **Draft** (``serve.spec.draft``): ONE launched program scanning G
+  single-token forwards over layers 0..k (``speculate._draft_view`` — the
+  draft model is a strict prefix of the target) for every decode-phase
+  slot at once.  The draft has NO persistent KV of its own: layers 0..k of
+  the main cache hold exactly the K/V a draft needs for every verified
+  column (they were written by full-depth feeds), so the program slices
+  them per launch and discards its own in-scan writes — recycling a slot
+  needs no draft-side bookkeeping.  Each step's token is the layer-k lens
+  argmax (``speculate.lens_pick``), each step's top1−top2 lens-logit gap
+  rides out as the adaptive-depth margin.
+- **Verify** (``serve.spec.verify``): ONE full-depth forward over the
+  ``[S, G+1]`` teacher-forced chunk ``[input_tok, d_1..d_G]``, each slot's
+  columns at its OWN offsets (``gemma2.forward(cache_positions=[B, T])``),
+  then the in-graph accept/emit/stop bookkeeping
+  (``speculate.accept_counts`` / ``stop_free_mask`` — the PR 9 kernels).
+  A slot still inside its prompt feeds ONLY chunk column 0 (its draft
+  budget masks to zero), which is bit-for-bit the vanilla single-token
+  prefill step — prefill and admission never left the single-token path.
+  Rejected-draft rollback is implicit: KV validity is recomputed per launch
+  as ``col < pos`` (the ``speculate._valid_cols`` counters argument), so a
+  rejected column simply never becomes valid; the G+1 spare columns past
+  ``max_context`` absorb chunk writes of frozen/prompt slots the way PR 9's
+  TRASH column did — they can never validate.
+- **Lossless contract** (tier-1 gated): with every slot's ``exit_margin``
+  at −1 (off), every emitted token is the full model's verify-pass argmax,
+  so token streams are ``array_equal`` to the vanilla ``serve.step`` engine
+  across all scenarios, mixed words, ragged lengths, EOS/budget stops,
+  mid-block recycle and mid-block drain.  Lens-readout probabilities are
+  f32 byproducts of chunk-shaped forwards and agree to rounding only (the
+  PR 8/9 shape-dependent-fusion caveat); tokens are exact.
+- **Adaptive depth** (opt-in per request, the ``adaptive_depth`` scenario):
+  a drafted token whose margin clears the slot's threshold is accepted
+  WITHOUT requiring argmax agreement — it was emitted at depth k; only
+  contested tokens pay full depth.  The emitted token is then the DRAFT
+  token (its K/V are what the cache holds), and the verify pass's argmax at
+  that position is kept purely as the agreement diagnostic
+  (``early_agree``) the response record reports.
+- **Per-slot (k, G) plans**: G rides as per-slot DATA (``SpecSlots.block``,
+  resolved at admission from the slot's word via
+  ``speculate.resolve_plan`` — env > calibration artifact > heuristic);
+  k selects which layers the draft slices, i.e. a SHAPE, so it resolves
+  once per engine (the max over resident words' plans — a deeper draft
+  only raises agreement).  A slot with a smaller plan masks its chunk tail
+  (the batch-shared draft still computes G steps; masking saves emission
+  mistakes, not FLOPs — the documented price of one compiled program).
+
+Host loop shape: ``step()`` = draft launch + verify launch + one
+``[S, G+1]`` pull, so the scheduler's drain poll and the
+``serve.spec.verify`` fault site keep their control point between verify
+launches exactly like PR 9's block loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from taboo_brittleness_tpu.models.gemma2 import (
+    Gemma2Config, KVCache, Params, forward, unembed)
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+from taboo_brittleness_tpu.runtime import aot, chat
+from taboo_brittleness_tpu.runtime import speculate
+from taboo_brittleness_tpu.serve.engine import (
+    STOP_IDS, EngineConfig, ServeEngine, SlotState, _serve_edit)
+
+import os
+
+
+def enabled() -> bool:
+    """``TBX_SERVE_SPECULATE=1`` routes serving through the speculative
+    engine.  Default OFF — the vanilla single-token step stays the
+    production path until a TPU round lands the ``serve_spec_ab`` table
+    (the ``TBX_FUSED``/``TBX_SPECULATE`` rollout playbook)."""
+    return os.environ.get("TBX_SERVE_SPECULATE", "0") == "1"
+
+
+class SpecSlots(NamedTuple):
+    """Per-slot speculation plan, set at admission (data, never shape)."""
+
+    block: jax.Array    # [S] int32 — draft budget g_s (≤ engine G)
+    margin: jax.Array   # [S] f32 — adaptive-depth margin; < 0 = lossless
+
+    @classmethod
+    def zeros(cls, slots: int, block: int) -> "SpecSlots":
+        return cls(block=jnp.full((slots,), block, jnp.int32),
+                   margin=jnp.full((slots,), -1.0, jnp.float32))
+
+
+class SpecStepOut(NamedTuple):
+    """One speculative step's host view: up to G+1 emissions per slot, in
+    chunk order (``emit`` marks the real ones), plus the per-slot accept
+    accounting the scheduler folds into responses."""
+
+    toks: jax.Array        # [S, G+1] int32 — emitted tokens (PAD elsewhere)
+    emit: jax.Array        # [S, G+1] bool
+    finished: jax.Array    # [S] bool — session completed THIS step
+    lens_prob: jax.Array   # [S, G+1] f32 — P(lens_target) per emission
+    accepted: jax.Array    # [S] int32 — drafted tokens emitted this step
+    drafted: jax.Array     # [S] int32 — drafts offered (the slot's g_s)
+    early: jax.Array       # [S] int32 — emissions accepted via margin
+    early_agree: jax.Array  # [S] int32 — of those, agreeing with full argmax
+
+
+# ---------------------------------------------------------------------------
+# Draft program: G lens-head steps for the whole batch, one launch.
+# ---------------------------------------------------------------------------
+
+def _edit_binding(state: SlotState, sae, sae_layer: int, proj_layer: int):
+    ep: Dict[str, Any] = {
+        "latent_ids": state.latent_ids,
+        "basis": state.basis,
+        "proj_layer": proj_layer,
+    }
+    if sae is not None:
+        ep["sae"] = sae
+        ep["sae_layer"] = sae_layer
+    return lambda h, idx: _serve_edit(h, idx, ep)
+
+
+def _draft_core(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    main_k: jax.Array,
+    main_v: jax.Array,
+    state: SlotState,
+    active: jax.Array,
+    *,
+    draft_layer: int,
+    block_size: int,
+    sae_layer: int,
+    proj_layer: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """G autoregressive lens-head steps over layers 0..k for rows ``active``
+    → ``(drafts [S, G], margins [S, G])``.  The draft cache is a per-launch
+    SLICE of the main cache (see module docstring); its in-scan writes land
+    at columns ≥ each row's ``pos`` — invalid by the counters until a
+    verify feed re-writes them at full depth — and the slice is dropped at
+    launch end, so nothing here persists."""
+    dcfg = cfg.replace(num_layers=draft_layer + 1)
+    dparams = speculate._draft_view(params, draft_layer)
+    dk = main_k[:draft_layer + 1]
+    dv = main_v[:draft_layer + 1]
+    C = main_k.shape[2]
+    col = jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid0 = col < state.pos[:, None]
+    bound = _edit_binding(state, sae, sae_layer, proj_layer)
+
+    def step(carry, _):
+        k, v, valid, tok, c = carry
+        res = forward(
+            dparams, dcfg, tok[:, None],
+            positions=c[:, None],
+            attn_validity=active[:, None],
+            cache=KVCache(k=k, v=v, valid=valid,
+                          length=jnp.zeros((), jnp.int32)),
+            edit_fn=bound,
+            cache_positions=c,
+        )
+        nxt, margin = speculate.lens_pick(params, cfg, res.last_hidden,
+                                          with_margin=True)
+        nxt = jnp.where(active, nxt[:, 0], jnp.int32(chat.PAD_ID))
+        return ((res.cache.k, res.cache.v, res.cache.valid, nxt, c + 1),
+                (nxt, margin[:, 0]))
+
+    _, (drafts, margins) = lax.scan(
+        step, (dk, dv, valid0, state.input_tok, state.pos),
+        None, length=block_size)
+    return jnp.transpose(drafts), jnp.transpose(margins)
+
+
+def _draft_active(state: SlotState) -> jax.Array:
+    """Rows worth drafting for: live AND past their prompt (prompt-phase
+    slots stay on the single-token path — their chunk is column 0 only)."""
+    in_prompt = state.pos + 1 < state.prompt_len
+    return state.active & ~state.done & ~in_prompt
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "draft_layer", "block_size", "sae_layer",
+                          "proj_layer"))
+def serve_spec_draft(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    main_k: jax.Array,
+    main_v: jax.Array,
+    state: SlotState,
+    *,
+    draft_layer: int,
+    block_size: int,
+    sae_layer: int,
+    proj_layer: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """The single-word draft program (``serve.spec.draft``).  The main cache
+    is NOT donated — the verify launch consumes it next."""
+    return _draft_core(
+        params, cfg, sae, main_k, main_v, state, _draft_active(state),
+        draft_layer=draft_layer, block_size=block_size,
+        sae_layer=sae_layer, proj_layer=proj_layer)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "codecs", "draft_layer", "block_size",
+                          "sae_layer", "proj_layer"))
+def serve_spec_draft_multi(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    bank: Dict[str, Dict[str, jax.Array]],
+    main_k: jax.Array,
+    main_v: jax.Array,
+    state: SlotState,
+    *,
+    codecs: Tuple[Tuple[str, str], ...],
+    draft_layer: int,
+    block_size: int,
+    sae_layer: int,
+    proj_layer: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mixed-word drafting: a ``lax.scan`` over the delta bank reconstructs
+    word ``w``'s params (``runtime.delta``) and drafts for that word's slots
+    alone, merged by mask — the ``serve_step_multi`` shape applied to the
+    draft program (W× draft compute, same price the multi step pays)."""
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    base_active = _draft_active(state)
+
+    if not any(codec != "zero" for _, codec in codecs):
+        return _draft_core(
+            params, cfg, sae, main_k, main_v, state, base_active,
+            draft_layer=draft_layer, block_size=block_size,
+            sae_layer=sae_layer, proj_layer=proj_layer)
+
+    W = next(arr.shape[0] for fields in bank.values()
+             for arr in fields.values())
+    S = state.input_tok.shape[0]
+
+    def body(carry, word_slice):
+        drafts_acc, margins_acc = carry
+        w, payload_w = word_slice
+        sel = base_active & (state.word_id == w)
+        params_w = deltalib.reconstruct_params(params, payload_w, codecs)
+        d, mg = _draft_core(
+            params_w, cfg, sae, main_k, main_v, state, sel,
+            draft_layer=draft_layer, block_size=block_size,
+            sae_layer=sae_layer, proj_layer=proj_layer)
+        return (jnp.where(sel[:, None], d, drafts_acc),
+                jnp.where(sel[:, None], mg, margins_acc)), None
+
+    (drafts, margins), _ = lax.scan(
+        body,
+        (jnp.full((S, block_size), chat.PAD_ID, jnp.int32),
+         jnp.zeros((S, block_size), jnp.float32)),
+        (jnp.arange(W, dtype=jnp.int32), bank))
+    return drafts, margins
+
+
+# ---------------------------------------------------------------------------
+# Verify program: one full-depth chunk forward + accept/advance bookkeeping.
+# ---------------------------------------------------------------------------
+
+def _chunk_inputs(state: SlotState, spec: SpecSlots, drafts: jax.Array,
+                  alive: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-slot teacher-forced chunk ``[input_tok, d_1..d_G]`` at columns
+    ``pos..pos+G``, masked to each slot's phase and draft budget: a
+    prompt-phase slot feeds column 0 only (== the vanilla step), a decode
+    slot feeds ``1 + g_s`` columns, frozen slots feed nothing."""
+    S, G = drafts.shape
+    in_prompt = state.pos + 1 < state.prompt_len
+    decode = alive & ~in_prompt
+    g_eff = jnp.where(decode, jnp.minimum(spec.block, G), 0)
+    i = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+    feed_valid = alive[:, None] & (i <= g_eff[:, None])
+    chunk = jnp.concatenate([state.input_tok[:, None], drafts], axis=1)
+    chunk = jnp.where(feed_valid, chunk, jnp.int32(chat.PAD_ID))
+    cols = state.pos[:, None] + i
+    return feed_valid, chunk, cols
+
+
+def _verify_forward(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    cache: KVCache,
+    state: SlotState,
+    chunk: jax.Array,
+    feed_valid: jax.Array,
+    cols: jax.Array,
+    sel: jax.Array,
+    *,
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """The chunk-shaped ``_forward_core``: one full-depth forward over
+    ``[S, G+1]`` positions (each row at its own columns), returning the new
+    cache, per-position argmax ``y [S, G+1]`` and lens prob ``[S, G+1]``.
+    KV validity is recomputed from the position counters (``col < pos``) —
+    the implicit rejected-draft rollback; in-chunk causality comes from the
+    validity-cumsum masking of ``cache_positions=[B, T]`` mode.  Per-row
+    independence (the ``_forward_core`` contract) lets the multi-word
+    verify run this per word under a narrowed ``sel``."""
+    S, G1 = chunk.shape
+    C = cache.k.shape[2]
+    col = jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid = col < state.pos[:, None]
+    bound = _edit_binding(state, sae, sae_layer, proj_layer)
+
+    res = forward(
+        params, cfg, chunk,
+        positions=cols,
+        attn_validity=feed_valid,
+        cache=KVCache(k=cache.k, v=cache.v, valid=valid,
+                      length=jnp.zeros((), jnp.int32)),
+        cache_positions=cols,
+        edit_fn=bound,
+        carry_tap=residual_carry_tap(S, G1, cfg.hidden_size, tap_layer),
+        compute_logits=False,
+    )
+    logits = unembed(params, cfg, res.last_hidden)            # [S, G+1, V]
+    y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    lens_on = (state.lens_target >= 0) & sel
+
+    def _readout(resid_tgt):
+        resid, tgt = resid_tgt
+        from taboo_brittleness_tpu.ops.lens import _lens_logits
+
+        ll = _lens_logits(params, cfg, resid)                 # [S, G+1, V]
+        lse = jax.scipy.special.logsumexp(ll, axis=-1)
+        picked = jnp.take_along_axis(
+            ll, jnp.clip(tgt, 0, cfg.vocab_size - 1)[:, None, None],
+            axis=-1)[..., 0]
+        return jnp.exp(picked - lse)
+
+    lens_prob = lax.cond(
+        jnp.any(lens_on), _readout,
+        lambda _: jnp.zeros((S, G1), jnp.float32),
+        (res.carry_tap, state.lens_target))
+    lens_prob = jnp.where(lens_on[:, None], lens_prob, 0.0)
+    return res.cache, y, lens_prob
+
+
+def _spec_advance(
+    state: SlotState,
+    spec: SpecSlots,
+    drafts: jax.Array,
+    margins: jax.Array,
+    y: jax.Array,
+    lens_prob: jax.Array,
+    stop_ids: Tuple[int, ...],
+) -> Tuple[SlotState, SpecStepOut]:
+    """Accept + emit + advance, [S]-wide and branch-free — the speculative
+    ``_advance``.  Emission index i emits the FED draft ``d_{i+1}`` while
+    ``i < m`` (under plain match it equals ``y_i``; under a margin accept it
+    is the depth-k early exit whose K/V the cache actually holds) and the
+    verify pass's own ``y_m`` as the full-depth bonus at ``i == m``, gated
+    by the per-slot budget and the stop-free prefix exactly like
+    ``verify_block``."""
+    S, G1 = y.shape
+    G = G1 - 1
+    i = jnp.arange(G1, dtype=jnp.int32)[None, :]
+    alive = state.active & ~state.done
+    in_prompt = state.pos + 1 < state.prompt_len
+    decode = alive & ~in_prompt
+    g_eff = jnp.where(decode, jnp.minimum(spec.block, G), 0)
+
+    adaptive = spec.margin >= 0.0
+    margin_ok = (decode[:, None] & adaptive[:, None]
+                 & (margins > spec.margin[:, None]))
+    match, m = speculate.accept_counts(drafts, y, limit=g_eff,
+                                       extra=margin_ok)
+    m = jnp.where(decode, m, 0)
+
+    drafts_p = jnp.concatenate(
+        [drafts, jnp.full((S, 1), chat.PAD_ID, jnp.int32)], axis=1)
+    stream = jnp.where(i < m[:, None], drafts_p, y)           # [S, G+1]
+    sf = speculate.stop_free_mask(stream, stop_ids)
+    budget_ok = (state.gen_count[:, None] + i) < state.max_gen[:, None]
+    emit_i = decode[:, None] & (i <= m[:, None]) & budget_ok & sf
+    count = jnp.sum(emit_i, axis=1).astype(jnp.int32)
+
+    st = speculate._is_stop(stream, stop_ids)
+    stop_emitted = jnp.any(emit_i & st, axis=1)
+    finished = decode & (stop_emitted
+                         | (state.gen_count + count >= state.max_gen))
+
+    last_emitted = jnp.take_along_axis(
+        stream, jnp.clip(count - 1, 0, G)[:, None], axis=1)[:, 0]
+    next_from_prompt = jnp.take_along_axis(
+        state.prompt_buf,
+        jnp.clip(state.pos + 1, 0, state.prompt_buf.shape[1] - 1)[:, None],
+        axis=1)[:, 0]
+    alive_next = alive & ~finished
+    next_tok = jnp.where(in_prompt, next_from_prompt, last_emitted)
+    next_tok = jnp.where(alive_next, next_tok, chat.PAD_ID)
+    kept = jnp.where(in_prompt, jnp.int32(1), count)
+
+    new_state = state._replace(
+        input_tok=next_tok,
+        pos=jnp.where(alive_next, state.pos + kept, state.pos),
+        done=state.done | finished,
+        gen_count=state.gen_count + count,
+    )
+
+    pad_col = jnp.zeros((S, 1), bool)
+    margin_p = jnp.concatenate([margin_ok, pad_col], axis=1)
+    match_p = jnp.concatenate([match, pad_col], axis=1)
+    early_i = emit_i & (i < m[:, None]) & margin_p
+    out = SpecStepOut(
+        toks=jnp.where(emit_i, stream, jnp.int32(chat.PAD_ID)),
+        emit=emit_i,
+        finished=finished,
+        lens_prob=jnp.where(emit_i, lens_prob, 0.0),
+        accepted=jnp.minimum(m, count),
+        drafted=g_eff,
+        early=jnp.sum(early_i, axis=1).astype(jnp.int32),
+        early_agree=jnp.sum(early_i & match_p, axis=1).astype(jnp.int32),
+    )
+    return new_state, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
+                          "stop_ids"),
+         donate_argnames=("cache", "state"))
+def serve_spec_verify(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    cache: KVCache,
+    state: SlotState,
+    spec: SpecSlots,
+    drafts: jax.Array,
+    margins: jax.Array,
+    *,
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+    stop_ids: Tuple[int, ...] = STOP_IDS,
+) -> Tuple[KVCache, SlotState, SpecStepOut]:
+    """The single-word verify program (``serve.spec.verify``): chunk forward
+    + accept bookkeeping, cache/state donated like ``serve_step``."""
+    alive = state.active & ~state.done
+    feed_valid, chunk, cols = _chunk_inputs(state, spec, drafts, alive)
+    new_cache, y, lens_prob = _verify_forward(
+        params, cfg, sae, cache, state, chunk, feed_valid, cols, alive,
+        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+    new_state, out = _spec_advance(state, spec, drafts, margins, y,
+                                   lens_prob, stop_ids)
+    return new_cache, new_state, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "codecs", "sae_layer", "proj_layer",
+                          "tap_layer", "stop_ids"),
+         donate_argnames=("cache", "state"))
+def serve_spec_verify_multi(
+    params: Params,
+    cfg: Gemma2Config,
+    sae: Optional[sae_ops.SAEParams],
+    bank: Dict[str, Dict[str, jax.Array]],
+    cache: KVCache,
+    state: SlotState,
+    spec: SpecSlots,
+    drafts: jax.Array,
+    margins: jax.Array,
+    *,
+    codecs: Tuple[Tuple[str, str], ...],
+    sae_layer: int,
+    proj_layer: int,
+    tap_layer: int,
+    stop_ids: Tuple[int, ...] = STOP_IDS,
+) -> Tuple[KVCache, SlotState, SpecStepOut]:
+    """Mixed-word verify: scan-over-words chunk forwards merged by word
+    mask (the ``serve_step_multi`` shape), then ONE shared accept/advance
+    over the merged ``y`` — per-slot (k, G) plans and word identity both
+    ride as data through one compiled program."""
+    from taboo_brittleness_tpu.runtime import delta as deltalib
+
+    alive = state.active & ~state.done
+    feed_valid, chunk, cols = _chunk_inputs(state, spec, drafts, alive)
+
+    if not any(codec != "zero" for _, codec in codecs):
+        new_cache, y, lens_prob = _verify_forward(
+            params, cfg, sae, cache, state, chunk, feed_valid, cols, alive,
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        new_state, out = _spec_advance(state, spec, drafts, margins, y,
+                                       lens_prob, stop_ids)
+        return new_cache, new_state, out
+
+    W = next(arr.shape[0] for fields in bank.values()
+             for arr in fields.values())
+    S, G1 = chunk.shape
+    length0 = cache.length
+
+    def body(carry, word_slice):
+        cache_c, y_acc, lens_acc = carry
+        w, payload_w = word_slice
+        sel = alive & (state.word_id == w)
+        params_w = deltalib.reconstruct_params(params, payload_w, codecs)
+        new_cache, y, lens_prob = _verify_forward(
+            params_w, cfg, sae, cache_c, state, chunk,
+            feed_valid & sel[:, None], cols, sel,
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        sel_r = sel[None, :, None, None, None]
+        merged = KVCache(
+            k=jnp.where(sel_r, new_cache.k, cache_c.k),
+            v=jnp.where(sel_r, new_cache.v, cache_c.v),
+            valid=jnp.where(sel[:, None], new_cache.valid, cache_c.valid),
+            length=length0,
+        )
+        return (merged,
+                jnp.where(sel[:, None], y, y_acc),
+                jnp.where(sel[:, None], lens_prob, lens_acc)), None
+
+    (new_cache, y, lens_prob), _ = lax.scan(
+        body,
+        (cache, jnp.zeros((S, G1), jnp.int32),
+         jnp.zeros((S, G1), jnp.float32)),
+        (jnp.arange(W, dtype=jnp.int32), bank))
+    new_state, out = _spec_advance(state, spec, drafts, margins, y,
+                                   lens_prob, stop_ids)
+    return new_cache, new_state, out
+
+
+# ---------------------------------------------------------------------------
+# The engine.
+# ---------------------------------------------------------------------------
+
+class SpecServeEngine(ServeEngine):
+    """:class:`ServeEngine` whose ``step()`` is a draft+verify block.
+
+    Drop-in for the scheduler: admission, capacity, recycle and the word
+    index are inherited unchanged (prefill IS the masked chunk column 0);
+    ``step()`` returns a :class:`SpecStepOut` whose multi-column emissions
+    the scheduler iterates in order.  ``admit`` additionally resolves the
+    slot's (word-calibrated) draft budget and the request's adaptive-depth
+    margin into per-slot data.
+    """
+
+    speculative = True
+
+    def __init__(self, params: Params, cfg: Gemma2Config, tok, *,
+                 engine_config: Optional[EngineConfig] = None,
+                 sae: Optional[sae_ops.SAEParams] = None,
+                 words=(), delta_bank: Optional[Tuple] = None,
+                 draft_layer: Optional[int] = None,
+                 block_size: Optional[int] = None):
+        super().__init__(params, cfg, tok, engine_config=engine_config,
+                         sae=sae, words=words, delta_bank=delta_bank)
+        # Per-word plans (env > calibration artifact > heuristic).  k is a
+        # shape parameter — one engine-wide value, the deepest plan among
+        # resident words; G is the engine ceiling, per-slot g_s rides as
+        # data below it.
+        plan_words = self.words if self.words else (None,)
+        self.plans: Dict[Optional[str], speculate.SpecPlan] = {
+            w: speculate.resolve_plan(cfg, w) for w in plan_words}
+        k = (int(draft_layer) if draft_layer is not None
+             else max(p.draft_layer for p in self.plans.values()))
+        self.draft_layer = max(0, min(k, cfg.num_layers - 2))
+        g = (int(block_size) if block_size is not None
+             else max(p.block_size for p in self.plans.values()))
+        self.block = max(1, g)
+        self.spec = SpecSlots.zeros(self.ec.slots, self.block)
+        # Widen the KV pages by G+1 columns: a verify chunk writes up to G
+        # columns past a slot's kept prefix (rejected drafts, frozen slots'
+        # scatter targets) — the tail region that can never validate plays
+        # PR 9's TRASH-column role.
+        self.cache = KVCache.zeros(
+            cfg, self.ec.slots, max_len=self.ec.max_context + self.block + 1)
+        self.aot_draft = ("serve.spec.draft.multi" if self.multi
+                          else "serve.spec.draft")
+        self.aot_verify = ("serve.spec.verify.multi" if self.multi
+                           else "serve.spec.verify")
+        #: the serve summary's zero-recompile gate reads the verify program
+        self.aot_name = self.aot_verify
+        self._draft_fn = (serve_spec_draft_multi if self.multi
+                          else serve_spec_draft)
+        self._verify_fn = (serve_spec_verify_multi if self.multi
+                           else serve_spec_verify)
+        # Host accumulators (the `_serve.json` / bench accept stats).
+        self.drafted_total = 0
+        self.accepted_total = 0
+        self.emitted_total = 0
+        self.early_total = 0
+
+    # -- plan resolution -----------------------------------------------------
+
+    def plan_for(self, word_id: int) -> speculate.SpecPlan:
+        w = (self.words[word_id]
+             if self.words and 0 <= word_id < len(self.words) else None)
+        plan = self.plans.get(w)
+        return plan if plan is not None else next(iter(self.plans.values()))
+
+    # -- program plumbing ----------------------------------------------------
+
+    def _draft_static(self) -> Dict[str, Any]:
+        static = dict(cfg=self.cfg, draft_layer=self.draft_layer,
+                      block_size=self.block,
+                      sae_layer=self.ec.sae_layer,
+                      proj_layer=self.ec.proj_layer)
+        if self.multi:
+            static["codecs"] = self.delta_codecs
+        return static
+
+    def _draft_dynamic(self) -> Dict[str, Any]:
+        dynamic = dict(params=self.params, sae=self.sae,
+                       main_k=self.cache.k, main_v=self.cache.v,
+                       state=self.state)
+        if self.multi:
+            dynamic["bank"] = self.delta_bank
+        return dynamic
+
+    def _verify_static(self) -> Dict[str, Any]:
+        static = dict(cfg=self.cfg, sae_layer=self.ec.sae_layer,
+                      proj_layer=self.ec.proj_layer,
+                      tap_layer=self.ec.tap_layer,
+                      stop_ids=self.ec.stop_ids)
+        if self.multi:
+            static["codecs"] = self.delta_codecs
+        return static
+
+    def _verify_dynamic(self, drafts, margins) -> Dict[str, Any]:
+        dynamic = dict(params=self.params, sae=self.sae, cache=self.cache,
+                       state=self.state, spec=self.spec,
+                       drafts=drafts, margins=margins)
+        if self.multi:
+            dynamic["bank"] = self.delta_bank
+        return dynamic
+
+    def warm_start(self) -> Dict[str, Any]:
+        """Build BOTH programs into the AOT registry (``execute=False`` —
+        the verify donates the resident cache/state)."""
+        draft = aot.entry(self.aot_draft, self._draft_fn).build(
+            self._draft_dynamic(), self._draft_static(), execute=False)
+        drafts = jnp.zeros((self.ec.slots, self.block), jnp.int32)
+        margins = jnp.zeros((self.ec.slots, self.block), jnp.float32)
+        verify = aot.entry(self.aot_verify, self._verify_fn).build(
+            self._verify_dynamic(drafts, margins), self._verify_static(),
+            execute=False)
+        return {self.aot_draft: draft, self.aot_verify: verify}
+
+    def step(self) -> SpecStepOut:
+        """One draft launch + one verify launch + one ``[S, G+1]`` pull.
+
+        The verify rides an ``obs.span`` whose end event carries the accept
+        record (drafted/accepted/emitted) — the ``trace_report --check``
+        contract that every ``serve.spec.verify`` span resolves to one —
+        plus the device-profiler annotation for both programs.
+        """
+        from taboo_brittleness_tpu import obs
+        from taboo_brittleness_tpu.obs import profile as obs_profile
+
+        with obs_profile.annotate(self.aot_draft, fn=self._draft_fn):
+            drafts, margins = aot.dispatch(
+                self.aot_draft, self._draft_fn,
+                dynamic=self._draft_dynamic(), static=self._draft_static())
+        with obs.span("serve.spec.verify", kind="program", step=self.steps,
+                      program=self.aot_verify) as sp:
+            with obs_profile.annotate(self.aot_verify, fn=self._verify_fn,
+                                      span_id=getattr(sp, "span_id", None)):
+                self.cache, self.state, out = aot.dispatch(
+                    self.aot_verify, self._verify_fn,
+                    dynamic=self._verify_dynamic(drafts, margins),
+                    static=self._verify_static())
+            self.steps += 1
+            # tbx: TBX001-ok — host control point: the scheduler needs the
+            # emitted/finished columns each block (one [S, G+1] pull).
+            host = jax.device_get(out)
+            drafted = int(np.sum(host.drafted))
+            accepted = int(np.sum(host.accepted))
+            emitted = int(np.sum(host.emit))
+            early = int(np.sum(host.early))
+            self.drafted_total += drafted
+            self.accepted_total += accepted
+            self.emitted_total += emitted
+            self.early_total += early
+            sp.set(drafted=drafted, accepted=accepted, emitted=emitted,
+                   early_exits=early)
+        return host
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, slot: int, prompt_ids, *, max_new: int,
+              latent_ids=(), basis=None, lens_target: int = -1,
+              word_id: int = 0, exit_margin: float = -1.0) -> None:
+        """Vanilla admission plus the slot's speculation plan: g_s from the
+        word's calibrated plan (clamped to the engine ceiling), and the
+        request's adaptive-depth margin (< 0 = lossless)."""
+        super().admit(slot, prompt_ids, max_new=max_new,
+                      latent_ids=latent_ids, basis=basis,
+                      lens_target=lens_target, word_id=word_id)
+        g = min(self.plan_for(word_id).block_size, self.block)
+        self.spec = SpecSlots(
+            block=self.spec.block.at[slot].set(int(g)),
+            margin=self.spec.margin.at[slot].set(float(exit_margin)))
+
+    def accept_stats(self) -> Dict[str, Any]:
+        """Engine-level accept accounting (the `_serve.json` spec block)."""
+        return {
+            "draft_layer": self.draft_layer,
+            "block_size": self.block,
+            "blocks": self.steps,
+            "drafted": self.drafted_total,
+            "accepted": self.accepted_total,
+            "emitted": self.emitted_total,
+            "exited_early": self.early_total,
+            "accept_rate": (round(self.accepted_total / self.drafted_total, 4)
+                            if self.drafted_total else 0.0),
+            "tokens_per_verify": (round(self.emitted_total / self.steps, 4)
+                                  if self.steps else 0.0),
+        }

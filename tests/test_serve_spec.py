@@ -1,0 +1,542 @@
+"""In-serve speculation (serve/spec_engine.py, ISSUE 13).
+
+The lossless contract, gated: behind ``TBX_SERVE_SPECULATE=1`` the
+speculative engine's token streams are ``array_equal`` to the vanilla
+``serve.step`` engine across every scenario, mixed words, ragged slot
+lengths, EOS/budget early stop, slot recycle mid-block and drain
+mid-block.  Plus the satellites' seams:
+
+- zero AOT misses after ``warm_start`` for BOTH spec programs;
+- the adaptive-depth scenario's early-exit accounting (opt-in, excluded
+  from exactness by contract);
+- the ``serve.spec.verify`` fault site: transient retry-in-place,
+  permanent single-session quarantine (batch lives), env fault plan;
+- per-word (k, G) plan resolution at admission (env > calibration
+  artifact > heuristic);
+- the calibrator's batch-width cost term (optimal G grows with occupancy);
+- the bench_compare / trace_report / loadgen reporting surfaces.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import sae as sae_ops
+from taboo_brittleness_tpu.perf import spec_calibrate
+from taboo_brittleness_tpu.runtime import aot, chat, resilience, speculate, supervise
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer, target_token_id
+from taboo_brittleness_tpu.serve import loadgen, spec_engine
+from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+from taboo_brittleness_tpu.serve.scheduler import (
+    Request, SlotScheduler, default_scenarios)
+from taboo_brittleness_tpu.serve.spec_engine import SpecServeEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+WORDS = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+         "Give", "me", "a", "the", "about"]
+TAP = 2
+
+#: scenarios under the lossless contract (adaptive_depth is excluded BY
+#: contract — it trades exactness for the depth-k early exit).
+LOSSLESS = ("chat", "chat_lens", "sae_ablate", "projection", "forcing")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    tok = WordTokenizer(WORDS, vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(8), cfg.hidden_size, 64)
+    return params, cfg, tok, sae
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+
+
+def make_engine(tiny, cls, *, slots=3, stop_ids=(-1,), max_context=48,
+                **kw):
+    """Either engine class over the same envelope; stop_ids=(-1,) =
+    fixed-length sessions (uniform work, column-by-column comparison)."""
+    params, cfg, tok, sae = tiny
+    return cls(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=slots, max_context=max_context, prompt_cols=24,
+            latent_slots=4, proj_rank=2,
+            sae_layer=TAP, proj_layer=TAP, tap_layer=TAP,
+            stop_ids=stop_ids),
+        sae=sae, **kw)
+
+
+def run_sched(engine, tok, specs, *, scenarios=None, max_new=5,
+              step_hook=None):
+    """Drive ``specs`` = [(scenario_name, prompt), ...] through a fresh
+    scheduler; returns ({request_id: Response}, scheduler).  Requests are
+    rebuilt each call (ids/seeds deterministic) so both arms see identical
+    inputs."""
+    scenarios = scenarios or default_scenarios(max_new_tokens=max_new)
+    done = {}
+    sched = SlotScheduler(engine,
+                          lens_target_id=target_token_id(tok, "ship"),
+                          on_complete=lambda r: done.__setitem__(r.id, r))
+    for i, (name, prompt) in enumerate(specs):
+        assert sched.submit(Request(id=f"r{i:03d}-{name}", prompt=prompt,
+                                    scenario=scenarios[name], seed=100 + i))
+    if step_hook is not None:
+        step_hook(sched)
+    sched.run_until_idle()
+    return done, sched
+
+
+def assert_streams_equal(off, on, *, lens_atol=1e-4):
+    """Token streams bit-identical (the contract); lens probs allclose
+    (chunk-shaped f32 fusions may reassociate — PR 8/9 precedent)."""
+    assert set(off) == set(on)
+    for rid in sorted(off):
+        a, b = off[rid], on[rid]
+        assert b.tokens == a.tokens, (
+            f"{rid}: spec-on stream diverged\n off={a.tokens}\n on={b.tokens}")
+        assert b.finish == a.finish, (rid, a.finish, b.finish)
+        if a.lens_probs is not None:
+            assert b.lens_probs is not None and np.allclose(
+                a.lens_probs, b.lens_probs, atol=lens_atol), rid
+
+
+# ---------------------------------------------------------------------------
+# The lossless contract.
+# ---------------------------------------------------------------------------
+
+def test_lossless_all_scenarios(tiny):
+    """Every lossless scenario through both arms — token streams exactly
+    equal, and the speculative arm actually speculated (accepted > 0)."""
+    _, _, tok, _ = tiny
+    specs = [(name, "Give me a hint about the word") for name in LOSSLESS]
+    off, _ = run_sched(make_engine(tiny, ServeEngine), tok, specs)
+    eng = make_engine(tiny, SpecServeEngine)
+    on, _ = run_sched(eng, tok, specs)
+    assert_streams_equal(off, on)
+    stats = eng.accept_stats()
+    assert stats["drafted"] > 0 and stats["accepted"] > 0
+    assert 0.0 < stats["accept_rate"] <= 1.0
+    # Multi-token blocks resolved in fewer verify launches than tokens
+    # emitted by the vanilla engine's one-per-step cadence.
+    assert stats["tokens_per_verify"] > 0
+
+
+def test_lossless_ragged_prompts_and_recycle(tiny):
+    """Ragged slot lengths + recycle mid-block: more requests than slots,
+    prompts of very different lengths, fixed-length sessions — streams
+    stay bit-identical through slot reuse."""
+    _, _, tok, _ = tiny
+    specs = [
+        ("chat", "hint"),
+        ("chat_lens", "Give me a clue about the word"),
+        ("sae_ablate", "My secret word is a ship about the moon"),
+        ("chat", "Give me a hint"),
+        ("projection", "a clue about a clue about a clue"),
+        ("forcing", "me"),
+        ("chat", "the secret is the word"),
+    ]
+    off, _ = run_sched(make_engine(tiny, ServeEngine, slots=2), tok, specs)
+    on, sched = run_sched(make_engine(tiny, SpecServeEngine, slots=2),
+                          tok, specs)
+    assert_streams_equal(off, on)
+    assert sched.completed == len(specs) and sched.quarantined == 0
+
+
+def test_lossless_eos_and_budget_early_stop(tiny):
+    """Real stop ids: sessions end on EOS/end-of-turn inside a block or on
+    budget — the finish reason and the (possibly short) stream both match
+    the vanilla arm."""
+    _, _, tok, _ = tiny
+    stop = (chat.EOS_ID, chat.END_OF_TURN_ID)
+    specs = [("chat", "Give me a hint"), ("forcing", "Give me a hint"),
+             ("chat_lens", "clue me"), ("chat", "word is moon")]
+    off, _ = run_sched(make_engine(tiny, ServeEngine, stop_ids=stop),
+                       tok, specs, max_new=8)
+    on, _ = run_sched(make_engine(tiny, SpecServeEngine, stop_ids=stop),
+                      tok, specs, max_new=8)
+    assert_streams_equal(off, on)
+    assert {r.finish for r in off.values()} <= {"eos", "budget"}
+
+
+def test_lossless_drain_mid_block(tiny):
+    """drain() between verify launches: in-flight sessions run to
+    completion (zero drops), new submits are rejected, streams unchanged."""
+    _, _, tok, _ = tiny
+    specs = [("chat", "Give me a hint"), ("chat_lens", "a clue"),
+             ("sae_ablate", "the word is")]
+    off, _ = run_sched(make_engine(tiny, ServeEngine), tok, specs)
+
+    def hook(sched):
+        sched.step()                   # one verify block in flight
+        sched.drain()
+        rejected = sched.submit(Request(
+            id="r999-late", prompt="hint",
+            scenario=default_scenarios(max_new_tokens=5)["chat"], seed=9))
+        assert rejected is False
+
+    on, sched = run_sched(make_engine(tiny, SpecServeEngine), tok, specs,
+                          step_hook=hook)
+    assert_streams_equal(off, on)
+    assert sched.completed == len(specs) and sched.rejected == 1
+
+
+def test_lossless_multi_word_engine(tiny):
+    """Mixed words through the delta-bank spec engine: the seeded loadgen
+    schedule (words, scenarios, prompts) replayed over both arms — every
+    lossless stream identical; the off arm's report has no spec block,
+    the on arm's does."""
+    del tiny  # the synthetic builders own their params
+
+    def arm(speculative):
+        engine, scenarios, lens_tgt = loadgen.build_synthetic_multi_engine(
+            words=("ship", "moon"), slots=3, max_new_tokens=5,
+            speculative=speculative)
+        streams = {}
+        report = loadgen.run_inprocess(
+            engine, n_requests=10, seed=11, rate=500.0, concurrency=6,
+            scenarios=scenarios, lens_target_id=lens_tgt,
+            words=("ship", "moon"),
+            on_complete=lambda r: streams.__setitem__(
+                r.id, (r.scenario, r.word, tuple(r.tokens))))
+        return streams, report
+
+    streams_off, report_off = arm(False)
+    streams_on, report_on = arm(True)
+    assert "spec" not in report_off and report_on["spec"]["drafted"] > 0
+    assert set(streams_off) == set(streams_on)
+    for rid, (sc, word, toks) in sorted(streams_off.items()):
+        if sc == "adaptive_depth":
+            continue                   # excluded from exactness by contract
+        assert streams_on[rid] == (sc, word, toks), rid
+    for sc, block in report_on["spec"]["scenarios"].items():
+        assert 0 <= block["accepted"] <= block["drafted"] or sc
+        assert "accept_rate" in block
+
+
+# ---------------------------------------------------------------------------
+# One compiled program per phase: zero AOT misses after warm_start.
+# ---------------------------------------------------------------------------
+
+def test_zero_recompile_after_warm_start(tiny):
+    _, _, tok, _ = tiny
+    eng = make_engine(tiny, SpecServeEngine)
+    aot.reset()
+    eng.warm_start()
+    run_sched(eng, tok, [(n, "Give me a hint") for n in LOSSLESS])
+    stats = aot.stats()
+    for name in (eng.aot_draft, eng.aot_verify):
+        st = stats[name]
+        assert st["misses"] == 0 and st["fallbacks"] == 0, (name, st)
+        assert st["hits"] > 0, (name, st)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive depth (the opt-in dial).
+# ---------------------------------------------------------------------------
+
+def test_adaptive_depth_dial(tiny):
+    """An adaptive session (margin 0: every positive lens gap clears)
+    exits early and reports agreement; the lossless sessions sharing the
+    batch still match the vanilla arm exactly."""
+    _, _, tok, _ = tiny
+    scenarios = default_scenarios(max_new_tokens=6, adaptive_exit_margin=0.0)
+    specs = [("chat", "Give me a hint"), ("adaptive_depth", "Give me a hint"),
+             ("chat_lens", "a clue about the word")]
+    off, _ = run_sched(make_engine(tiny, ServeEngine), tok, specs,
+                       scenarios=scenarios, max_new=6)
+    off.pop("r001-adaptive_depth")     # excluded from exactness by contract
+    eng = make_engine(tiny, SpecServeEngine)
+    on, _ = run_sched(eng, tok, specs, scenarios=scenarios, max_new=6)
+    adaptive = on.pop("r001-adaptive_depth")
+    assert_streams_equal(off, on)
+    assert adaptive.ok and len(adaptive.tokens) == 6
+    assert adaptive.exited_early > 0
+    assert adaptive.early_agreement is not None
+    assert 0.0 <= adaptive.early_agreement <= 1.0
+    lossless = [r for r in on.values()]
+    assert all(r.exited_early == 0 for r in lossless)
+    assert eng.accept_stats()["exited_early"] == adaptive.exited_early
+
+
+# ---------------------------------------------------------------------------
+# The serve.spec.verify fault site.
+# ---------------------------------------------------------------------------
+
+def test_spec_verify_transient_fault_retries_in_place(tiny, tmp_path):
+    """times=1 transient: the block retries once (serve.spec.retry event),
+    nothing is quarantined, streams complete."""
+    _, _, tok, _ = tiny
+    inj = FaultInjector()
+    inj.arm("serve.spec.verify", times=1, match="r001")
+    resilience.set_injector(inj)
+    path = str(tmp_path / "_events.jsonl")
+    t = obs.activate(path)
+    try:
+        done, sched = run_sched(make_engine(tiny, SpecServeEngine), tok,
+                                [("chat", "Give me a hint"),
+                                 ("chat_lens", "a clue")])
+    finally:
+        obs.deactivate(t)
+    assert sched.quarantined == 0 and all(r.ok for r in done.values())
+    events = list(obs.iter_events(path))
+    retries = [e for e in events if e.get("ev") == "point"
+               and e.get("name") == "serve.spec.retry"]
+    assert len(retries) == 1
+    assert "r001" in str(retries[0].get("attrs", {}).get("request"))
+
+
+def test_spec_verify_permanent_fault_quarantines_one_session(tiny):
+    """A permanent fault matching ONE request quarantines exactly that
+    session; every other slot keeps decoding to completion."""
+    _, _, tok, _ = tiny
+    inj = FaultInjector()
+    inj.arm("serve.spec.verify", kind="permanent", match="poison")
+    resilience.set_injector(inj)
+    specs = [("chat", "Give me a hint"), ("chat_lens", "a clue"),
+             ("sae_ablate", "the word is")]
+    scenarios = default_scenarios(max_new_tokens=5)
+    done = {}
+    sched = SlotScheduler(
+        make_engine(tiny, SpecServeEngine), lens_target_id=-1,
+        on_complete=lambda r: done.__setitem__(r.id, r))
+    for i, (name, prompt) in enumerate(specs):
+        rid = "poison-r001" if i == 1 else f"r{i:03d}-{name}"
+        assert sched.submit(Request(id=rid, prompt=prompt,
+                                    scenario=scenarios[name], seed=100 + i))
+    sched.run_until_idle()
+    bad = done.pop("poison-r001")
+    assert not bad.ok and bad.finish == "quarantined"
+    assert "InjectedPermanentFault" in bad.error
+    assert sched.quarantined == 1 and sched.completed == 2
+    assert all(r.ok and len(r.tokens) == 5 for r in done.values())
+
+
+def test_spec_verify_fault_plan_env(tiny, monkeypatch):
+    """The seeded TABOO_FAULT_PLAN path reaches the new site."""
+    _, _, tok, _ = tiny
+    monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps({
+        "serve.spec.verify": {"mode": "fail", "kind": "permanent",
+                              "times": 1, "match": "poison"}}))
+    resilience.set_injector(None)      # re-read from env
+    scenarios = default_scenarios(max_new_tokens=4)
+    done = {}
+    sched = SlotScheduler(
+        make_engine(tiny, SpecServeEngine, slots=2), lens_target_id=-1,
+        on_complete=lambda r: done.__setitem__(r.id, r))
+    assert sched.submit(Request(id="poison-env", prompt="Give me a hint",
+                                scenario=scenarios["chat"], seed=1))
+    assert sched.submit(Request(id="clean", prompt="a clue",
+                                scenario=scenarios["chat"], seed=2))
+    sched.run_until_idle()
+    assert not done["poison-env"].ok
+    assert done["poison-env"].finish == "quarantined"
+    assert done["clean"].ok
+
+
+# ---------------------------------------------------------------------------
+# Plan resolution at admission (env > calibration artifact > heuristic).
+# ---------------------------------------------------------------------------
+
+def test_plan_env_override_and_clamp(tiny, monkeypatch):
+    params, cfg, tok, sae = tiny
+    monkeypatch.setenv("TBX_SPEC_DRAFT_LAYER", "99")   # clamped to L-2
+    monkeypatch.setenv("TBX_SPEC_BLOCK", "4")
+    eng = make_engine(tiny, SpecServeEngine)
+    assert eng.draft_layer == cfg.num_layers - 2
+    assert eng.block == 4
+    assert eng.plans[None].source == "env"
+    # Admission writes the per-slot draft budget from the plan.
+    eng.admit(0, tok.encode(chat.user_prompt("hint")), max_new=4)
+    assert int(eng.spec.block[0]) == 4
+    assert float(eng.spec.margin[0]) == -1.0           # lossless default
+
+
+def test_plan_calibration_artifact(tiny, monkeypatch, tmp_path):
+    params, cfg, tok, sae = tiny
+    monkeypatch.delenv("TBX_SPEC_DRAFT_LAYER", raising=False)
+    monkeypatch.delenv("TBX_SPEC_BLOCK", raising=False)
+    art = tmp_path / "spec_calibration.json"
+    art.write_text(json.dumps({
+        "words": {"ship": {"draft_layer": 1, "block_size": 5}},
+        "default": {"draft_layer": 1, "block_size": 2}}))
+    monkeypatch.setenv("TBX_SPEC_CALIBRATION", str(art))
+    plan = speculate.resolve_plan(cfg, "ship")
+    assert (plan.draft_layer, plan.block_size) == (1, 5)
+    assert plan.source == "calibration"
+    # A single-word engine resolves without a word -> the default block.
+    eng = make_engine(tiny, SpecServeEngine)
+    assert eng.draft_layer == 1 and eng.block == 2
+    # Explicit constructor overrides beat everything (bench A/B knob).
+    eng2 = make_engine(tiny, SpecServeEngine, draft_layer=0, block_size=1)
+    assert eng2.draft_layer == 0 and eng2.block == 1
+
+
+# ---------------------------------------------------------------------------
+# Calibrator: the batch-width cost term.
+# ---------------------------------------------------------------------------
+
+def test_block_cost_batch_width_term(tiny):
+    """Per-row weight streams deflate as 1/rows while the per-row KV
+    re-read is flat — so the marginal-draft/verify cost ratio falls
+    monotonically with occupancy."""
+    _, cfg, _, _ = tiny
+    prev_ratio = None
+    prev_verify = None
+    for rows in (1, 4, 16, 64):
+        draft, verify, vanilla = spec_calibrate.block_cost(
+            cfg, 1, 1, rows=rows, seq_len=64)
+        assert 0 < draft < verify and verify == vanilla
+        if prev_ratio is not None:
+            assert draft / verify < prev_ratio
+            assert verify < prev_verify
+        prev_ratio, prev_verify = draft / verify, verify
+
+
+def test_calibrated_block_grows_with_occupancy(tiny):
+    """The serving engine calibrates at its slot count: at fixed agreement
+    the chosen G is nondecreasing in rows (and strictly larger at high
+    occupancy than the offline rows=1 plan for mid agreement)."""
+    _, cfg, _, _ = tiny
+    agreement = [0.6] * cfg.num_layers
+    gs = [spec_calibrate.calibrate_word(
+        agreement, cfg, max_block=8, rows=r)["block_size"]
+        for r in (1, 8, 64)]
+    assert gs == sorted(gs), gs
+    assert gs[-1] > gs[0], gs
+    assert all(1 <= g <= 8 for g in gs)
+
+
+# ---------------------------------------------------------------------------
+# Reporting surfaces: loadgen report, trace_report, bench_compare.
+# ---------------------------------------------------------------------------
+
+def test_loadgen_spec_report_and_trace_stream(tiny, tmp_path):
+    """One speculative loadgen run feeds three gates: the report's spec
+    block, the trace_report serving section's speculation line, and the
+    --check invariant that every verify span carries an accept record."""
+    del tiny
+    path = str(tmp_path / "_events.jsonl")
+    engine, scenarios, lens_tgt = loadgen.build_synthetic_engine(
+        slots=3, max_new_tokens=5, speculative=True)
+    t = obs.activate(path)
+    try:
+        report = loadgen.run_inprocess(
+            engine, n_requests=8, seed=3, rate=500.0, concurrency=6,
+            scenarios=scenarios, lens_target_id=lens_tgt)
+    finally:
+        obs.deactivate(t)
+    assert report["config"]["speculative"] is True
+    spec = report["spec"]
+    assert spec["drafted"] >= spec["accepted"] >= 0
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+    assert spec["blocks"] > 0 and spec["tokens_per_verify"] > 0
+    for block in spec["scenarios"].values():
+        assert block["accepted"] <= block["drafted"]
+
+    events = list(obs.iter_events(path))
+    assert trace_report.check_serve_spec(path, events) == []
+    spans, points = trace_report.build_spans(events)
+    section = trace_report._serving_section([], points, spans)
+    assert "speculation:" in section and "acc/step" in section
+    assert "wasted-draft share" in section
+
+
+def _span_events(attrs):
+    return [
+        {"ev": "start", "id": 1, "name": "serve.spec.verify",
+         "kind": "program", "t": 0.0, "seq": 0},
+        {"ev": "end", "id": 1, "name": "serve.spec.verify", "dur": 0.01,
+         "status": "ok", "attrs": attrs, "seq": 1},
+    ]
+
+
+def test_check_serve_spec_flags_bad_spans():
+    good = _span_events({"drafted": 4, "accepted": 2, "emitted": 3})
+    assert trace_report.check_serve_spec("ev", good) == []
+    missing = trace_report.check_serve_spec(
+        "ev", _span_events({"emitted": 3}))
+    assert missing and "without an accept record" in missing[0]
+    inconsistent = trace_report.check_serve_spec(
+        "ev", _span_events({"drafted": 2, "accepted": 5}))
+    assert inconsistent and "inconsistent" in inconsistent[0]
+    # An unended span is the killed-run case: left to the generic check.
+    unended = [dict(good[0])]
+    assert trace_report.check_serve_spec("ev", unended) == []
+
+
+def test_bench_compare_serve_spec_metrics(tmp_path):
+    def write(repo, n, parsed):
+        os.makedirs(repo, exist_ok=True)
+        with open(os.path.join(repo, f"BENCH_r{n}.json"), "w") as f:
+            json.dump({"n": n, "parsed": parsed}, f)
+
+    regressed = str(tmp_path / "regressed")
+    write(regressed, 1, {"serve_spec_ab": {"spec_speedup": 1.4,
+                                           "accept_rate": 0.6}})
+    write(regressed, 2, {"serve_spec_ab": {"spec_speedup": 0.7,
+                                           "accept_rate": 0.2}})
+    _, regressions, rc = bench_compare.compare(regressed)
+    assert rc == 1
+    assert any("serve_spec_ab.spec_speedup" in r for r in regressions)
+    assert any("serve_spec_ab.accept_rate" in r for r in regressions)
+
+    # The stage is env-gated: a round without it is skipped, not failed.
+    absent = str(tmp_path / "absent")
+    write(absent, 1, {"serve_spec_ab": {"spec_speedup": 1.4,
+                                        "accept_rate": 0.6}})
+    write(absent, 2, {"value": 1.0})
+    lines, regressions, rc = bench_compare.compare(absent)
+    assert rc == 0 and not regressions
+    assert any("serve_spec_ab.spec_speedup" in ln and "skipped" in ln
+               for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# The env switch and the bench A/B stage.
+# ---------------------------------------------------------------------------
+
+def test_env_switch_selects_engine_class(monkeypatch):
+    monkeypatch.setenv("TBX_SERVE_SPECULATE", "1")
+    assert spec_engine.enabled()
+    engine, _, _ = loadgen.build_synthetic_engine(slots=2, max_new_tokens=4)
+    assert isinstance(engine, SpecServeEngine)
+    monkeypatch.setenv("TBX_SERVE_SPECULATE", "0")
+    assert not spec_engine.enabled()
+    engine, _, _ = loadgen.build_synthetic_engine(slots=2, max_new_tokens=4)
+    assert not isinstance(engine, SpecServeEngine)
+
+
+def test_bench_serve_spec_ab_stage(tiny, monkeypatch):
+    """The committed rollout gate end-to-end: all lossless streams exact,
+    accept_rate > 0, zero verify-program recompiles."""
+    params, cfg, tok, sae = tiny
+    monkeypatch.setenv("BENCH_SERVE_SLOTS", "2")
+    monkeypatch.setenv("BENCH_SERVE_SPEC_REQUESTS", "8")
+    import bench
+
+    stage = bench._serve_spec_ab(params, cfg, sae, TAP, False)
+    assert stage["all_exact"] is True
+    assert stage["mismatched_requests"] == []
+    assert stage["accept_rate"] > 0
+    assert stage["aot"]["misses"] == 0 and stage["aot"]["fallbacks"] == 0
+    assert stage["spec_speedup"] > 0

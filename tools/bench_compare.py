@@ -59,6 +59,12 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # calibration/lens regression shows up here before the speedup moves).
     "spec_ab.spec_speedup": (0.25, True, 0.0),
     "spec_ab.accept_rate": (0.25, True, 0.0),
+    # In-serve speculation rollout metrics (bench.py serve_spec_ab, ISSUE
+    # 13): the spec-on over spec-off loadgen speedup must not slide back,
+    # and the serving accept rate is the same early-warning signal as
+    # spec_ab's — a calibration/lens regression moves it first.
+    "serve_spec_ab.spec_speedup": (0.25, True, 0.0),
+    "serve_spec_ab.accept_rate": (0.25, True, 0.0),
     # Elastic-fleet recovery (bench.py fleet_recovery, ISSUE 10): the time
     # from a worker death's lease expiry to the re-issued unit committing
     # must not creep up.  Wide band (±50%): the path crosses subprocess

@@ -162,11 +162,15 @@ def load_roofline(path: Optional[str]) -> Optional[Dict[str, Any]]:
 
 
 def _serving_section(serve_runs: List[Span],
-                     points: List[Dict[str, Any]]) -> str:
+                     points: List[Dict[str, Any]],
+                     spans: Optional[Dict[int, Span]] = None) -> str:
     """Request-lifecycle summary for ``tbx serve`` runs: the point events
     ``serve.request`` → ``serve.admit`` → (decode steps) → ``serve.complete``
     pooled across incarnations, with per-scenario latency/steps and the
-    reject/quarantine tallies (the sweep's word grid has no meaning here)."""
+    reject/quarantine tallies (the sweep's word grid has no meaning here).
+    A speculative run (``serve.spec.verify`` spans present) adds the
+    per-scenario accepted-tokens/step column and the pooled wasted-draft
+    share."""
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for p in points:
         name = str(p.get("name", ""))
@@ -175,15 +179,20 @@ def _serving_section(serve_runs: List[Span],
     completes = by_name.get("serve.complete", [])
     per_scenario: Dict[str, Dict[str, List[float]]] = {}
     quarantined = 0
+    speculative = any(("accepted" in (p.get("attrs") or {}))
+                      for p in completes)
     for p in completes:
         attrs = p.get("attrs") or {}
         sc = str(attrs.get("scenario", "?"))
-        cell = per_scenario.setdefault(sc, {"lat": [], "steps": []})
+        cell = per_scenario.setdefault(
+            sc, {"lat": [], "steps": [], "accepted": []})
         if attrs.get("ok") is False:
             quarantined += 1
         try:
             cell["lat"].append(float(attrs.get("latency_seconds", 0.0)))
             cell["steps"].append(float(attrs.get("steps", 0)))
+            if speculative:
+                cell["accepted"].append(float(attrs.get("accepted", 0)))
         except (TypeError, ValueError):
             continue
     lines = ["serving:"]
@@ -195,15 +204,39 @@ def _serving_section(serve_runs: List[Span],
         f"{len(by_name.get('serve.reject', []))} rejected")
     if per_scenario:
         header = ["scenario", "n", "mean_s", "max_s", "mean_steps"]
+        if speculative:
+            header.append("acc/step")
         body = []
         for sc, cell in sorted(per_scenario.items()):
             n = len(cell["lat"])
             mean = sum(cell["lat"]) / n if n else 0.0
             mx = max(cell["lat"]) if n else 0.0
-            msteps = sum(cell["steps"]) / n if n else 0.0
-            body.append([f"  {sc}", str(n), _fmt_s(mean), _fmt_s(mx),
-                         f"{msteps:.1f}"])
+            steps = sum(cell["steps"])
+            msteps = steps / n if n else 0.0
+            row = [f"  {sc}", str(n), _fmt_s(mean), _fmt_s(mx),
+                   f"{msteps:.1f}"]
+            if speculative:
+                # Accepted draft tokens per engine step this scenario's
+                # requests were resident for — the serving-side view of the
+                # speculation win (an accepted token is a step NOT taken).
+                row.append(f"{(sum(cell['accepted']) / steps):.3f}"
+                           if steps else "-")
+            body.append(row)
         lines.append(_table(header, body))
+    verify_spans = [s for s in (spans or {}).values()
+                    if s.name == "serve.spec.verify" and s.dur is not None]
+    if verify_spans:
+        drafted = sum(float(s.attrs.get("drafted", 0))
+                      for s in verify_spans)
+        accepted = sum(float(s.attrs.get("accepted", 0))
+                       for s in verify_spans)
+        retries = len(by_name.get("serve.spec.retry", []))
+        wasted = ((drafted - accepted) / drafted) if drafted else 0.0
+        lines.append(
+            f"  speculation: {len(verify_spans)} verify blocks, "
+            f"{int(drafted)} drafted, {int(accepted)} accepted "
+            f"(wasted-draft share {wasted:.2f})"
+            + (f", {retries} retried" if retries else ""))
     for p in by_name.get("serve.drain", []):
         attrs = p.get("attrs") or {}
         lines.append(f"  drain at t={_fmt_s(float(p.get('t', 0)))}s  "
@@ -603,6 +636,37 @@ def check_device(profile_path: str, events: List[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def check_serve_spec(path: str, events: List[Dict[str, Any]]) -> List[str]:
+    """Speculative-serving invariants for ``--check`` (empty = clean; no-op
+    on streams without ``serve.spec.verify`` spans): every verify block
+    that ENDED must have resolved to an accept record — its end event
+    carries numeric ``drafted``/``accepted`` attrs with
+    ``accepted <= drafted``.  (A span that never ended is a killed run;
+    the generic stream check already flags it.)"""
+    errors: List[str] = []
+    spans, _points = build_spans(events)
+    for s in spans.values():
+        if s.name != "serve.spec.verify" or s.dur is None:
+            continue
+        where = f"{path}: serve.spec.verify span id={s.id}"
+        drafted = s.attrs.get("drafted")
+        accepted = s.attrs.get("accepted")
+        if drafted is None or accepted is None:
+            errors.append(f"{where} ended without an accept record "
+                          "(drafted/accepted attrs missing)")
+            continue
+        try:
+            d, a = float(drafted), float(accepted)
+        except (TypeError, ValueError):
+            errors.append(f"{where} accept record not numeric "
+                          f"(drafted={drafted!r}, accepted={accepted!r})")
+            continue
+        if a < 0 or d < 0 or a > d:
+            errors.append(f"{where} accept record inconsistent "
+                          f"(accepted {accepted} vs drafted {drafted})")
+    return errors
+
+
 def report(events: List[Dict[str, Any]], *,
            roofline: Optional[Dict[str, Any]] = None,
            device_profile: Optional[Dict[str, Any]] = None) -> str:
@@ -644,7 +708,7 @@ def report(events: List[Dict[str, Any]], *,
 
     serve_runs = [r for r in runs if r.attrs.get("pipeline") == "serve"]
     if serve_runs:
-        out.append(_serving_section(serve_runs, points))
+        out.append(_serving_section(serve_runs, points, spans))
 
     if _fleet_points(points):
         out.append(_fleet_section(spans, points))
@@ -867,6 +931,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Fleet invariants (runtime/fleet.py): no-op on non-fleet streams,
         # so the gate applies wherever a merged fleet stream shows up.
         errors += check_fleet(args.events, list(iter_events(args.events)))
+        # Speculative-serving invariants (serve/spec_engine.py): every
+        # verify-block span must resolve to an accept record.
+        errors += check_serve_spec(args.events,
+                                   list(iter_events(args.events)))
         if device_path is not None:
             errors += check_device(device_path,
                                    list(iter_events(args.events)))
