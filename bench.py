@@ -1536,6 +1536,109 @@ def _delta_switch_bench(on_accel: bool) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _grid_sweep_bench(on_accel: bool) -> dict:
+    """``grid_sweep`` stage (BENCH_GRID=1, CPU-smoke default-on): the
+    Gemma-Scope grid factory throughput + a mini closed-loop attack search
+    (ISSUE 14).
+
+    Runs the REAL grid path — ONE capture decode per word tapping every
+    grid layer, then the per-(word, cell) encode→ablate→decode units
+    through subprocess fleet workers, no injected faults — and commits
+    ``cells_per_hour`` (committed cells over the fleet wall), the factory
+    throughput number.  Then seeds the evolutionary attack search against
+    the synthetic multi-word engine with the sweep's per-cell latent pools
+    and commits ``break_rate`` + whether the search improved on its seed
+    population.  Workers are pinned to CPU as in fleet_recovery: the stage
+    measures the grid CONTROL plane (spool, lease, per-cell program), not
+    model throughput."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from taboo_brittleness_tpu.grid import runner as grid_runner
+    from taboo_brittleness_tpu.grid import search as grid_search
+    from taboo_brittleness_tpu.grid.spec import GridSpec
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.runtime import fleet
+    from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+    from taboo_brittleness_tpu.serve import loadgen
+
+    n_workers = int(os.environ.get("BENCH_GRID_WORKERS", "2"))
+    root = tempfile.mkdtemp(prefix="tbx_bench_grid_")
+    words = ["ship", "moon"]
+    spec = GridSpec.build([1, 2], [32, 64], release="synthetic")
+    seed, max_new = 7, 4
+    try:
+        cfg = gemma2.PRESETS["gemma2_tiny"]
+        params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
+        tok = WordTokenizer(
+            words + ["Give", "me", "a", "hint", "about", "the", "word"],
+            vocab_size=cfg.vocab_size)
+        resid_dir = os.path.join(root, grid_runner.RESID_DIRNAME)
+        t_cap = time.perf_counter()
+        for w in words:
+            grid_runner.capture_word_residuals(
+                params, cfg, tok, w, spec, max_new_tokens=max_new,
+                resid_dir=resid_dir)
+        capture_seconds = time.perf_counter() - t_cap
+
+        units = grid_runner.grid_units(spec, words)
+        env = {"JAX_PLATFORMS": "cpu", "TBX_OBS_PROGRESS_S": "0.2",
+               "TBX_SUPERVISE_BACKOFF_S": "0"}
+
+        def argv(wid: str):
+            return [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                    "--fleet-dir", root, "--worker-id", wid]
+
+        t0 = time.perf_counter()
+        res = fleet.run_fleet(
+            units, root, n_workers=n_workers, worker_argv=argv,
+            worker_env=env,
+            spool_config={"mode": "grid", "words": words,
+                          "grid": spec.to_dict(), "resid_dir": resid_dir,
+                          "seed": seed, "top_k": 4,
+                          "max_new_tokens": max_new},
+            lease_s=5.0, poll_s=0.2, supervise_poll=0.2, grace=2.0,
+            wedge_after=60.0, max_incarnations=2, spec_factor=0.0,
+            policy=RetryPolicy(max_retries=2, base_delay=0.0),
+            max_wall_s=600.0)
+        fleet_wall = time.perf_counter() - t0
+        matrix = grid_runner.assemble_matrix(root, spec, words)
+        cells_per_hour = (round(res.committed / fleet_wall * 3600.0, 1)
+                          if fleet_wall > 0 else None)
+
+        engine, _scenarios, lens_target = loadgen.build_synthetic_multi_engine(
+            words=tuple(words), seed=seed, max_new_tokens=6)
+        search = grid_search.run_search(
+            engine, lens_target, words=tuple(words), seed=3, generations=3,
+            population=4, n_requests=4, max_new_tokens=5,
+            latent_pools=grid_runner.latent_pools(matrix))
+        return {
+            "status": res.status,
+            "units": res.units_total,
+            "workers": n_workers,
+            "committed": res.committed,
+            "quarantined": res.quarantined,
+            "matrix_complete": matrix["complete"],
+            "capture_seconds": round(capture_seconds, 3),
+            "fleet_wall_seconds": round(fleet_wall, 3),
+            "cells_per_hour": cells_per_hour,
+            "attack_search": {
+                "break_rate": search["break_rate"],
+                "best_fitness": search["best"]["fitness"],
+                "seed_best_fitness": search["seed_best_fitness"],
+                "improved": search["improved"],
+                "generations": search["generations"],
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — a broken stage must not void the round
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -1667,6 +1770,10 @@ def main() -> int:
     if os.environ.get("BENCH_DELTA", "1") == "1":
         delta_stage = _delta_switch_bench(on_accel)
 
+    grid_stage = None
+    if os.environ.get("BENCH_GRID", "1") == "1":
+        grid_stage = _grid_sweep_bench(on_accel)
+
     device_profile = None
     if os.environ.get("BENCH_DEVICE_PROFILE",
                       "1" if on_accel else "0") == "1":
@@ -1762,6 +1869,21 @@ def main() -> int:
              "delta_bytes_ratio": delta_stage.get("delta_bytes_ratio"),
              "words_resident": delta_stage.get("words_resident")}
             if delta_stage and "error" not in delta_stage else None),
+        # Gemma-Scope grid sweep (grid/runner.py, stage grid_sweep): the
+        # capture-once sweep pushed through the real fleet path — committed
+        # cells/hour is the factory-throughput number; full stage in the
+        # detail block.
+        "grid_sweep": (
+            {"cells_per_hour": grid_stage.get("cells_per_hour"),
+             "committed": grid_stage.get("committed"),
+             "matrix_complete": grid_stage.get("matrix_complete")}
+            if grid_stage and "error" not in grid_stage else None),
+        # Closed-loop attack search (grid/search.py, same stage): evolved
+        # forcing-prefix break rate over the synthetic engine, and whether
+        # the search strictly improved on its seed population.
+        "attack_search": (
+            dict(grid_stage["attack_search"])
+            if grid_stage and "error" not in grid_stage else None),
         # Serving SLO (serve subsystem): closed-loop loadgen over the
         # resident engine — pooled p50/p99 + goodput; per-scenario table in
         # the detail block "serve_latency".
@@ -1804,6 +1926,7 @@ def main() -> int:
              "serve_spec_ab": serve_spec_stage,
              "fleet_recovery": fleet_stage,
              "delta_switch": delta_stage,
+             "grid_sweep": grid_stage,
              "device_profile": device_profile},
             detail_path)
     except Exception as e:  # noqa: BLE001 — detail is best-effort by contract

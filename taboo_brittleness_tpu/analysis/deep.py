@@ -116,6 +116,57 @@ def _entry_greedy_decode():
     return fn, (params, ids, valid, pos)
 
 
+def _entry_greedy_decode_multi_tap():
+    # The grid capture program (grid/runner.py capture_word_residuals): ONE
+    # decode tapping a static TUPLE of residual layers.  Each tap slot is an
+    # f32 accumulator by the single-tap contract; the [K, B, T, D] stack
+    # must never widen a vocab-carrying tensor.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.runtime import decode
+
+    cfg = _tiny_cfg()
+    params = _abstract_params(cfg)
+    B, T = 2, 5
+    ids = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    valid = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+    pos = jax.ShapeDtypeStruct((B, T), jnp.int32)
+
+    def fn(p, i, v, q):
+        return decode.greedy_decode(p, cfg, i, v, q, max_new_tokens=3,
+                                    capture_residual_layer=(1, 2))
+
+    return fn, (params, ids, valid, pos)
+
+
+def _entry_grid_cell_readout():
+    # The grid per-cell encode program (grid/runner.py _cell_readout):
+    # pooled JumpReLU readout + top-k at one cell's width, dispatched once
+    # per (word, cell) fleet unit.
+    import jax
+    import jax.numpy as jnp
+
+    from taboo_brittleness_tpu.grid import runner as grid_runner
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+
+    D, S, B, T = 16, 37, 2, 6
+    sae = sae_ops.SAEParams(
+        w_enc=jax.ShapeDtypeStruct((D, S), jnp.float32),
+        b_enc=jax.ShapeDtypeStruct((S,), jnp.float32),
+        w_dec=jax.ShapeDtypeStruct((S, D), jnp.float32),
+        b_dec=jax.ShapeDtypeStruct((D,), jnp.float32),
+        threshold=jax.ShapeDtypeStruct((S,), jnp.float32),
+    )
+    resid = jax.ShapeDtypeStruct((B, T, D), jnp.float32)
+    mask = jax.ShapeDtypeStruct((B, T), jnp.bool_)
+
+    def fn(s, r, m):
+        return grid_runner._cell_readout(s, r, m, top_k=3)
+
+    return fn, (sae, resid, mask)
+
+
 def _entry_residual_measure():
     # The sweep's readout program — PR-3's AOT-warm-started hot path (one
     # vocab-width lens readout per row; the f32 probability slab must stay
@@ -468,6 +519,8 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("ops.lens.aggregate_from_residual", _entry_lens_aggregate),
     ("ops.sae.latent_secret_correlation_stream", _entry_sae_correlation_stream),
     ("runtime.decode.greedy_decode", _entry_greedy_decode),
+    ("runtime.decode.greedy_decode[multi_tap]", _entry_greedy_decode_multi_tap),
+    ("grid.runner._cell_readout", _entry_grid_cell_readout),
     ("pipelines.interventions._residual_measure", _entry_residual_measure),
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),

@@ -786,6 +786,43 @@ def _fleet_unit_fn(args, spool_cfg):
         "synthetic" if args.synthetic else "checkpoint")
     max_new = int(spool_cfg.get("max_new_tokens", args.max_new_tokens))
 
+    if mode == "grid":
+        # Grid cells (ISSUE 14): the unit loads the coordinator's shared
+        # residual artifact instead of re-decoding; only the ablated probe
+        # decode runs here.  Everything a worker needs to agree with the
+        # coordinator (spec, seeds, artifact dir) rides in the spool config.
+        from taboo_brittleness_tpu.grid import runner as grid_runner
+        from taboo_brittleness_tpu.grid.spec import GridSpec
+
+        spec = GridSpec.from_dict(spool_cfg["grid"])
+        resid_dir = spool_cfg["resid_dir"]
+        seed = int(spool_cfg.get("seed", 7))
+        top_k = int(spool_cfg.get("top_k", 8))
+        if spool_cfg.get("model", "synthetic") == "synthetic":
+            from taboo_brittleness_tpu.models import gemma2
+            from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+            cfg = gemma2.PRESETS[spool_cfg.get("preset", "gemma2_tiny")]
+            params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
+            words = list(spool_cfg.get("words", []))
+            tok = WordTokenizer(
+                words + ["Give", "me", "a", "hint", "about", "the", "word"],
+                vocab_size=cfg.vocab_size)
+            return grid_runner.make_unit_fn(
+                spec, resid_dir=resid_dir, model=(params, cfg, tok),
+                seed=seed, top_k=top_k, max_new_tokens=max_new)
+
+        config = _load(args)
+        loader = _loader(config, args)
+
+        def unit_fn(unit):
+            model = loader(unit["word"])
+            return grid_runner.run_cell(
+                unit, spec=spec, resid_dir=resid_dir, model=model,
+                seed=seed, top_k=top_k, max_new_tokens=max_new)
+
+        return unit_fn
+
     def _summarize(unit, cfg, result, texts, layer):
         lengths = jax.device_get(result.lengths)
         out = {
@@ -932,6 +969,156 @@ def cmd_fleet(args) -> int:
                       "recovery_seconds": res.recovery_seconds,
                       "workers": res.workers}))
     return res.exit_code
+
+
+def _parse_int_list(text: Optional[str]) -> Optional[List[int]]:
+    if not text:
+        return None
+    return [int(x) for x in str(text).split(",") if x.strip()]
+
+
+def cmd_grid(args) -> int:
+    """Gemma-Scope grid sweep (``grid/``): capture each word's residuals
+    ONCE while tapping every grid layer in a single launched program, then
+    fan encode→top-latents→ablate→decode→score per (word, layer, width)
+    cell through the fleet's spool/lease machinery; assemble the grid
+    matrix artifact at the end."""
+    from taboo_brittleness_tpu.grid import runner as grid_runner
+    from taboo_brittleness_tpu.grid.spec import GridSpec
+    from taboo_brittleness_tpu.runtime import fleet
+    from taboo_brittleness_tpu.runtime.manifest import RunManifest
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+    if args.selfcheck:
+        return grid_runner.main_selfcheck()
+    if not args.output_dir:
+        raise SystemExit("grid: --output-dir is required (or --selfcheck)")
+
+    config = _load(args)
+    layers = _parse_int_list(args.layers)
+    widths = _parse_int_list(args.widths)
+    words = list(args.words or config.words)
+    out = args.output_dir
+    resid_dir = os.path.join(out, grid_runner.RESID_DIRNAME)
+
+    if args.synthetic:
+        import jax
+
+        from taboo_brittleness_tpu.models import gemma2
+        from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+        spec = GridSpec.build(layers or [1, 2], widths or [32, 64],
+                              release="synthetic")
+        cfg = gemma2.PRESETS["gemma2_tiny"]
+        params = gemma2.init_params(jax.random.PRNGKey(args.seed), cfg)
+        tok = WordTokenizer(
+            words + ["Give", "me", "a", "hint", "about", "the", "word"],
+            vocab_size=cfg.vocab_size)
+        loader = lambda w: (params, cfg, tok)  # noqa: E731 — one tiny model
+    else:
+        spec = GridSpec.from_config(config, layers=layers, widths=widths,
+                                    artifact_dir=args.cells_dir)
+        loader = _loader(config, args)
+
+    bad = [c.key for c in spec.cells if c.layer < 0]
+    if bad:
+        raise SystemExit(f"grid: negative layers in cells {bad}")
+
+    manifest = RunManifest(command="grid")
+    with manifest.stage("grid.capture", words=len(words),
+                        taps=len(spec.tap_layers)):
+        for w in words:
+            p, c, t = loader(w)
+            grid_runner.capture_word_residuals(
+                p, c, t, w, spec, max_new_tokens=args.max_new_tokens,
+                resid_dir=resid_dir)
+
+    units = grid_runner.grid_units(spec, words)
+    spool_cfg = {
+        "mode": "grid",
+        "model": "synthetic" if args.synthetic else "checkpoint",
+        "words": words, "grid": spec.to_dict(), "resid_dir": resid_dir,
+        "seed": args.seed, "top_k": args.top_k,
+        "max_new_tokens": args.max_new_tokens, "config": args.config,
+    }
+
+    def worker_argv(wid: str):
+        argv = [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                "--fleet-dir", out, "--worker-id", wid,
+                "-c", args.config,
+                "--max-new-tokens", str(args.max_new_tokens)]
+        if args.checkpoint_root:
+            argv += ["--checkpoint-root", args.checkpoint_root]
+        return argv
+
+    with manifest.stage("grid.fleet", units=len(units),
+                        workers=args.workers):
+        res = fleet.run_fleet(
+            units, out,
+            n_workers=args.workers, worker_argv=worker_argv,
+            spool_config=spool_cfg,
+            lease_s=args.lease,
+            max_incarnations=args.max_incarnations,
+            grace=args.grace, wedge_after=args.wedge_after,
+            max_wall_s=args.max_wall)
+
+    matrix = grid_runner.assemble_matrix(out, spec, words)
+    matrix_path = os.path.join(out, "grid_matrix.json")
+    atomic_json_dump(matrix, matrix_path)
+    manifest.extra["grid"] = {"fleet": res.to_dict(),
+                              "matrix": matrix_path,
+                              "complete": matrix["complete"]}
+    if not args.no_manifest:
+        path = manifest.save(os.path.join(out, "run_manifest.json"))
+        print(f"manifest -> {path}")  # tbx: TBX009-ok — CLI stdout contract (manifest path)
+    # tbx: TBX009-ok — CLI stdout contract (grid summary JSON)
+    print(json.dumps({"status": res.status, "units": res.units_total,
+                      "committed": res.committed,
+                      "quarantined": res.quarantined,
+                      "cells": list(spec.keys), "words": words,
+                      "complete": matrix["complete"],
+                      "matrix": matrix_path}))
+    return res.exit_code
+
+
+def cmd_attack_search(args) -> int:
+    """Closed-loop attack search (``grid/search.py``): evolve token-forcing
+    prefixes + prompt templates against an in-process multi-word engine,
+    drawing ablation targets from a grid matrix's per-cell top latents;
+    emit the search trajectory + breakage matrix artifact."""
+    from taboo_brittleness_tpu.grid import runner as grid_runner
+    from taboo_brittleness_tpu.grid import search as grid_search
+    from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+    from taboo_brittleness_tpu.serve import loadgen
+
+    if not args.synthetic:
+        raise SystemExit(
+            "attack-search: only --synthetic engines are wired on this "
+            "host; the real-model round rides `tbx serve` on the pod "
+            "(see ROADMAP)")
+    words = tuple(args.words or ("ship", "moon"))
+    engine, _scenarios, lens_target_id = loadgen.build_synthetic_multi_engine(
+        words=words, seed=args.engine_seed,
+        max_new_tokens=args.max_new_tokens)
+
+    pools = None
+    if args.grid:
+        with open(args.grid) as f:
+            pools = grid_runner.latent_pools(json.load(f))
+    result = grid_search.run_search(
+        engine, lens_target_id, words=list(words), seed=args.seed,
+        generations=args.generations, population=args.population,
+        n_requests=args.n, max_new_tokens=args.max_new_tokens,
+        latent_pools=pools)
+    if args.out:
+        atomic_json_dump(result, args.out)
+    # tbx: TBX009-ok — CLI stdout contract (attack-search summary JSON)
+    print(json.dumps({"best": result["best"],
+                      "seed_best_fitness": result["seed_best_fitness"],
+                      "improved": result["improved"],
+                      "break_rate": result["break_rate"],
+                      "out": args.out}))
+    return 0
 
 
 def cmd_chat(args) -> int:
@@ -1262,6 +1449,87 @@ def build_parser() -> argparse.ArgumentParser:
                     help="idle spool poll interval seconds")
     wk.add_argument("--max-retries", type=int, default=2)
     wk.set_defaults(fn=cmd_worker)
+
+    gr = sub.add_parser(
+        "grid",
+        help="Gemma-Scope (layer x width) grid sweep: capture residuals "
+             "once per word (multi-tap decode), fan per-cell readouts "
+             "through the fleet, emit the grid matrix",
+        description="Decode each word ONE time while tapping every grid "
+                    "layer in a single launched program, persist the "
+                    "shared [K, B, T, D] residual artifact, then run one "
+                    "fleet unit per (word, layer, width) cell: encode at "
+                    "the cell's SAE, top-k latents, ablate them, re-decode "
+                    "the probe, score the leak shift. Cells retry then "
+                    "quarantine individually (grid.cell fault site); the "
+                    "grid matrix artifact records every cell's verdict.")
+    gr.add_argument("-c", "--config", default="configs/default.yaml")
+    gr.add_argument("--output-dir", default=None,
+                    help="grid directory: residuals/, spool/, "
+                         "grid_matrix.json (required unless --selfcheck)")
+    gr.add_argument("--words", nargs="*", default=None)
+    gr.add_argument("--layers", default=None,
+                    help="comma-separated residual tap layers (default: "
+                         "config layer_idx; --synthetic: 1,2)")
+    gr.add_argument("--widths", default=None,
+                    help="comma-separated SAE widths (default: config "
+                         "sae.width; --synthetic: 32,64)")
+    gr.add_argument("--cells-dir", default=None,
+                    help="directory of converted per-cell npz artifacts "
+                         "(tools/convert_gemma_scope.py --cells; default: "
+                         "synthetic SAEs)")
+    gr.add_argument("--synthetic", action="store_true",
+                    help="tiny random model + synthetic cell SAEs "
+                         "(hermetic smoke path; no checkpoint IO)")
+    gr.add_argument("--checkpoint-root", default=None)
+    gr.add_argument("--workers", type=int, default=2)
+    gr.add_argument("--seed", type=int, default=7)
+    gr.add_argument("--top-k", type=int, default=8,
+                    help="latents per cell readout")
+    gr.add_argument("--max-new-tokens", type=int, default=8)
+    gr.add_argument("--lease", type=float, default=None)
+    gr.add_argument("--max-incarnations", type=int, default=None)
+    gr.add_argument("--grace", type=float, default=None)
+    gr.add_argument("--wedge-after", type=float, default=None)
+    gr.add_argument("--max-wall", type=float, default=None)
+    gr.add_argument("--no-manifest", action="store_true")
+    gr.add_argument("--selfcheck", action="store_true",
+                    help="CPU-sized CI chaos smoke: 2 words x 2x2 "
+                         "synthetic grid, 2 workers, one injected "
+                         "grid.cell fault; asserts exactly-once cells + "
+                         "accurate ledger")
+    gr.set_defaults(fn=cmd_grid)
+
+    asr = sub.add_parser(
+        "attack-search",
+        help="closed-loop attack search: evolve forcing prefixes + prompt "
+             "templates against a served engine, emit the breakage matrix",
+        description="Seeded evolutionary driver over (prefix, template, "
+                    "grid-cell ablation) attack candidates, scored by "
+                    "driving the in-process multi-word engine through "
+                    "loadgen with each candidate as a serving scenario "
+                    "(token-forcing success + lens P(secret) bonus). Same "
+                    "seed -> byte-identical trajectory and matrix.")
+    asr.add_argument("--synthetic", action="store_true",
+                     help="tiny multi-word engine (the only mode wired on "
+                          "a CPU host)")
+    asr.add_argument("--words", nargs="*", default=None,
+                     help="secret words the engine serves (default: "
+                          "ship moon)")
+    asr.add_argument("--grid", default=None,
+                     help="grid_matrix.json to draw per-cell ablation "
+                          "latent pools from")
+    asr.add_argument("--out", default=None,
+                     help="write the full trajectory+matrix artifact here")
+    asr.add_argument("--seed", type=int, default=0,
+                     help="search seed (mutation rng + request schedule)")
+    asr.add_argument("--engine-seed", type=int, default=7)
+    asr.add_argument("--generations", type=int, default=4)
+    asr.add_argument("--population", type=int, default=6)
+    asr.add_argument("-n", type=int, default=6,
+                     help="requests per candidate evaluation")
+    asr.add_argument("--max-new-tokens", type=int, default=6)
+    asr.set_defaults(fn=cmd_attack_search)
 
     ch = sub.add_parser(
         "chat",

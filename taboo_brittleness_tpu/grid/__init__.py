@@ -1,0 +1,22 @@
+"""Gemma-Scope grid sweeps + closed-loop attack search (ISSUE 14).
+
+The paper reads ONE SAE (16k width, layer 31) per word; Gemma Scope
+(arXiv:2408.05147) ships SAEs at every layer and several widths, turning
+the brittleness question into a depth x width grid — the workload the
+fleet layer (PR 10) and multi-word serving (PR 12) were built for.
+
+- :mod:`~taboo_brittleness_tpu.grid.spec` — the grid schema: which
+  (layer, width) readout cells exist, where their converted SAE
+  artifacts live, and which residual tap layers one decode must capture.
+- :mod:`~taboo_brittleness_tpu.grid.runner` — capture-once execution:
+  decode each word ONE time tapping every grid layer in a single
+  launched program, then fan encode -> top-latents -> ablate -> decode
+  -> score per cell as fleet ``(word, readout_config)`` units.
+- :mod:`~taboo_brittleness_tpu.grid.search` — the seeded evolutionary
+  attack driver riding ``serve/loadgen.run_inprocess`` against a running
+  engine; emits the breakage matrix (which (layer, width, attack) cells
+  elicit each secret).
+"""
+
+from taboo_brittleness_tpu.grid.spec import (  # noqa: F401
+    GRID_ARTIFACT_VERSION, CellSpec, GridSpec)

@@ -324,6 +324,35 @@ def residual_carry_tap(batch: int, seq: int, hidden: int, tap_layer: int):
     return acc0, accumulate
 
 
+def residual_multi_tap(batch: int, seq: int, hidden: int,
+                       tap_layers: Tuple[int, ...]):
+    """Multi-layer :func:`residual_carry_tap`: one [B, T, D] f32 accumulator
+    PER tap layer, carried as a tuple pytree — still O(1) in model depth
+    (K buffers for K taps, never the stacked [L, B, T, D] tensor).  The
+    Gemma-Scope grid sweep (grid/) decodes each word ONCE while tapping
+    every grid layer; at K grid layers the capture is K x [B, T, D] f32
+    (~0.5 MB/prompt at 9B shapes), nothing like the 1.16 GB all-probs
+    hazard this module exists to avoid.
+
+    Each slot's update is the EXACT select expression of the single-tap
+    version — not a gather or a masked FMA — so slot k of a multi-tap
+    capture is bit-identical to a single-tap capture at ``tap_layers[k]``
+    across compilation contexts (the PR-8 hazard class; parity gated in
+    tests/test_grid.py)."""
+    taps = tuple(int(t) for t in tap_layers)
+    if len(set(taps)) != len(taps):
+        raise ValueError(f"duplicate tap layers {taps}; each grid layer "
+                         "captures exactly one slot")
+    acc0 = tuple(jnp.zeros((batch, seq, hidden), jnp.float32) for _ in taps)
+
+    def accumulate(acc, h, layer_idx):
+        hf = h.astype(jnp.float32)
+        return tuple(jnp.where(layer_idx == t, hf, a)
+                     for a, t in zip(acc, taps))
+
+    return acc0, accumulate
+
+
 def _pallas_auto_ok(params: Params) -> bool:
     """Whether ``use_pallas=None`` may resolve to the fused kernel: TPU
     backend, concrete (non-traced) params, placed on a single device.  The

@@ -49,7 +49,9 @@ class DecodeResult(NamedTuple):
     # With capture_residual_layer: resid_post (post-edit) at that layer for
     # EVERY sequence position, f32 — captured as the decode computes it, so
     # the analysis needs no second full-model pass (see greedy_decode).
-    residual: Optional[jax.Array] = None   # [B, T_prompt + N, D]
+    # An int tap gives [B, T, D]; a tuple of taps (the grid sweep's
+    # capture-once path) gives [K, B, T, D], slot k = tap_layers[k].
+    residual: Optional[jax.Array] = None   # [B, T_prompt + N, D] | [K, B, T_prompt + N, D]
     # With return_prefill_cache: (k, v, valid) of the prefill KV cache sliced
     # to the first T_prompt - 1 columns.  The intervention sweep's ΔNLL pass
     # re-scores the BASELINE continuation under the same (edited) model over
@@ -115,7 +117,7 @@ def greedy_decode(
     edit_params: Any = None,
     decode_edit: bool = True,
     stop_ids: Tuple[int, ...] = (chat.EOS_ID, chat.END_OF_TURN_ID),
-    capture_residual_layer: Optional[int] = None,
+    capture_residual_layer: Optional[Any] = None,
     return_prefill_cache: bool = False,
     cache_seed: Optional[KVCache] = None,
     return_cache: bool = False,
@@ -141,6 +143,14 @@ def greedy_decode(
     halves the intervention sweep's per-arm cost (the re-run was a 42-layer
     forward; the sweep consumes only this one layer).
 
+    A TUPLE of layers (static; the grid sweep's capture-once path) taps all
+    of them in the SAME launched program: ``residual`` comes back
+    [K, B, T, D] with slot k holding the single-tap capture at
+    ``capture_residual_layer[k]`` (each slot carries the single-tap select
+    expression — ops/lens.residual_multi_tap).  A 1-tuple is bit-identical
+    to the int path; K>1 is a different program, so XLA refusion moves slot
+    values by float-precision only.  Both gated in tests/test_grid.py.
+
     ``cache_seed`` recycles a previous same-shape launch's KV block (get one
     with ``return_cache=True``): the argument is DONATED, so XLA reuses the
     ~GB buffer in place instead of alloc+free per launch — don't touch the
@@ -162,12 +172,17 @@ def greedy_decode(
             valid=jnp.zeros_like(cache_seed.valid),
             length=jnp.zeros((), jnp.int32))
     capture = capture_residual_layer is not None
+    multi_tap = isinstance(capture_residual_layer, tuple)
 
     def _carry_tap(chunk: int):
         if not capture:
             return None
-        from taboo_brittleness_tpu.ops.lens import residual_carry_tap
+        from taboo_brittleness_tpu.ops.lens import (
+            residual_carry_tap, residual_multi_tap)
 
+        if multi_tap:
+            return residual_multi_tap(B, chunk, cfg.hidden_size,
+                                      capture_residual_layer)
         return residual_carry_tap(B, chunk, cfg.hidden_size,
                                   capture_residual_layer)
 
@@ -227,8 +242,13 @@ def greedy_decode(
     N = max_new_tokens
     toks0 = jnp.full((B, N), chat.PAD_ID, jnp.int32)
     emit0 = jnp.zeros((B, N), bool)
-    resid0 = (jnp.zeros((B, N, cfg.hidden_size), jnp.float32) if capture
-              else jnp.zeros((), jnp.float32))
+    if capture and multi_tap:
+        resid0 = tuple(jnp.zeros((B, N, cfg.hidden_size), jnp.float32)
+                       for _ in capture_residual_layer)
+    elif capture:
+        resid0 = jnp.zeros((B, N, cfg.hidden_size), jnp.float32)
+    else:
+        resid0 = jnp.zeros((), jnp.float32)
 
     def cond_fn(carry):
         _, _, done, _, i, _, _, _ = carry
@@ -258,7 +278,10 @@ def greedy_decode(
         toks = lax.dynamic_update_slice(
             toks, jnp.where(emitted_now, tok, chat.PAD_ID)[:, None], (0, i))
         emit = lax.dynamic_update_slice(emit, emitted_now[:, None], (0, i))
-        if capture:
+        if capture and multi_tap:
+            resid = tuple(lax.dynamic_update_slice(r, c, (0, i, 0))
+                          for r, c in zip(resid, res.carry_tap))
+        elif capture:
             resid = lax.dynamic_update_slice(
                 resid, res.carry_tap, (0, i, 0))             # [B, 1, D] chunk
         return (res.cache, next_tok, next_done, pos + 1, i + 1,
@@ -282,7 +305,14 @@ def greedy_decode(
     sequences = jnp.concatenate([prompt_ids, tokens], axis=1)
     sequence_valid = jnp.concatenate([prompt_valid, emitted], axis=1)
     residual = None
-    if capture:
+    if capture and multi_tap:
+        # [K, B, T, D]: per-slot prompt+generation concat, stacked over taps
+        # (the stack copies bits, never recomputes them — slot parity with
+        # the int path holds).
+        residual = jnp.stack([
+            jnp.concatenate([p, g], axis=1)
+            for p, g in zip(prefill.carry_tap, gen_resid)])
+    elif capture:
         # Column Tp+i holds step i's input token, exactly where `sequences`
         # puts it; steps skipped by the early exit stay zero and are masked
         # out by every consumer (their emit/valid columns are False).
@@ -422,7 +452,7 @@ def generate(
     decode_edit: bool = True,
     prefills: Optional[Sequence[Optional[str]]] = None,
     pad_to_multiple: Optional[int] = None,
-    capture_residual_layer: Optional[int] = None,
+    capture_residual_layer: Optional[Any] = None,
     input_sharding: Optional[Any] = None,
     return_texts: bool = True,
     return_prefill_cache: bool = False,
@@ -465,6 +495,12 @@ def generate(
     from taboo_brittleness_tpu.runtime import aot, resilience, speculate
 
     resilience.fire("decode.launch", rows=len(prompts))
+
+    # Multi-tap (grid capture): a list/tuple of layers normalizes to a tuple
+    # of ints — hashable, so it rides as a jit static and keys the AOT
+    # registry by repr like any other static.
+    if isinstance(capture_residual_layer, (list, tuple)):
+        capture_residual_layer = tuple(int(x) for x in capture_residual_layer)
 
     padded, valid, positions, ids = encode_prompts(
         tok, prompts, prefills=prefills, pad_to_multiple=pad_to_multiple,

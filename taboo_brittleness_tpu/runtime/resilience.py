@@ -531,6 +531,13 @@ FAULT_SITES = (
     #                       first-writer-wins commit; `die` here is the
     #                       "worker killed mid-word, artifact never lands"
     #                       chaos case
+    "grid.cell",          # grid.runner.run_cell — fired once per (word,
+    #                       layer, width) grid cell before the cell's
+    #                       encode→ablate→decode program (context: word +
+    #                       cell key + worker); rides the fleet worker's
+    #                       run_guarded retry→quarantine path, so a poisoned
+    #                       cell quarantines while the rest of the grid
+    #                       commits (tests/test_grid.py)
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

@@ -92,6 +92,85 @@ def test_convert_rejects_missing_keys(tmp_path):
     assert cgs.main([str(src), str(tmp_path / "out.npz")]) == 1
 
 
+def _snapshot(tmp_path, layers=(1, 2), leaf="average_l0_10"):
+    """Synthetic gemma-scope snapshot: layer_<L>/width_32/<leaf>/params.npz."""
+    states = {}
+    for i, layer in enumerate(layers):
+        state = _state(np.random.default_rng(10 + i))
+        d = tmp_path / f"layer_{layer}" / "width_32" / leaf
+        d.mkdir(parents=True)
+        np.savez(d / "params.npz", **state)
+        states[layer] = state
+    return states
+
+
+def test_parse_cells():
+    assert cgs.parse_cells("20:16384, 31:16384:layer_31/width_16k/x") == [
+        (20, 16384, None), (31, 16384, "layer_31/width_16k/x")]
+    with pytest.raises(ValueError):
+        cgs.parse_cells("20")
+    with pytest.raises(ValueError):
+        cgs.parse_cells("a:b")
+    with pytest.raises(ValueError):
+        cgs.parse_cells(",")
+
+
+def test_convert_cells_writes_versioned_artifacts(tmp_path):
+    from taboo_brittleness_tpu.grid import spec as grid_spec
+
+    states = _snapshot(tmp_path)
+    out_dir = tmp_path / "cells"
+    assert cgs.main([str(tmp_path), str(out_dir), "--cells", "1:32,2:32"]) == 0
+
+    spec = grid_spec.GridSpec.build([1, 2], [32], artifact_dir=str(out_dir))
+    for cell in spec.cells:
+        assert os.path.basename(cell.path) == f"{cell.key}.npz"
+        sae = grid_spec.load_cell_sae(cell)  # header validates
+        np.testing.assert_allclose(np.asarray(sae.w_enc),
+                                   states[cell.layer]["W_enc"])
+        with np.load(cell.path) as data:
+            assert int(data["__grid_version__"]) == \
+                grid_spec.GRID_ARTIFACT_VERSION
+            # "canonical" resolved to the single leaf actually present.
+            assert str(data["__sae_id__"]) == \
+                f"layer_{cell.layer}/width_32/average_l0_10"
+
+
+def test_convert_cells_header_rejects_mismatched_cell(tmp_path):
+    import dataclasses
+
+    from taboo_brittleness_tpu.grid import spec as grid_spec
+
+    _snapshot(tmp_path, layers=(1,))
+    out_dir = tmp_path / "cells"
+    path = cgs.convert_cell(str(tmp_path), str(out_dir), 1, 32)
+    wrong = dataclasses.replace(
+        grid_spec.CellSpec(layer=2, width=32), path=path)
+    with pytest.raises(ValueError, match="header says layer=1"):
+        grid_spec.load_cell_sae(wrong)
+    # A plain (headerless) npz is rejected too.
+    bare = tmp_path / "bare.npz"
+    np.savez(bare, **_state(np.random.default_rng(6)))
+    with pytest.raises(ValueError, match="missing header"):
+        grid_spec.load_cell_sae(dataclasses.replace(
+            grid_spec.CellSpec(layer=1, width=32), path=str(bare)))
+
+
+def test_convert_cells_rejects_width_mismatch(tmp_path):
+    _snapshot(tmp_path, layers=(1,))
+    # Source SAE is width 32; asking for a 64-wide cell must fail loudly,
+    # not write a mislabeled artifact.
+    assert cgs.main([str(tmp_path), str(tmp_path / "cells"),
+                     "--cells", "1:64:layer_1/width_32/average_l0_10"]) == 1
+
+
+def test_convert_cells_canonical_ambiguous(tmp_path):
+    _snapshot(tmp_path, layers=(1,), leaf="average_l0_10")
+    _snapshot(tmp_path, layers=(1,), leaf="average_l0_99")
+    with pytest.raises(FileNotFoundError, match="multiple"):
+        cgs.convert_cell(str(tmp_path), str(tmp_path / "cells"), 1, 32)
+
+
 def test_cli_sae_autoconvert(tmp_path, monkeypatch):
     """cli._sae auto-converts from TABOO_GEMMA_SCOPE_ROOT when no npz given;
     output lands under the working tree (snapshot roots may be read-only)."""

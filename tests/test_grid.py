@@ -1,0 +1,551 @@
+"""Gemma-Scope grid sweeps + closed-loop attack search
+(taboo_brittleness_tpu/grid, ISSUE 14).
+
+Five layers:
+
+- grid schema unit tests (GridSpec round-trip, tap-layer derivation,
+  deterministic synthetic cell SAEs) — stdlib-fast;
+- capture-parity tests for the multi-tap decode (runtime/decode.py): a
+  1-tuple tap must be BIT-identical to the existing single-layer tap under
+  every edit scenario (none / SAE ablation / projection — the PR-8
+  cross-compilation hazard class), and a multi-layer tap on a ragged batch
+  must reproduce each per-layer single tap slot for slot;
+- capture/readout plumbing: the atomic residual artifact round-trips with
+  its version header, and ``run_cell`` slices the right slot;
+- the ISSUE 14 acceptance chaos e2e: 2 words x 2x2 grid through 2 real
+  subprocess fleet workers with one injected worker DEATH — every cell
+  commits exactly once, the breakage matrix is complete, and the merged
+  events are green under the full ``trace_report --check`` gate including
+  the new grid invariant;
+- the deterministic attack search: same seed => byte-identical trajectory
+  and breakage matrix, with at least one evolved forcing prefix scoring
+  strictly higher than the seed population — plus the trace_report
+  ``check_grid`` violation cases and the bench_compare grid gates.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu.grid import runner as grid_runner
+from taboo_brittleness_tpu.grid import search as grid_search
+from taboo_brittleness_tpu.grid.spec import (
+    GRID_ARTIFACT_VERSION, CellSpec, GridSpec, synthetic_cell_sae)
+from taboo_brittleness_tpu.models import gemma2
+from taboo_brittleness_tpu.ops import projection
+from taboo_brittleness_tpu.pipelines.interventions import (
+    projection_edit, sae_ablation_edit)
+from taboo_brittleness_tpu.runtime import decode, fleet, resilience, supervise
+from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+from taboo_brittleness_tpu.serve import loadgen
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# GridSpec schema.
+# ---------------------------------------------------------------------------
+
+def test_grid_spec_build_and_roundtrip():
+    spec = GridSpec.build([2, 1], [64, 32], release="synthetic")
+    assert spec.tap_layers == (1, 2)            # sorted, unique
+    assert len(spec.cells) == 4
+    assert "L1-W32" in spec.keys and "L2-W64" in spec.keys
+    cell = spec.cell("L2-W64")
+    assert (cell.layer, cell.width) == (2, 64)
+    assert spec.slot_of(cell) == 1
+    again = GridSpec.from_dict(spec.to_dict())
+    assert again == spec
+
+
+def test_grid_spec_rejects_version_drift():
+    spec = GridSpec.build([1], [32])
+    d = spec.to_dict()
+    d["version"] = GRID_ARTIFACT_VERSION + 1
+    with pytest.raises(ValueError, match="version"):
+        GridSpec.from_dict(d)
+
+
+def test_grid_spec_artifact_dir_layout(tmp_path):
+    spec = GridSpec.build([31], [16384], artifact_dir=str(tmp_path))
+    assert spec.cells[0].path == str(tmp_path / "L31-W16k.npz")
+
+
+def test_grid_spec_from_config_keeps_paper_cell():
+    from taboo_brittleness_tpu.config import Config
+
+    config = Config()
+    spec = GridSpec.from_config(config)
+    assert len(spec.cells) == 1
+    assert spec.cells[0].sae_id == config.sae.sae_id
+    assert spec.cells[0].layer == config.model.layer_idx
+    # Widening the grid drops the single-cell sae_id passthrough.
+    wide = GridSpec.from_config(config, layers=[1, 2], widths=[32])
+    assert all(c.sae_id != config.sae.sae_id or c.layer == 31
+               for c in wide.cells)
+    assert len(wide.cells) == 2
+
+
+def test_synthetic_cell_sae_is_cell_deterministic():
+    a = synthetic_cell_sae(CellSpec(layer=1, width=32), 16, seed=7)
+    b = synthetic_cell_sae(CellSpec(layer=1, width=32), 16, seed=7)
+    c = synthetic_cell_sae(CellSpec(layer=2, width=32), 16, seed=7)
+    np.testing.assert_array_equal(np.asarray(a.w_enc), np.asarray(b.w_enc))
+    assert not np.array_equal(np.asarray(a.w_enc), np.asarray(c.w_enc))
+    assert a.d_sae == 32
+
+
+# ---------------------------------------------------------------------------
+# Multi-tap capture parity (the PR-8 cross-compilation hazard class: a new
+# static configuration must not perturb the captured bits).
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    return gemma2.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _decode_args(cfg, rows=2, T=5, ragged=False):
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, cfg.vocab_size,
+                                 size=T - (i if ragged else 0)))
+               for i in range(rows)]
+    import jax.numpy as jnp
+
+    padded, valid, positions = decode.pad_prompts(prompts)
+    return (jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(positions))
+
+
+def _edit_for(scenario, cfg):
+    import jax.numpy as jnp
+
+    if scenario == "none":
+        return {}
+    if scenario == "sae":
+        sae = synthetic_cell_sae(CellSpec(layer=1, width=32),
+                                 cfg.hidden_size, seed=7)
+        return {"edit_fn": sae_ablation_edit,
+                "edit_params": {"sae": sae,
+                                "latent_ids": jnp.asarray([0, 3], jnp.int32),
+                                "layer": 1}}
+    basis = projection.random_subspace(jax.random.PRNGKey(5),
+                                       cfg.hidden_size, 2)
+    return {"edit_fn": projection_edit,
+            "edit_params": {"basis": basis, "layer": 1}}
+
+
+@pytest.mark.parametrize("scenario", ["none", "sae", "projection"])
+def test_multi_tap_1tuple_bit_identical_to_single_tap(scenario):
+    params, cfg = _tiny_model()
+    args = _decode_args(cfg)
+    kw = dict(max_new_tokens=3, **_edit_for(scenario, cfg))
+    single = decode.greedy_decode(params, cfg, *args,
+                                  capture_residual_layer=1, **kw)
+    multi = decode.greedy_decode(params, cfg, *args,
+                                 capture_residual_layer=(1,), **kw)
+    np.testing.assert_array_equal(np.asarray(single.tokens),
+                                  np.asarray(multi.tokens))
+    assert np.asarray(multi.residual).shape[0] == 1
+    # Bit identity, not allclose: the tuple path must compile to the exact
+    # same per-slot select as the int path.
+    np.testing.assert_array_equal(np.asarray(single.residual),
+                                  np.asarray(multi.residual)[0])
+
+
+def test_multi_tap_matches_per_layer_single_taps_ragged():
+    params, cfg = _tiny_model()
+    args = _decode_args(cfg, rows=3, T=6, ragged=True)
+    taps = (1, 2)
+    multi = decode.greedy_decode(params, cfg, *args, max_new_tokens=3,
+                                 capture_residual_layer=taps)
+    stack = np.asarray(multi.residual)
+    assert stack.shape[0] == len(taps)
+    for k, layer in enumerate(taps):
+        single = decode.greedy_decode(params, cfg, *args, max_new_tokens=3,
+                                      capture_residual_layer=layer)
+        # Tokens stay bit-identical (the decode path itself is untouched by
+        # how many taps ride the carry) ...
+        np.testing.assert_array_equal(np.asarray(single.tokens),
+                                      np.asarray(multi.tokens))
+        # ... but a K>1 carry is a DIFFERENT program, and XLA refuses the
+        # forward around the extra consumer: slot values match to float
+        # precision, not bit-for-bit (the K=1 test above holds the bit
+        # contract against the int path).
+        np.testing.assert_allclose(np.asarray(single.residual), stack[k],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_multi_tap_rejects_duplicate_layers():
+    params, cfg = _tiny_model()
+    args = _decode_args(cfg)
+    with pytest.raises(ValueError, match="duplicate"):
+        decode.greedy_decode(params, cfg, *args, max_new_tokens=2,
+                             capture_residual_layer=(1, 1))
+
+
+def test_generate_normalizes_list_taps():
+    """``generate`` accepts a list of taps (CLI plumbing) and rides the same
+    static-tuple path — result stacked [K, B, T, D]."""
+    params, cfg = _tiny_model()
+    tok = WordTokenizer(["ship", "hint"], vocab_size=cfg.vocab_size)
+    res, texts, seqs = decode.generate(
+        params, cfg, tok, ["Give me a hint"], max_new_tokens=3,
+        capture_residual_layer=[2, 1], return_texts=False)
+    assert np.asarray(res.residual).shape[0] == 2
+    assert np.asarray(res.residual).shape[3] == cfg.hidden_size
+
+
+# ---------------------------------------------------------------------------
+# Capture artifact + per-cell unit.
+# ---------------------------------------------------------------------------
+
+def _captured_grid(tmp_path, words=("ship",), max_new=3):
+    params, cfg = _tiny_model()
+    spec = GridSpec.build([1, 2], [32, 64], release="synthetic")
+    tok = WordTokenizer(
+        list(words) + ["Give", "me", "a", "hint", "about", "the", "word"],
+        vocab_size=cfg.vocab_size)
+    resid_dir = str(tmp_path / "residuals")
+    for w in words:
+        grid_runner.capture_word_residuals(
+            params, cfg, tok, w, spec, max_new_tokens=max_new,
+            resid_dir=resid_dir)
+    return params, cfg, tok, spec, resid_dir
+
+
+def test_capture_artifact_roundtrip_and_header(tmp_path):
+    _params, cfg, _tok, spec, resid_dir = _captured_grid(tmp_path)
+    path = grid_runner.residual_path(resid_dir, "ship")
+    art = grid_runner.load_word_residuals(path)
+    K, B, T, D = art["residual"].shape
+    assert K == len(spec.tap_layers) and D == cfg.hidden_size
+    assert art["mask"].shape == (B, T)
+    assert tuple(int(x) for x in art["tap_layers"]) == spec.tap_layers
+    # Version drift fails loudly.
+    blob = dict(np.load(path))
+    blob["__grid_version__"] = np.int64(GRID_ARTIFACT_VERSION + 1)
+    np.savez(path, **blob)
+    with pytest.raises(ValueError, match="version"):
+        grid_runner.load_word_residuals(path)
+
+
+def test_run_cell_readout_and_scoring(tmp_path):
+    params, cfg, tok, spec, resid_dir = _captured_grid(tmp_path)
+    unit = grid_runner.grid_units(spec, ["ship"])[0]
+    res = grid_runner.run_cell(unit, spec=spec, resid_dir=resid_dir,
+                               model=(params, cfg, tok), seed=7, top_k=4,
+                               max_new_tokens=3)
+    assert res["word"] == "ship" and res["cell"] == unit["readout"]["key"]
+    assert len(res["top_latents"]) == 4
+    assert {"leak_base", "leak_ablated", "broke"} <= set(res)
+    # Readout-only mode (no model) still yields the latent readout.
+    lite = grid_runner.run_cell(unit, spec=spec, resid_dir=resid_dir,
+                                model=None, seed=7, top_k=4)
+    assert lite["top_latents"] == res["top_latents"]
+
+
+def test_run_cell_rejects_untapped_layer(tmp_path):
+    # Capture with taps (1, 2), then ask for a cell at layer 3 through a
+    # WIDER spec: the stale-artifact guard must refuse, not mis-slice.
+    _params, _cfg, _tok, _spec, resid_dir = _captured_grid(tmp_path)
+    wide = GridSpec.build([3], [32], release="synthetic")
+    unit = grid_runner.grid_units(wide, ["ship"])[0]
+    with pytest.raises(ValueError, match="not in captured taps"):
+        grid_runner.run_cell(unit, spec=wide, resid_dir=resid_dir)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 acceptance: grid e2e through real fleet workers, worker death.
+# ---------------------------------------------------------------------------
+
+def test_grid_fleet_worker_death_exactly_once(tmp_path):
+    """2 words x 2x2 grid = 8 cells over 2 real subprocess workers; worker
+    ``w1`` dies at its first commit.  Every cell must commit exactly once,
+    the breakage matrix must be complete, and the merged event stream must
+    be green under the full trace_report gate including ``check_grid``."""
+    out = str(tmp_path / "grid")
+    words = ["ship", "moon"]
+    _params, _cfg, _tok, spec, resid_dir = _captured_grid(
+        tmp_path / "grid", words=tuple(words))
+    units = grid_runner.grid_units(spec, words)
+    plan = {"fleet.commit": [
+        {"mode": "die", "times": 1, "match": "w1", "incarnation": 0}]}
+    env = {"JAX_PLATFORMS": "cpu", "TABOO_FAULT_PLAN": json.dumps(plan),
+           "TBX_OBS_PROGRESS_S": "0.2", "TBX_SUPERVISE_BACKOFF_S": "0"}
+
+    def argv(wid):
+        return [sys.executable, "-m", "taboo_brittleness_tpu", "worker",
+                "--fleet-dir", out, "--worker-id", wid]
+
+    res = fleet.run_fleet(
+        units, out, n_workers=2, worker_argv=argv, worker_env=env,
+        spool_config={"mode": "grid", "words": words,
+                      "grid": spec.to_dict(), "resid_dir": resid_dir,
+                      "seed": 7, "top_k": 4, "max_new_tokens": 3},
+        lease_s=3.0, poll_s=0.2, supervise_poll=0.2, grace=2.0,
+        wedge_after=30.0, max_incarnations=4, spec_factor=0.0,
+        policy=fleet.RetryPolicy(max_retries=6, base_delay=0.0),
+        max_wall_s=600.0)
+
+    assert res.status == "done", res.to_dict()
+    spool = fleet.FleetSpool(os.path.join(out, fleet.SPOOL_DIRNAME))
+    assert sorted(spool.done_uids()) == sorted(u["uid"] for u in units)
+    assert res.committed == len(units) and res.quarantined == 0
+    # The death burned an incarnation and its unit was re-issued.
+    incs = {w["worker_id"]: w["incarnations"] for w in res.workers}
+    assert incs["w1"] >= 2, incs
+    assert res.lease_expiries >= 1 and res.reissued >= 1, res.to_dict()
+
+    matrix = grid_runner.assemble_matrix(out, spec, words)
+    assert matrix["complete"], matrix
+    for w in words:
+        for key in spec.keys:
+            cell = matrix["matrix"][w][key]
+            assert cell["status"] == "done"
+            assert cell["top_latents"], cell
+    pools = grid_runner.latent_pools(matrix)
+    assert set(pools) == set(spec.keys)
+
+    merged = os.path.join(out, "_events.jsonl")
+    events = list(trace_report.iter_events(merged))
+    assert trace_report.check(merged) == []
+    assert trace_report.check_fleet(merged, events) == []
+    assert trace_report.check_grid(merged, events) == []
+    rendered = trace_report.report(events)
+    assert "grid:" in rendered
+    for key in spec.keys:
+        assert key in rendered
+
+
+# ---------------------------------------------------------------------------
+# Attack search: determinism + strict improvement.
+# ---------------------------------------------------------------------------
+
+_SEARCH_KW = dict(words=("ship", "moon"), seed=3, generations=3,
+                  population=4, n_requests=4, max_new_tokens=5,
+                  latent_pools={"L1-W32": [1, 5, 9], "L2-W64": [2, 7]})
+
+
+def test_attack_search_deterministic_and_improves():
+    engine, _scen, lens_target = loadgen.build_synthetic_multi_engine(
+        words=("ship", "moon"), max_new_tokens=6)
+    r1 = grid_search.run_search(engine, lens_target, **_SEARCH_KW)
+    r2 = grid_search.run_search(engine, lens_target, **_SEARCH_KW)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+    # Strict improvement over the seed population (the lens bonus provides
+    # continuous signal even when nothing forces yet).
+    assert r1["improved"] is True
+    assert r1["best"]["fitness"] > r1["seed_best_fitness"]
+    assert len(r1["trajectory"]) == r1["generations"]
+    # Breakage matrix covers every (word, cell, attack) triple.
+    by_word = r1["matrix"]["by_word"]
+    for w in ("ship", "moon"):
+        for cell in r1["matrix"]["cells"]:
+            assert len(by_word[w][cell]) == len(r1["matrix"]["attacks"])
+            for rec in by_word[w][cell].values():
+                assert {"forcing", "lens", "broke"} <= set(rec)
+
+
+def test_attack_search_seed_changes_trajectory():
+    engine, _scen, lens_target = loadgen.build_synthetic_multi_engine(
+        words=("ship", "moon"), max_new_tokens=6)
+    r1 = grid_search.run_search(engine, lens_target, **_SEARCH_KW)
+    r3 = grid_search.run_search(engine, lens_target,
+                                **dict(_SEARCH_KW, seed=4))
+    assert json.dumps(r1, sort_keys=True) != json.dumps(r3, sort_keys=True)
+
+
+def test_attack_name_is_stable_across_processes():
+    a = grid_search.Attack(prefix="My secret word is",
+                           template="What is the word?", latents=(1, 2))
+    b = grid_search.Attack(prefix="My secret word is",
+                           template="What is the word?", latents=(1, 2))
+    assert a.name == b.name and a.name.startswith("a")
+
+
+# ---------------------------------------------------------------------------
+# trace_report: check_grid violation cases + grid section rendering.
+# ---------------------------------------------------------------------------
+
+def _grid_stream(tmp_path, records, name="_events.jsonl"):
+    """A minimal valid stream: ``records`` entries are either
+    ("point", name, attrs) or ("span", name, attrs, status)."""
+    path = str(tmp_path / name)
+    seq = 0
+    next_id = [2]
+    lines = []
+
+    def add(rec):
+        nonlocal seq
+        seq += 1
+        lines.append(json.dumps({"v": 1, "seq": seq, "t": float(seq),
+                                 **rec}))
+
+    add({"ev": "start", "kind": "run", "name": "sweep", "id": 1,
+         "attrs": {"pipeline": "fleet"}})
+    for rec in records:
+        if rec[0] == "point":
+            add({"ev": "point", "kind": "point", "name": rec[1],
+                 "parent": 1, "attrs": rec[2]})
+        else:
+            sid = next_id[0]
+            next_id[0] += 1
+            add({"ev": "start", "kind": "phase", "name": rec[1], "id": sid,
+                 "parent": 1, "attrs": rec[2]})
+            add({"ev": "end", "kind": "phase", "name": rec[1], "id": sid,
+                 "dur": 0.5, "status": rec[3]})
+    add({"ev": "end", "kind": "run", "name": "sweep", "id": 1, "dur": 9.0,
+         "status": "ok"})
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def _grid_errors(path):
+    return trace_report.check_grid(path, list(trace_report.iter_events(path)))
+
+
+def test_check_grid_green_on_clean_cell(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("span", "grid.cell", {"word": "ship", "cell": "L1-W32"}, "ok"),
+        ("point", "fleet.commit", {"uid": "ship@L1-W32", "worker": "w0",
+                                   "duplicate": False}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    assert _grid_errors(path) == []
+
+
+def test_check_grid_flags_double_commit(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("span", "grid.cell", {"word": "ship", "cell": "L1-W32"}, "ok"),
+        ("point", "fleet.commit", {"uid": "ship@L1-W32", "worker": "w0",
+                                   "duplicate": False}),
+        ("point", "fleet.commit", {"uid": "ship@L1-W32", "worker": "w1",
+                                   "duplicate": False}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    assert any("exactly-once violated" in e for e in _grid_errors(path))
+
+
+def test_check_grid_flags_commit_without_span(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("point", "fleet.commit", {"uid": "ship@L1-W32", "worker": "w0",
+                                   "duplicate": False}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    assert any("no completed grid.cell span" in e for e in _grid_errors(path))
+
+
+def test_check_grid_flags_unresolved_cell(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    assert any("never committed or quarantined" in e
+               for e in _grid_errors(path))
+
+
+def test_check_grid_drained_run_tolerates_unresolved(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("point", "fleet.exit", {"status": "drained"}),
+    ])
+    assert _grid_errors(path) == []
+
+
+def test_check_grid_noop_on_non_grid_fleet_stream(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "word00-L1", "worker": "w0"}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    assert _grid_errors(path) == []
+
+
+def test_grid_section_renders_cell_lanes(tmp_path):
+    path = _grid_stream(tmp_path, [
+        ("point", "fleet.claim", {"uid": "ship@L1-W32", "worker": "w0"}),
+        ("span", "grid.cell", {"word": "ship", "cell": "L1-W32"}, "error"),
+        ("span", "grid.cell", {"word": "ship", "cell": "L1-W32"}, "ok"),
+        ("point", "fleet.commit", {"uid": "ship@L1-W32", "worker": "w0",
+                                   "duplicate": False}),
+        ("point", "fleet.exit", {"status": "done"}),
+    ])
+    out = trace_report.report(list(trace_report.iter_events(path)))
+    assert "grid:" in out
+    assert "L1-W32" in out
+    # Two runs (one errored retry), one commit.
+    line = next(ln for ln in out.splitlines() if "L1-W32" in ln)
+    cols = line.split()
+    assert cols[1:6] == ["1", "2", "1", "1", "0"]
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the grid_sweep / attack_search regression gates.
+# ---------------------------------------------------------------------------
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_grid_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"grid_sweep": {"cells_per_hour": 4000.0},
+                               "attack_search": {"break_rate": 0.0}})
+    _write_round(tmp_path, 2, {"grid_sweep": {"cells_per_hour": 3500.0},
+                               "attack_search": {"break_rate": 0.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_grid_flags_throughput_regression(tmp_path):
+    _write_round(tmp_path, 1, {"grid_sweep": {"cells_per_hour": 4000.0}})
+    _write_round(tmp_path, 2, {"grid_sweep": {"cells_per_hour": 2500.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("grid_sweep.cells_per_hour" in r for r in regressions)
+
+
+def test_bench_compare_break_rate_slack_tolerates_near_zero(tmp_path):
+    # 0.02 -> 0.0 is within the 0.05 absolute slack: near-zero wiggle.
+    _write_round(tmp_path, 1, {"attack_search": {"break_rate": 0.02}})
+    _write_round(tmp_path, 2, {"attack_search": {"break_rate": 0.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_break_rate_flags_real_regression(tmp_path):
+    _write_round(tmp_path, 1, {"attack_search": {"break_rate": 0.5}})
+    _write_round(tmp_path, 2, {"attack_search": {"break_rate": 0.2}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("attack_search.break_rate" in r for r in regressions)
+
+
+def test_bench_compare_grid_missing_is_skipped(tmp_path):
+    _write_round(tmp_path, 1, {"grid_sweep": {"cells_per_hour": 4000.0}})
+    _write_round(tmp_path, 2, {})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("grid_sweep.cells_per_hour" in line and "skipped" in line
+               for line in lines)

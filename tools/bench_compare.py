@@ -78,6 +78,16 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # sparse shows up here before latency moves.
     "delta_switch.switch_ms": (0.50, False, 0.0),
     "delta_switch.delta_bytes_ratio": (0.25, False, 0.0),
+    # Gemma-Scope grid sweep (bench.py grid_sweep, ISSUE 14): committed
+    # grid cells per hour through the REAL fleet path (capture-once decode
+    # + per-cell fleet units over subprocess workers) must not slide back.
+    "grid_sweep.cells_per_hour": (0.25, True, 0.0),
+    # Closed-loop attack search (same bench stage, attack_search headline):
+    # evolved-attack break rate over the synthetic engine.  Absolute slack:
+    # the healthy CPU-smoke value sits at/near zero (the tiny random model
+    # rarely emits the secret), so a 0.00 -> 0.05 wiggle is noise, not a
+    # regression signal.
+    "attack_search.break_rate": (0.25, True, 0.05),
 }
 
 #: Absolute-budget metrics: (max allowed value).  Checked on the LATEST

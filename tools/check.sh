@@ -41,6 +41,9 @@ JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
 echo "== delta-pack selfcheck (pack/apply bit-exactness on the tiny model)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu delta-pack --selfcheck
 
+echo "== grid selfcheck (chaos smoke: 2x2 grid x 2 words, one faulted cell)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu grid --selfcheck
+
 echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \

@@ -19,6 +19,21 @@ Output: ``np.savez(out, W_enc, b_enc, W_dec, b_dec, threshold)`` — exactly wha
 ``ops/sae.py:load`` consumes.  Shapes are validated against the JumpReLU layout
 (W_enc [d_model, d_sae], W_dec [d_sae, d_model]); an encoder stored transposed
 is fixed automatically using the bias lengths as ground truth.
+
+Grid mode (``--cells``) converts an explicit list of (layer, width) cells in
+one pass for ``taboo_brittleness_tpu.grid``:
+
+    python tools/convert_gemma_scope.py SNAPSHOT_DIR out_dir \\
+        --cells "20:16384,31:16384,31:131072:layer_31/width_128k/average_l0_73"
+
+Each entry is ``layer:width`` or ``layer:width:sae_id``; without an explicit
+sae_id the converter resolves ``layer_<L>/width_<tag>/canonical`` to the single
+``average_l0_*`` leaf present in the snapshot.  OUT becomes a directory holding
+one ``<cell-key>.npz`` per cell (``L<layer>-W<tag>.npz`` — exactly the layout
+``grid.spec.GridSpec.build(artifact_dir=...)`` points at), each carrying a
+versioned header (``__grid_version__``/``__sae_id__``/``__layer__``/
+``__width__``) next to the weight arrays; ``grid.spec.load_cell_sae``
+validates that header before trusting the file.
 """
 
 from __future__ import annotations
@@ -128,14 +143,102 @@ def convert(source: str, out_path: str, sae_id: Optional[str] = None) -> Dict[st
     return state
 
 
+def parse_cells(text: str) -> List[tuple]:
+    """``"20:16384,31:16384:layer_31/width_16k/average_l0_76"`` ->
+    ``[(20, 16384, None), (31, 16384, "layer_31/...")]``."""
+    cells = []
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":", 2)
+        if len(parts) < 2:
+            raise ValueError(
+                f"bad --cells entry {entry!r} (want layer:width[:sae_id])")
+        try:
+            layer, width = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"bad --cells entry {entry!r} (layer/width must be ints)")
+        cells.append((layer, width, parts[2] if len(parts) == 3 else None))
+    if not cells:
+        raise ValueError("--cells parsed to an empty list")
+    return cells
+
+
+def _resolve_sae_id(source: str, sae_id: str) -> str:
+    """Resolve a ``.../canonical`` sae_id against a snapshot dir: the release
+    names leaves ``average_l0_<x>`` with per-cell x, so ``canonical`` means
+    "the single leaf that exists under layer_<L>/width_<tag>/"."""
+    if not sae_id.endswith("/canonical") or not os.path.isdir(source):
+        return sae_id
+    base_rel = os.path.dirname(sae_id)
+    base = os.path.join(source, base_rel)
+    leaves = sorted(
+        d for d in (os.listdir(base) if os.path.isdir(base) else [])
+        if os.path.exists(os.path.join(base, d, "params.npz")))
+    if len(leaves) == 1:
+        return f"{base_rel}/{leaves[0]}"
+    raise FileNotFoundError(
+        f"cannot resolve {sae_id!r} under {source}: "
+        f"{'no' if not leaves else 'multiple'} params.npz leaves "
+        f"({leaves or 'none'}); pass layer:width:sae_id explicitly")
+
+
+def convert_cell(source: str, out_dir: str, layer: int, width: int,
+                 sae_id: Optional[str] = None) -> str:
+    """Convert one grid cell to ``<out_dir>/<cell-key>.npz`` with the
+    versioned header ``grid.spec.load_cell_sae`` validates.  Returns the
+    written path."""
+    from taboo_brittleness_tpu.grid import spec as grid_spec
+
+    sid = _resolve_sae_id(
+        source, sae_id or grid_spec.default_sae_id(layer, width))
+    state = canonicalize(
+        load_state(source, sid if os.path.isdir(source) else None))
+    d_sae = state["b_enc"].shape[0]
+    if d_sae != int(width):
+        raise ValueError(
+            f"cell {layer}:{width}: source {sid!r} has d_sae={d_sae}, "
+            f"not {width} — wrong width folder?")
+    cell = grid_spec.CellSpec(layer=int(layer), width=int(width), sae_id=sid)
+    out_path = os.path.join(out_dir, f"{cell.key}.npz")
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(out_path, **state,
+             __grid_version__=np.int64(grid_spec.GRID_ARTIFACT_VERSION),
+             __sae_id__=np.asarray(sid),
+             __layer__=np.int64(layer), __width__=np.int64(width))
+    # Round-trip through the grid loader so what we wrote is what a fleet
+    # worker will accept (header AND weights).
+    import dataclasses as _dc
+    loaded = grid_spec.load_cell_sae(_dc.replace(cell, path=out_path))
+    assert loaded.d_sae == int(width)
+    return out_path
+
+
+def convert_cells(source: str, out_dir: str,
+                  cells: List[tuple]) -> List[str]:
+    return [convert_cell(source, out_dir, la, w, sid)
+            for la, w, sid in cells]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("source", help="params.npz / snapshot dir / .pt / .safetensors")
-    ap.add_argument("out", help="output npz path")
+    ap.add_argument("out", help="output npz path (a directory with --cells)")
     ap.add_argument("--sae-id", default="layer_31/width_16k/average_l0_76",
                     help="release subfolder when SOURCE is a snapshot dir")
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated layer:width[:sae_id] grid cells; "
+                         "OUT becomes a directory of <cell-key>.npz artifacts")
     args = ap.parse_args(argv)
     try:
+        if args.cells:
+            paths = convert_cells(args.source, args.out,
+                                  parse_cells(args.cells))
+            for p in paths:
+                print(f"OK: wrote {p}")
+            return 0
         state = convert(args.source, args.out, args.sae_id)
     except (FileNotFoundError, KeyError, ValueError) as e:
         print(f"FAILED: {e}")

@@ -57,6 +57,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -543,6 +544,143 @@ def _fleet_section(spans: Dict[int, Span],
     return "\n".join(lines)
 
 
+#: Grid fleet unit ids look like ``<word>@L<layer>-W<tag>`` —
+#: ``fleet.unit_id(word, readout)`` over ``grid.spec.CellSpec.key``.
+_GRID_UID_RE = re.compile(r"^.+@L\d+-W[0-9a-zA-Z]+$")
+
+
+def check_grid(path: str, events: List[Dict[str, Any]]) -> List[str]:
+    """Grid-sweep invariants for ``--check`` (empty = clean; no-op on
+    streams without grid units).  Over a grid fleet's merged stream
+    (``taboo_brittleness_tpu/grid/runner.py``):
+
+    - every ISSUED cell (a ``fleet.claim`` whose uid is a grid unit id,
+      ``<word>@L<layer>-W<tag>``) resolves: committed exactly once
+      (non-duplicate), quarantined, or the run drained;
+    - a committed cell is backed by at least one COMPLETED ``grid.cell``
+      span whose (word, cell) attrs reconstruct that uid — a commit with
+      no span means the worker skipped the cell program;
+    - every ended ``grid.cell`` span carries its word/cell attrs (the
+      lane join below, and the uid reconstruction above, need them).
+    """
+    errors: List[str] = []
+    spans, points = build_spans(events)
+    fleet = _fleet_points(points)
+    cell_spans = [s for s in spans.values() if s.name == "grid.cell"]
+
+    def attr(p, key, default=None):
+        return (p.get("attrs") or {}).get(key, default)
+
+    def grid_uid(p) -> Optional[str]:
+        uid = str(attr(p, "uid"))
+        return uid if _GRID_UID_RE.match(uid) else None
+
+    issued = [p for p in fleet.get("fleet.claim", []) if grid_uid(p)]
+    if not issued and not cell_spans:
+        return errors
+
+    drained = any(
+        s.attrs.get("drained") for s in spans.values() if s.kind == "run")
+    exits = fleet.get("fleet.exit", [])
+    status = str((exits[-1].get("attrs") or {}).get("status", "done")
+                 if exits else "done")
+    incomplete_ok = drained or status in ("drained", "stalled")
+
+    committed: Dict[str, int] = {}
+    for p in fleet.get("fleet.commit", []):
+        uid = grid_uid(p)
+        if uid and not attr(p, "duplicate", False):
+            committed[uid] = committed.get(uid, 0) + 1
+    quarantined = {grid_uid(p)
+                   for p in fleet.get("fleet.quarantine", [])} - {None}
+
+    done_cells = set()
+    for s in cell_spans:
+        if s.dur is None:
+            continue  # killed mid-cell; the re-issue path owns it
+        word, cell = s.attrs.get("word"), s.attrs.get("cell")
+        if not word or not cell:
+            errors.append(
+                f"{path}: grid.cell span id={s.id} ended without word/cell "
+                "attrs — lanes and commit backing cannot be joined")
+            continue
+        if s.status == "ok":
+            done_cells.add(f"{word}@{cell}")
+
+    for uid, n in sorted(committed.items()):
+        if n > 1:
+            errors.append(
+                f"{path}: grid cell {uid} committed {n} times without the "
+                "duplicate flag — exactly-once violated")
+        if uid not in done_cells:
+            errors.append(
+                f"{path}: grid cell {uid} committed with no completed "
+                "grid.cell span backing it")
+    for p in issued:
+        uid = grid_uid(p)
+        if uid in committed or uid in quarantined or incomplete_ok:
+            continue
+        errors.append(
+            f"{path}: grid cell {uid} issued (worker "
+            f"{attr(p, 'worker')}) but never committed or quarantined")
+    return errors
+
+
+def _grid_section(spans: Dict[int, Span],
+                  points: List[Dict[str, Any]]) -> str:
+    """Per-cell lane view of a grid sweep: one row per (layer, width) cell
+    pooling its ``grid.cell`` runs across words and workers, joined against
+    the fleet's commit/quarantine markers for that cell's units."""
+    fleet = _fleet_points(points)
+
+    def attr(p, key, default=None):
+        return (p.get("attrs") or {}).get(key, default)
+
+    lanes: Dict[str, Dict[str, Any]] = {}
+
+    def lane(cell_key: str) -> Dict[str, Any]:
+        return lanes.setdefault(str(cell_key), {
+            "words": set(), "runs": 0, "errors": 0, "committed": 0,
+            "quarantined": 0, "total": 0.0})
+
+    for s in spans.values():
+        if s.name != "grid.cell" or s.dur is None:
+            continue
+        cell = lane(s.attrs.get("cell", "?"))
+        cell["runs"] += 1
+        cell["total"] += s.dur
+        cell["words"].add(str(s.attrs.get("word", "?")))
+        if s.status == "error":
+            cell["errors"] += 1
+    for name, field in (("fleet.commit", "committed"),
+                        ("fleet.quarantine", "quarantined")):
+        for p in fleet.get(name, []):
+            uid = str(attr(p, "uid"))
+            if _GRID_UID_RE.match(uid) and not attr(p, "duplicate", False):
+                lane(uid.rsplit("@", 1)[1])[field] += 1
+    if not lanes:
+        return ""
+    lines = ["grid:"]
+    encodes = [s for s in spans.values()
+               if s.name == "grid.encode" and s.dur is not None]
+    if encodes:
+        tot = sum(s.dur for s in encodes)
+        lines.append(f"  {len(encodes)} encode program launch(es), "
+                     f"{_fmt_s(tot)}s total")
+    header = ["cell", "words", "runs", "errors", "committed", "quarantined",
+              "mean_s"]
+    body = []
+    for key in sorted(lanes):
+        cell = lanes[key]
+        mean = cell["total"] / cell["runs"] if cell["runs"] else None
+        body.append([f"  {key}", str(len(cell["words"])), str(cell["runs"]),
+                     str(cell["errors"]), str(cell["committed"]),
+                     str(cell["quarantined"]), _fmt_s(mean)])
+    lines.append(_table(header, body))
+    lines.append("")
+    return "\n".join(lines)
+
+
 def check_device(profile_path: str, events: List[Dict[str, Any]]) -> List[str]:
     """Join-invariant violations for ``--check --device`` (empty = clean)."""
     errors: List[str] = []
@@ -712,6 +850,10 @@ def report(events: List[Dict[str, Any]], *,
 
     if _fleet_points(points):
         out.append(_fleet_section(spans, points))
+
+    grid_section = _grid_section(spans, points)
+    if grid_section:
+        out.append(grid_section)
 
     for run in runs:
         pipeline = run.attrs.get("pipeline", run.name)
@@ -931,6 +1073,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Fleet invariants (runtime/fleet.py): no-op on non-fleet streams,
         # so the gate applies wherever a merged fleet stream shows up.
         errors += check_fleet(args.events, list(iter_events(args.events)))
+        # Grid-sweep invariants (grid/runner.py): every issued cell resolves
+        # committed-once / quarantined / drained, with span backing.
+        errors += check_grid(args.events, list(iter_events(args.events)))
         # Speculative-serving invariants (serve/spec_engine.py): every
         # verify-block span must resolve to an accept record.
         errors += check_serve_spec(args.events,
