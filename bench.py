@@ -1026,7 +1026,7 @@ def _study_bench(params, cfg, tap_layer: int, prompt_len: int,
 
 
 def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
-                     on_accel: bool = False) -> dict:
+                     on_accel: bool = False, live: bool = False) -> dict:
     """Measure the telemetry subsystem's wall cost on a sweep smoke.
 
     The obs contract (taboo_brittleness_tpu/obs) is "always-on is free":
@@ -1083,7 +1083,14 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
 
     def run(obs_on: bool) -> tuple:
         prev = os.environ.get("TBX_OBS")
+        prev_ts = os.environ.get("TBX_OBS_TS_S")
         os.environ["TBX_OBS"] = "1" if obs_on else "0"
+        if live and obs_on:
+            # Live-telemetry arm (ISSUE 15): the windowed spool + SLO burn
+            # engine + flight recorder armed at an AGGRESSIVE window (0.5 s
+            # vs the 10 s default) so the measured overhead upper-bounds
+            # production settings.
+            os.environ["TBX_OBS_TS_S"] = "0.5"
         out_dir = tempfile.mkdtemp(prefix="tbx_obs_ab_")
         try:
             t0 = time.perf_counter()
@@ -1105,6 +1112,10 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
                 os.environ.pop("TBX_OBS", None)
             else:
                 os.environ["TBX_OBS"] = prev
+            if prev_ts is None:
+                os.environ.pop("TBX_OBS_TS_S", None)
+            else:
+                os.environ["TBX_OBS_TS_S"] = prev_ts
             shutil.rmtree(out_dir, ignore_errors=True)
 
     run(False)                              # compile warm-up, off the books
@@ -1136,8 +1147,12 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
         "overhead_pct": (round(100.0 * overhead, 2)
                          if overhead is not None else None),
         "events_per_run": events,
-        "budget": "obs-on must stay <2% wall over obs-off (ratio of "
-                  "paired-rep totals)",
+        "live_sampler": bool(live),
+        "budget": ("obs-on (windowed spool + SLO engine + flight recorder "
+                   "at TBX_OBS_TS_S=0.5) must stay <2% wall over obs-off "
+                   "(ratio of paired-rep totals)" if live else
+                   "obs-on must stay <2% wall over obs-off (ratio of "
+                   "paired-rep totals)"),
     }
 
 
@@ -1751,6 +1766,20 @@ def main() -> int:
             reps=int(os.environ.get("BENCH_OBS_AB_REPS", "5")),
             on_accel=on_accel)
 
+    obs_live_ab = None
+    if os.environ.get("BENCH_OBS_LIVE_AB", "1") == "1":
+        # Re-proof of the <2% contract with the LIVE sampler armed
+        # (ISSUE 15): windowed metrics spool + SLO burn engine + flight
+        # recorder, at an aggressive 0.5 s window.  Default reps are 4x the
+        # plain stage's: bench_compare holds this number to an ABSOLUTE
+        # +/-2% band, and at 5 reps the CPU smoke's run-to-run scatter is
+        # itself ~+/-2% — 20 paired reps integrate it to well under the
+        # band (measured: 5-rep trials ranged 0.45..4.63%, 20 reps -0.62%).
+        obs_live_ab = _obs_overhead_ab(
+            params, cfg, new_tokens,
+            reps=int(os.environ.get("BENCH_OBS_LIVE_AB_REPS", "20")),
+            on_accel=on_accel, live=True)
+
     serve_stage = None
     if os.environ.get("BENCH_SERVE", "1") == "1":
         serve_stage = _serve_bench(params, cfg, sae, tap_layer, on_accel)
@@ -1842,6 +1871,12 @@ def main() -> int:
         # Telemetry A/B (obs subsystem): sweep smoke with TBX_OBS on vs off;
         # the contract is <2% wall overhead (detail block "obs_overhead").
         "obs_overhead_pct": (obs_ab and obs_ab.get("overhead_pct")),
+        # Live-telemetry A/B (ISSUE 15): the same smoke with the windowed
+        # metrics spool + SLO burn engine + flight recorder ARMED at a 0.5 s
+        # window vs TBX_OBS=0 — the <2% contract re-proved with the sampler
+        # on (detail block "obs_live").
+        "obs_live": (obs_live_ab and {
+            "overhead_pct": obs_live_ab.get("overhead_pct")}),
         # Device-timeline profile (obs/profile.py): MEASURED per-phase
         # device-busy seconds + the device-idle share of one annotated
         # captured pass; full artifact in the detail block "device_profile".
@@ -1922,7 +1957,8 @@ def main() -> int:
         os.makedirs(os.path.dirname(detail_path), exist_ok=True)
         _atomic_json_dump(
             {"headline": headline, "sweep": sweep, "study": study,
-             "obs_overhead": obs_ab, "serve_latency": serve_stage,
+             "obs_overhead": obs_ab, "obs_live": obs_live_ab,
+             "serve_latency": serve_stage,
              "serve_spec_ab": serve_spec_stage,
              "fleet_recovery": fleet_stage,
              "delta_switch": delta_stage,

@@ -977,6 +977,17 @@ def _parse_int_list(text: Optional[str]) -> Optional[List[int]]:
     return [int(x) for x in str(text).split(",") if x.strip()]
 
 
+def cmd_top(args) -> int:
+    """Live terminal view (``obs.top``) of one output directory's telemetry
+    files: progress lanes, serve latency + SLO burn, HBM watermarks, spool
+    health, flight-recorder dumps.  Read-only and stdlib-only."""
+    from taboo_brittleness_tpu.obs import top
+
+    if args.selfcheck:
+        return top.main_selfcheck()
+    return top.run(args.dir, once=args.once, interval=args.interval)
+
+
 def cmd_grid(args) -> int:
     """Gemma-Scope grid sweep (``grid/``): capture each word's residuals
     ONCE while tapping every grid layer in a single launched program, then
@@ -1541,6 +1552,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: first configured word)")
     ch.add_argument("--max-new-tokens", type=int, default=128)
     ch.set_defaults(fn=cmd_chat)
+
+    tp = sub.add_parser(
+        "top",
+        help="live terminal view of a run directory's telemetry "
+             "(_progress*.json heartbeats, _metrics.jsonl SLO burn, "
+             "HBM watermarks, flight-recorder dumps)",
+        description="Renders the output directory's observability files as "
+                    "a compact text screen: one lane per progress "
+                    "heartbeat, windowed serve latency next to cumulative, "
+                    "the SLO burn table, speculation accept rate, HBM "
+                    "live/peak/headroom, and spool/flight-recorder health. "
+                    "Read-only; --once prints a single frame for CI or "
+                    "piping.")
+    tp.add_argument("--dir", default=".",
+                    help="run output directory to watch (default: cwd)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="live-refresh period in seconds")
+    tp.add_argument("--selfcheck", action="store_true",
+                    help="render the committed fleet fixture and verify "
+                         "the frame (CI smoke)")
+    tp.set_defaults(fn=cmd_top)
 
     sc = sub.add_parser(
         "spec-calibrate",

@@ -36,19 +36,24 @@ import sys
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
-from taboo_brittleness_tpu.obs import memory, metrics, profile, progress, trace
+from taboo_brittleness_tpu.obs import (
+    flightrec, memory, metrics, profile, progress, slo, timeseries, trace)
 from taboo_brittleness_tpu.obs.trace import (
     EVENTS_FILENAME, NULL_SPAN, SCHEMA_VERSION, Tracer, activate, deactivate,
     enabled, event, events_path, get_tracer, iter_events, last_seq, span)
 from taboo_brittleness_tpu.obs.progress import (
     PROGRESS_FILENAME, ProgressReporter, read_progress)
+from taboo_brittleness_tpu.obs.timeseries import (
+    METRICS_FILENAME, TimeseriesRecorder)
 
 __all__ = [
-    "EVENTS_FILENAME", "PROGRESS_FILENAME", "SCHEMA_VERSION",
-    "ProgressReporter", "SweepObserver", "Tracer",
-    "activate", "deactivate", "enabled", "event", "events_path",
+    "EVENTS_FILENAME", "METRICS_FILENAME", "PROGRESS_FILENAME",
+    "SCHEMA_VERSION", "ProgressReporter", "SweepObserver",
+    "TimeseriesRecorder", "Tracer",
+    "activate", "deactivate", "enabled", "event", "events_path", "flightrec",
     "get_tracer", "iter_events", "last_seq", "memory", "metrics", "profile",
-    "progress", "read_progress", "span", "sweep_observer", "trace", "warn",
+    "progress", "read_progress", "slo", "span", "sweep_observer",
+    "timeseries", "trace", "warn",
 ]
 
 
@@ -88,13 +93,15 @@ class SweepObserver:
                  reporter: Optional[ProgressReporter] = None,
                  owns_tracer: bool = False,
                  mem_sampler: Optional[memory.MemorySampler] = None,
-                 device_capture: Optional["profile.SweepCapture"] = None):
+                 device_capture: Optional["profile.SweepCapture"] = None,
+                 ts_recorder: Optional[TimeseriesRecorder] = None):
         self.tracer = tracer
         self.run_span = run_span
         self.reporter = reporter
         self._owns_tracer = owns_tracer
         self._mem_sampler = mem_sampler
         self._device_capture = device_capture
+        self.ts_recorder = ts_recorder
         self._final_status: Optional[str] = None
         self._preempt_notice = preempt_notice_seconds()
         #: Worst-case slack between the longest computed word and the
@@ -219,6 +226,13 @@ class SweepObserver:
                 pass
         if self._mem_sampler is not None:
             self._mem_sampler.stop()
+        if self.ts_recorder is not None:
+            # Final window + exit snapshot: the conservation invariant
+            # ``trace_report --check`` verifies (exit totals == last window).
+            try:
+                self.ts_recorder.stop()
+            except Exception:  # noqa: BLE001 — fail-open
+                pass
         if self.run_span is not None:
             if self.preempt_margin_s is not None:
                 self.run_span.set(preempt_margin_s=self.preempt_margin_s)
@@ -277,6 +291,7 @@ def sweep_observer(output_dir: Optional[str], *, pipeline: str,
                        else f"_events.{wid}.jsonl")
         progress_name = (PROGRESS_FILENAME if wid is None
                          else f"_progress.{wid}.json")
+        metrics_name = timeseries.metrics_filename(wid)
         outer = get_tracer()
         owns = outer is None
         if owns:
@@ -306,9 +321,24 @@ def sweep_observer(output_dir: Optional[str], *, pipeline: str,
             capture = profile.SweepCapture(output_dir, tracer=tracer)
             if not capture.start():
                 capture = None
+        recorder = None
+        if owns:
+            # Windowed metrics spool + SLO burn engine + crash flight
+            # recorder (ISSUE 15).  Only the outermost observer owns the
+            # spool — a nested sweep's counters already land in the outer
+            # recorder's registry sweeps.
+            flightrec.configure(output_dir, worker_id=wid)
+            engine = slo.SloEngine()
+            recorder = TimeseriesRecorder(
+                os.path.join(output_dir, metrics_name),
+                slo_engine=engine,
+                on_window=lambda rec, _rep=reporter, _eng=engine: (
+                    _rep.set_slo(_eng.last_block())))
+            recorder.start()
         ob = SweepObserver(tracer=tracer, run_span=run_span,
                            reporter=reporter, owns_tracer=owns,
-                           mem_sampler=sampler, device_capture=capture)
+                           mem_sampler=sampler, device_capture=capture,
+                           ts_recorder=recorder)
     except Exception:  # noqa: BLE001 — observability must never block a sweep
         yield SweepObserver()
         return

@@ -76,12 +76,40 @@ def device_memory_stats() -> List[Dict[str, Any]]:
         return []
 
 
+def _publish_gauges(rss: Optional[int],
+                    devices: List[Dict[str, Any]]) -> None:
+    """Mirror the watermarks into the metrics registry (``mem.hbm.*``, host
+    RSS) so they ride the timeseries spool (``obs.timeseries``) — the live
+    input the ROADMAP's batch-width autotune and the HBM-headroom SLO
+    (``obs.slo``) consume.  Fail-open; totals across local devices."""
+    try:
+        from taboo_brittleness_tpu.obs import metrics
+
+        if rss is not None:
+            metrics.gauge("mem.host.rss_bytes").set(rss)
+        if devices:
+            live = sum(d["bytes_in_use"] or 0 for d in devices)
+            peak = sum(d["peak_bytes_in_use"] or 0 for d in devices)
+            limit = sum(d["bytes_limit"] or 0 for d in devices)
+            metrics.gauge("mem.hbm.live_bytes").set(live)
+            if peak:
+                metrics.gauge("mem.hbm.peak_bytes").set(peak)
+            if limit:
+                metrics.gauge("mem.hbm.limit_bytes").set(limit)
+                metrics.gauge("mem.hbm.headroom_frac").set(
+                    round(max(0.0, 1.0 - live / limit), 4))
+    except Exception:  # noqa: BLE001 — publication is best-effort
+        pass
+
+
 def sample(*, compact: bool = False) -> Dict[str, Any]:
     """One watermark sample.  ``compact=True`` is the span-boundary form:
     megabytes, short keys, device list collapsed to totals — small enough to
-    ride on every word/phase end event."""
+    ride on every word/phase end event.  Every sample also refreshes the
+    ``mem.*`` registry gauges (:func:`_publish_gauges`)."""
     rss = host_rss_bytes()
     devices = device_memory_stats()
+    _publish_gauges(rss, devices)
     if not compact:
         out: Dict[str, Any] = {"rss_bytes": rss, "devices": devices}
         return out

@@ -20,6 +20,16 @@ from typing import Any, Dict, List, Optional
 _RESERVOIR_CAP = 512
 
 
+def quantile_of(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile over a raw sample list (the shared rule every
+    reservoir consumer uses, so windowed and cumulative percentiles can never
+    disagree about rounding)."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, max(0, int(q * (len(s) - 1) + 0.5)))]
+
+
 class Counter:
     """Monotonic non-negative counter."""
 
@@ -66,9 +76,19 @@ class Histogram:
     The reservoir keeps the FIRST ``_RESERVOIR_CAP`` observations and then
     overwrites deterministically (index ``n % cap``): sweeps observe at most
     a few thousand values, so this stays representative without RNG (obs code
-    must not perturb seeded randomness anywhere)."""
+    must not perturb seeded randomness anywhere).
 
-    __slots__ = ("name", "count", "total", "min", "max", "_sample", "_lock")
+    Alongside the cumulative reservoir, each observation also lands in a
+    WINDOW-forked reservoir: :meth:`roll_window` (called by the timeseries
+    recorder, ``obs.timeseries``) snapshots and resets it, so per-window
+    p50/p99 describe only the samples of that window — the live signal an
+    SLO burn rate needs, which a since-process-start reservoir arithmetically
+    masks.  The last rolled window is kept so :meth:`windowed` can report
+    "recent" stats (last rolled + in-progress window) between rolls."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_sample",
+                 "_w_count", "_w_total", "_w_min", "_w_max", "_w_sample",
+                 "_last_window", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -77,6 +97,12 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._sample: List[float] = []
+        self._w_count = 0
+        self._w_total = 0.0
+        self._w_min: Optional[float] = None
+        self._w_max: Optional[float] = None
+        self._w_sample: List[float] = []
+        self._last_window: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -90,14 +116,66 @@ class Histogram:
             self.total += value
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
+            if self._w_count < _RESERVOIR_CAP:
+                self._w_sample.append(value)
+            else:
+                self._w_sample[self._w_count % _RESERVOIR_CAP] = value
+            self._w_count += 1
+            self._w_total += value
+            self._w_min = (value if self._w_min is None
+                           else min(self._w_min, value))
+            self._w_max = (value if self._w_max is None
+                           else max(self._w_max, value))
 
     def quantile(self, q: float) -> Optional[float]:
         with self._lock:
             if not self._sample:
                 return None
-            s = sorted(self._sample)
-        idx = min(len(s) - 1, max(0, int(q * (len(s) - 1) + 0.5)))
-        return s[idx]
+            s = list(self._sample)
+        return quantile_of(s, q)
+
+    def roll_window(self) -> Dict[str, Any]:
+        """Fork off the current window: return ``{n, sum, min, max, samples}``
+        for everything observed since the last roll, reset the window
+        accumulators, and remember the result as the "last rolled window".
+        ``samples`` is the raw (bounded) reservoir — the timeseries recorder
+        computes per-window quantiles from it and the SLO engine counts
+        per-sample threshold violations; neither leaves the process."""
+        with self._lock:
+            win = {
+                "n": self._w_count,
+                "sum": self._w_total,
+                "min": self._w_min,
+                "max": self._w_max,
+                "samples": self._w_sample,
+            }
+            self._w_count = 0
+            self._w_total = 0.0
+            self._w_min = None
+            self._w_max = None
+            self._w_sample = []
+            self._last_window = win
+        return win
+
+    def windowed(self) -> Dict[str, Any]:
+        """Stats over the RECENT samples: the last rolled window plus the
+        in-progress one (so the view is never empty right after a roll).
+        Before any roll this is simply "everything so far" — identical to
+        cumulative, which is correct for a process younger than one window."""
+        with self._lock:
+            samples = list(self._w_sample)
+            n = self._w_count
+            w_max = self._w_max
+            last = self._last_window
+        if last is not None:
+            samples = list(last["samples"]) + samples
+            n += last["n"]
+            if last["max"] is not None:
+                w_max = (last["max"] if w_max is None
+                         else max(w_max, last["max"]))
+        return {"n": n, "max": w_max,
+                "p50": quantile_of(samples, 0.50),
+                "p99": quantile_of(samples, 0.99)}
 
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
@@ -149,6 +227,13 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def instruments(self) -> Dict[str, Any]:
+        """A point-in-time copy of the name → instrument map (the timeseries
+        recorder iterates this to roll histogram windows and diff counters
+        without holding the registry lock across IO)."""
+        with self._lock:
+            return dict(self._metrics)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """{"counters": {...}, "gauges": {...}, "histograms": {...}} with
@@ -203,5 +288,6 @@ def reset() -> None:
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "registry", "counter", "gauge", "histogram", "snapshot", "reset",
+    "quantile_of", "registry", "counter", "gauge", "histogram", "snapshot",
+    "reset",
 ]

@@ -86,6 +86,7 @@ class ProgressReporter:
         self._ema: Optional[float] = None
         self._serving: Optional[Dict[str, Any]] = None
         self._serving_latency: Optional[Dict[str, Any]] = None
+        self._slo: Optional[Dict[str, Any]] = None
         self._last_step_mono: Optional[float] = None
 
     # -- state setters (all thread-safe, all fail-open at the write) -------
@@ -128,7 +129,8 @@ class ProgressReporter:
 
     def serving_update(self, *, in_flight: int, completed: int,
                        queued: int = 0, stepped: bool = False,
-                       latency: Optional[Dict[str, Any]] = None) -> None:
+                       latency: Optional[Dict[str, Any]] = None,
+                       slo: Optional[Dict[str, Any]] = None) -> None:
         """Serving-mode heartbeat state (``tbx serve``; ISSUE 6 satellite).
 
         The word-sweep staleness classifier assumes word-boundary progress —
@@ -142,23 +144,39 @@ class ProgressReporter:
         heartbeat alone; only in-flight sessions with a stalled step clock
         wedge.
 
-        ``latency`` (ISSUE 7 satellite) carries the rolling per-scenario
-        percentiles (``SlotScheduler.latency_percentiles``) so operators see
-        SLO burn LIVE instead of only in the exit-time ``_serve.json``; the
-        last non-None value persists across heartbeats (the scheduler only
-        recomputes it when requests complete)."""
+        ``latency`` (ISSUE 7/15 satellites) carries the per-scenario
+        percentiles from ``SlotScheduler.latency_percentiles``: WINDOWED
+        p50/p99 (the window-forked reservoirs, stamped with ``window_s`` and
+        per-window sample counts) next to the honestly-labeled cumulative
+        view.  The last non-None value persists across heartbeats (the
+        scheduler only recomputes it when requests complete).
+
+        ``slo`` (ISSUE 15) is the burn-rate block from ``obs.slo.SloEngine``
+        — ``{series: {burn, fast, slow, ok}}`` — refreshed each timeseries
+        window; it rides the heartbeat so a supervisor or replica router can
+        admit on it without parsing the spool."""
         now = self._clock()
         with self._lock:
             if stepped or self._last_step_mono is None:
                 self._last_step_mono = now
             if latency is not None:
                 self._serving_latency = latency
+            if slo is not None:
+                self._slo = slo
             self._serving = {
                 "in_flight": int(in_flight),
                 "completed_requests": int(completed),
                 "queued": int(queued),
             }
         self._write_throttled()
+
+    def set_slo(self, block: Optional[Dict[str, Any]]) -> None:
+        """Update the heartbeat's ``slo`` block outside a serving update
+        (sweep/fleet mode, where the timeseries recorder drives it)."""
+        if block is None:
+            return
+        with self._lock:
+            self._slo = dict(block)
 
     def finish(self, status: str = "done") -> None:
         with self._lock:
@@ -177,6 +195,7 @@ class ProgressReporter:
             serving = dict(self._serving) if self._serving else None
             serving_latency = (dict(self._serving_latency)
                                if self._serving_latency else None)
+            slo = dict(self._slo) if self._slo else None
             last_step = self._last_step_mono
         remaining = max(
             0, state["words_total"] - state["words_done"]
@@ -215,6 +234,8 @@ class ProgressReporter:
             if serving_latency:
                 serving["latency"] = serving_latency
             out["serving"] = serving
+        if slo:
+            out["slo"] = slo
         if self.tracer is not None:
             try:
                 out["last_event_age_seconds"] = round(

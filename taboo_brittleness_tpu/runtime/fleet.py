@@ -84,6 +84,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
+from taboo_brittleness_tpu.obs import flightrec
 from taboo_brittleness_tpu.runtime import supervise
 from taboo_brittleness_tpu.runtime.resilience import (
     FailureLedger, RetryPolicy, atomic_json_dump, current_incarnation,
@@ -92,8 +93,8 @@ from taboo_brittleness_tpu.runtime import resilience
 
 __all__ = [
     "FleetResult", "FleetSpool", "LeaseKeeper", "WorkerResult",
-    "holder_token", "main_selfcheck", "merge_fleet_artifacts", "run_fleet",
-    "run_worker", "unit_id",
+    "holder_token", "main_selfcheck", "merge_fleet_artifacts",
+    "merge_metrics", "run_fleet", "run_worker", "unit_id",
 ]
 
 SPOOL_DIRNAME = "spool"
@@ -314,6 +315,9 @@ class FleetSpool:
                 os.replace(src, dst)
             except OSError:
                 continue                    # raced another worker; scan on
+            flightrec.record("fleet.claim", uid=uid,
+                             attempt=int(rec.get("attempt", 0)),
+                             worker=worker)
             return rec
         return None
 
@@ -366,6 +370,7 @@ class FleetSpool:
                 os.unlink(tmp)
             except OSError:
                 pass
+        flightrec.record("fleet.commit", uid=uid, won=won)
         return won
 
     def quarantine_unit(self, uid: str, attempt: int, *, worker: str,
@@ -430,6 +435,8 @@ class LeaseKeeper:
                 self.spool.write_lease(self.uid, self.attempt, self.holder,
                                        self.worker, self.lease_s,
                                        claimed_at=self.claimed_at)
+                flightrec.record("fleet.lease_renew", uid=self.uid,
+                                 attempt=self.attempt)
             except Exception:  # noqa: BLE001 — fail-open; expiry is benign
                 pass
 
@@ -812,6 +819,10 @@ def run_fleet(
                     ob.event("fleet.recovered",
                              reissued=len(reissued_uids),
                              recovery_seconds=recovery_seconds)
+                    # The fleet_recovery SLO (obs.slo) reads this histogram
+                    # from the timeseries windows.
+                    obs_metrics.histogram(
+                        "fleet.recovery_seconds").observe(recovery_seconds)
             if set(issued) <= resolved:
                 break
             if supervise.drain_requested():
@@ -971,6 +982,41 @@ def merge_events(output_dir: str, worker_ids: Sequence[str]) -> int:
     return appended
 
 
+def merge_metrics(output_dir: str, worker_ids: Sequence[str]) -> int:
+    """Fold the per-worker ``_metrics.<wid>.jsonl`` timeseries spools into
+    the coordinator's ``_metrics.jsonl`` (ISSUE 15), mirroring
+    :func:`merge_events`: ``seq`` renumbered to continue the merged tail and
+    every record stamped with its ``worker``.  Conservation invariants
+    (``trace_report --check``) are evaluated per (worker, pid) epoch, so
+    interleaving whole streams preserves them.  Returns records appended;
+    per-worker sources stay in place as the per-worker audit trail."""
+    from taboo_brittleness_tpu.obs import timeseries
+
+    merged_path = os.path.join(output_dir, timeseries.METRICS_FILENAME)
+    seq = timeseries._resume_seq(merged_path)
+    lines: List[bytes] = []
+    appended = 0
+    for wid in worker_ids:
+        src = os.path.join(output_dir, timeseries.metrics_filename(wid))
+        if not os.path.exists(src):
+            continue
+        for rec in _iter_jsonl(src):
+            rec = dict(rec)
+            seq += 1
+            rec["seq"] = seq
+            rec.setdefault("worker", wid)
+            lines.append((json.dumps(rec, default=str) + "\n").encode())
+            appended += 1
+    if lines:
+        fd = os.open(merged_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, b"".join(lines))
+        finally:
+            os.close(fd)
+    return appended
+
+
 def merge_ledgers(output_dir: str, worker_ids: Sequence[str],
                   result: Optional[FleetResult] = None) -> Dict[str, Any]:
     """Fold the per-worker ``_failures.<wid>.json`` ledgers into one merged
@@ -1014,6 +1060,10 @@ def merge_fleet_artifacts(output_dir: str, worker_ids: Sequence[str],
     try:
         merge_events(output_dir, worker_ids)
     except Exception:  # noqa: BLE001 — merging is bookkeeping, not the sweep
+        pass
+    try:
+        merge_metrics(output_dir, worker_ids)
+    except Exception:  # noqa: BLE001
         pass
     try:
         merge_ledgers(output_dir, worker_ids, result)

@@ -203,6 +203,24 @@ def _obs_count(name: str, amount: float = 1.0) -> None:
         pass
 
 
+def _flightrec_record(kind: str, **attrs: Any) -> None:
+    try:
+        from taboo_brittleness_tpu.obs import flightrec
+
+        flightrec.record(kind, **attrs)
+    except Exception:  # noqa: BLE001 — fail-open
+        pass
+
+
+def _flightrec_dump(reason: str, **extra: Any) -> None:
+    try:
+        from taboo_brittleness_tpu.obs import flightrec
+
+        flightrec.dump(reason, **extra)
+    except Exception:  # noqa: BLE001 — fail-open
+        pass
+
+
 # ---------------------------------------------------------------------------
 # RetryPolicy.
 # ---------------------------------------------------------------------------
@@ -504,6 +522,10 @@ FAULT_SITES = (
     "obs.event_write",    # obs.trace.Tracer._emit — proves telemetry is
     #                       fail-open: an injected sink fault drops the event,
     #                       never the run (tests/test_obs.py)
+    "obs.metrics_write",  # obs.timeseries.TimeseriesRecorder._write — same
+    #                       fail-open proof for the windowed metrics spool:
+    #                       an injected fault drops the window (counted in
+    #                       obs.metrics_dropped), never the run
     "serve.step",         # serve.scheduler.SlotScheduler.step — fired once
     #                       per in-flight session per step (context: request
     #                       id + scenario) so a plan can poison ONE session;
@@ -772,11 +794,15 @@ def run_guarded(
     here so every sweep shares one bookkeeping path.
     """
     attempts = {"n": 1}
+    _flightrec_record("word.attempt", word=word, stage=stage())
 
     def on_retry(exc: BaseException, attempt: int, delay: float) -> None:
         attempts["n"] = attempt + 1
         if ledger is not None:
             ledger.record_retry(word, stage(), exc, attempt)
+        _flightrec_record("word.retry", word=word, stage=stage(),
+                          attempt=attempt,
+                          error=f"{type(exc).__name__}: {exc}"[:200])
         _obs_count("sweep.retries")
         _obs_warn(f"[resilience] {word}: attempt {attempt} failed at "
                   f"{stage()} ({type(exc).__name__}: {exc}); retrying in "
@@ -795,6 +821,12 @@ def run_guarded(
                    attempts=attempts["n"],
                    error=f"{type(exc).__name__}: {exc}"[:300])
         _obs_count("sweep.quarantines")
+        # The postmortem trigger: the quarantine freezes the last-N-steps
+        # ring to <output_dir>/_flightrec.json (obs.flightrec; fail-open).
+        _flightrec_record("word.quarantine", word=word, stage=stage(),
+                          attempts=attempts["n"],
+                          error=f"{type(exc).__name__}: {exc}"[:200])
+        _flightrec_dump("quarantine", word=word, stage=stage())
         return WordOutcome(word=word, error=exc, attempts=attempts["n"],
                            stage=stage())
     if ledger is not None:

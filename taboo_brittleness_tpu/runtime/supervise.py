@@ -178,6 +178,17 @@ class DrainController:
                 "word boundary (send again to abort immediately)\n")
         except Exception:  # noqa: BLE001 — a closed stderr must not matter
             pass
+        # Flight-recorder dump (obs.flightrec): unlike the tracer, the ring
+        # is LOCK-FREE (deque appends are GIL-atomic) and the dump writes a
+        # fresh tmp file, so this is safe from signal context.  A wedge-kill
+        # (the supervisor's SIGTERM before SIGKILL) therefore leaves the last
+        # N records on disk even when the drain never completes.
+        try:
+            from taboo_brittleness_tpu.obs import flightrec
+
+            flightrec.dump(f"signal:{signum}")
+        except Exception:  # noqa: BLE001 — fail-open, always
+            pass
 
     def request(self) -> None:
         self._event.set()

@@ -46,7 +46,9 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 import numpy as np
 
 from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs import flightrec
 from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.obs import timeseries
 from taboo_brittleness_tpu.runtime import chat, resilience
 from taboo_brittleness_tpu.serve.engine import ServeEngine
 
@@ -313,6 +315,12 @@ class SlotScheduler:
             if not self._sessions:
                 return []
         responses: List[Response] = []
+        # Flight-recorder step record BEFORE the fault site fires, so a
+        # poisoned step is IN the ring the quarantine dump freezes.
+        flightrec.record("serve.step",
+                         in_flight=len(self._sessions),
+                         requests=[s.request.id
+                                   for s in self._sessions.values()])
         for slot, sess in list(self._sessions.items()):
             try:
                 resilience.fire("serve.step", request=sess.request.id,
@@ -328,6 +336,7 @@ class SlotScheduler:
         out = self.engine.step()
         obs_metrics.counter("serve.steps").inc()
         multi_col = hasattr(out, "toks")      # SpecStepOut: [S, G+1] columns
+        step_drafted = step_accepted = 0
         for slot, sess in list(self._sessions.items()):
             sess.steps += 1
             if multi_col:
@@ -337,8 +346,12 @@ class SlotScheduler:
                         if sess.request.scenario.lens_readout:
                             sess.lens_probs.append(
                                 float(out.lens_prob[slot, j]))
-                sess.drafted += int(out.drafted[slot])
-                sess.accepted += int(out.accepted[slot])
+                drafted = int(out.drafted[slot])
+                accepted = int(out.accepted[slot])
+                sess.drafted += drafted
+                sess.accepted += accepted
+                step_drafted += drafted
+                step_accepted += accepted
                 sess.early += int(out.early[slot])
                 sess.early_agree += int(out.early_agree[slot])
             elif bool(out.emitted[slot]):
@@ -349,6 +362,12 @@ class SlotScheduler:
                 stop_hit = sess.tokens and sess.tokens[-1] in self.engine.ec.stop_ids
                 responses.append(
                     self._finish(slot, "eos" if stop_hit else "budget"))
+        if step_drafted:
+            # Windowed accept_rate rides the timeseries spool as counter
+            # deltas — the live signal Sequoia-style (k, G) recalibration
+            # and the spec_accept SLO need (exit summary alone hides drift).
+            obs_metrics.counter("serve.spec.drafted").inc(step_drafted)
+            obs_metrics.counter("serve.spec.accepted").inc(step_accepted)
         self._after_step(responses)
         return responses
 
@@ -397,6 +416,9 @@ class SlotScheduler:
         if ok:
             self.completed += 1
             self._scenarios_completed.add(req.scenario.name)
+            flightrec.record("serve.complete", request=req.id,
+                             scenario=req.scenario.name, finish=finish,
+                             latency_s=resp.latency_seconds)
             obs_metrics.counter("serve.completed").inc()
             obs_metrics.histogram(
                 f"serve.latency.{req.scenario.name}").observe(
@@ -416,6 +438,13 @@ class SlotScheduler:
         else:
             self.quarantined += 1
             obs_metrics.counter("serve.quarantined").inc()
+            # Postmortem: freeze the ring (which already holds this request's
+            # poisoned serve.step record) to _flightrec.json.
+            flightrec.record("serve.quarantine", request=req.id,
+                             scenario=req.scenario.name, slot=slot,
+                             error=resp.error)
+            flightrec.dump("serve.quarantine", request=req.id,
+                           scenario=req.scenario.name)
         spec_attrs = ({"drafted": sess.drafted, "accepted": sess.accepted,
                        "emitted": len(sess.tokens),
                        "exited_early": sess.early}
@@ -449,27 +478,43 @@ class SlotScheduler:
             out[name] = d
         return out
 
-    def latency_percentiles(self) -> Dict[str, Dict[str, Any]]:
-        """Rolling per-scenario latency percentiles from the SLO histograms
-        — the live view ``serve_forever`` exports into the ``_progress.json``
-        heartbeat so ``tbx supervise`` and operators see SLO burn DURING the
-        run, not only in the exit-time ``_serve.json`` (ISSUE 7 satellite).
+    def latency_percentiles(self) -> Dict[str, Any]:
+        """Per-scenario latency percentiles — WINDOWED, honestly labeled.
 
-        Reads the same ``serve.latency.<scenario>`` reservoirs the exit
-        summary snapshots, so the live and final numbers can never disagree
-        about their source."""
-        out: Dict[str, Dict[str, Any]] = {}
+        The primary ``window`` stats come from each histogram's
+        window-forked reservoir (``obs.metrics.Histogram.windowed``: the
+        last rolled timeseries window plus the in-progress one), so a p99
+        regression mid-run moves the number within ~2 windows.  The
+        ``cumulative`` stats are the since-process-start reservoir the exit
+        summary snapshots — kept alongside because both views are useful,
+        labeled as what they are because a cumulative number sold as
+        "rolling" arithmetically masks exactly the regressions an SLO
+        exists to catch (ISSUE 15).
+
+        Shape::
+
+            {"window_s": 10.0,
+             "scenarios": {name: {"window":     {p50_s, p99_s, max_s, n},
+                                  "cumulative": {p50_s, p99_s, max_s, n}}}}
+        """
+        def _r(v: Optional[float]) -> Optional[float]:
+            return round(v, 4) if v is not None else None
+
+        scenarios: Dict[str, Dict[str, Any]] = {}
         for name in sorted(self._scenarios_completed):
             h = obs_metrics.histogram(f"serve.latency.{name}")
             if not h.count:
                 continue
-            out[name] = {
-                "p50_s": round(h.quantile(0.5), 4),
-                "p99_s": round(h.quantile(0.99), 4),
-                "max_s": round(h.max, 4) if h.max is not None else None,
-                "n": h.count,
+            win = h.windowed()
+            scenarios[name] = {
+                "window": {"p50_s": _r(win["p50"]), "p99_s": _r(win["p99"]),
+                           "max_s": _r(win["max"]), "n": win["n"]},
+                "cumulative": {"p50_s": _r(h.quantile(0.5)),
+                               "p99_s": _r(h.quantile(0.99)),
+                               "max_s": _r(h.max), "n": h.count},
             }
-        return out
+        return {"window_s": timeseries.window_seconds(),
+                "scenarios": scenarios}
 
     # -- loop helper ---------------------------------------------------------
 

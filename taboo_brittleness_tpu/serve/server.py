@@ -212,9 +212,12 @@ def serve_forever(
                           run_id=uuid.uuid4().hex[:12]) if obs.enabled() else None
     run_span = None
     reporter = None
+    recorder = None
+    slo_engine = None
     if tracer is not None:
+        from taboo_brittleness_tpu.obs import flightrec, slo, timeseries
         from taboo_brittleness_tpu.runtime.resilience import (
-            current_incarnation)
+            current_incarnation, current_worker_id)
 
         inc = current_incarnation()
         run_span = tracer.span(
@@ -226,6 +229,22 @@ def serve_forever(
             total_words=0, run_id=tracer.run_id, tracer=tracer).start()
         reporter.serving_update(in_flight=0,
                                 completed=spool.completed_count())
+        # Live telemetry (ISSUE 15): the windowed metrics spool + SLO burn
+        # engine + crash flight recorder.  The serve loop reads the engine's
+        # burn block into each heartbeat so supervisors and routers can admit
+        # on it without parsing _metrics.jsonl.
+        try:
+            flightrec.configure(output_dir,
+                                worker_id=current_worker_id())
+            slo_engine = slo.SloEngine()
+            recorder = timeseries.TimeseriesRecorder(
+                os.path.join(output_dir, timeseries.metrics_filename(
+                    current_worker_id())),
+                slo_engine=slo_engine)
+            recorder.start()
+        except Exception:  # noqa: BLE001 — telemetry must never block serving
+            recorder = None
+            slo_engine = None
 
     sched = SlotScheduler(engine, queue_limit=queue_limit,
                           lens_target_id=lens_target_id,
@@ -278,7 +297,9 @@ def serve_forever(
                     in_flight=sched.in_flight, completed=completed,
                     queued=sched.queue_depth, stepped=stepped,
                     latency=(sched.latency_percentiles() if resolved
-                             else None))
+                             else None),
+                    slo=(slo_engine.last_block() if slo_engine is not None
+                         else None))
             if sched.draining and sched.idle:
                 status, exit_code = "drained", supervise.EXIT_DRAINED
                 break
@@ -309,10 +330,20 @@ def serve_forever(
                              os.path.join(output_dir, SERVE_SUMMARY_FILENAME))
         except OSError:
             pass
+        if recorder is not None:
+            # Final window + exit snapshot BEFORE the reporter's last write
+            # so the heartbeat's closing slo block reflects the final window.
+            try:
+                recorder.stop()
+            except Exception:  # noqa: BLE001 — fail-open
+                pass
         if reporter is not None:
-            reporter.serving_update(in_flight=sched.in_flight,
-                                    completed=spool.completed_count(),
-                                    latency=sched.latency_percentiles())
+            reporter.serving_update(
+                in_flight=sched.in_flight,
+                completed=spool.completed_count(),
+                latency=sched.latency_percentiles(),
+                slo=(slo_engine.last_block() if slo_engine is not None
+                     else None))
             reporter.stop(status="preempted" if status == "drained"
                           else "done")
         if run_span is not None:

@@ -1,0 +1,652 @@
+"""Live telemetry (ISSUE 15): windowed metrics spool, SLO burn engine,
+crash flight recorder, ``tbx top``, and the ``trace_report --check``
+stream invariants.
+
+Layers:
+
+- ``obs.timeseries``: window/exit record schema, counter conservation
+  (``total_i == total_{i-1} + delta_i``), seq resume across incarnations,
+  torn-tail tolerance, and the ``obs.metrics_write`` fault site (a failed
+  spool write drops the window — counted and CONFESSED in the stream,
+  never fatal);
+- ``obs.slo``: ratio/histogram/gauge burn math, multi-window fast+slow
+  spans, burn decay as good windows age badness out, and one-alert-per-
+  episode latching;
+- ``obs.flightrec``: bounded ring + atomic dump, the serve-quarantine
+  trigger (the poisoned step is IN the frozen ring), and the SIGTERM
+  drain trigger (a subprocess killed the way the supervisor kills wedges);
+- ``tools/trace_report --check``: the new spool checkers accept the real
+  recorder's output and reject seeded corruption (broken conservation,
+  non-monotone seq, exit/window drift);
+- ``obs.top``: collect/render over the committed fleet fixture and over a
+  seeded latency regression (nonzero ``slo.burn`` must show);
+- satellite 1 regression: a latency step-change moves the WINDOWED p99
+  within two window rolls while the cumulative p99 stays put — the
+  arithmetic masking the windowed view exists to defeat;
+- satellite 6: the jit entry-point registry and the committed tbx-check
+  baseline must not grow as a side effect of telemetry work.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.obs import flightrec
+from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.obs import slo as obs_slo
+from taboo_brittleness_tpu.obs import timeseries, top
+from taboo_brittleness_tpu.obs.progress import ProgressReporter
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.resilience import FaultInjector
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+FLEET_FIXTURE = os.path.join(_REPO, "tests", "fixtures", "obs", "fleet")
+
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+import trace_report  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obs_metrics.reset()
+    flightrec.reset()
+    resilience.set_injector(FaultInjector())
+    yield
+    obs_metrics.reset()
+    flightrec.reset()
+    resilience.set_injector(FaultInjector())
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _read_spool(path):
+    return list(timeseries.iter_windows(path))
+
+
+# ---------------------------------------------------------------------------
+# Windowed spool: schema, conservation, resume, torn tails, fault site.
+# ---------------------------------------------------------------------------
+
+def test_window_and_exit_records_conserve(tmp_path):
+    """The recorder's own output must satisfy every invariant the checker
+    holds streams to: monotone seq/t0, exact counter conservation, and an
+    exit record identical to the final window's snapshot."""
+    reg = obs_metrics.MetricsRegistry()
+    clock = FakeClock()
+    path = str(tmp_path / "_metrics.jsonl")
+    rec = timeseries.TimeseriesRecorder(path, registry=reg, window_s=10.0,
+                                        sample_memory=False, clock=clock)
+    reg.counter("work.units").inc(3)
+    reg.gauge("work.depth").set(2.0)
+    for v in (0.1, 0.2, 0.3):
+        reg.histogram("work.latency").observe(v)
+    clock.advance(10.0)
+    rec.roll()
+    reg.counter("work.units").inc(4)
+    clock.advance(10.0)
+    rec.roll()
+    clock.advance(2.0)
+    rec.stop()                                  # final roll + exit record
+
+    records = _read_spool(path)
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["window", "window", "window", "exit"]
+    w1, w2, w3, ex = records
+    for r in records:
+        assert r["v"] == timeseries.SCHEMA_VERSION
+        assert r["pid"] == os.getpid()
+    assert [r["seq"] for r in records] == [1, 2, 3, 4]
+    assert w1["t1"] == pytest.approx(10.0) and w2["t0"] == pytest.approx(10.0)
+    assert w1["counters"]["work.units"] == {"total": 3.0, "delta": 3.0}
+    assert w2["counters"]["work.units"] == {"total": 7.0, "delta": 4.0}
+    assert w3["counters"]["work.units"] == {"total": 7.0, "delta": 0.0}
+    assert w1["gauges"]["work.depth"] == 2.0
+    h = w1["histograms"]["work.latency"]
+    assert h["n"] == 3 and h["cum_n"] == 3
+    assert h["p50"] <= h["p99"] <= h["max"] == pytest.approx(0.3)
+    # Window 2 saw no new samples: the fork reset, cumulative kept.
+    assert w2["histograms"]["work.latency"]["n"] == 0
+    assert w2["histograms"]["work.latency"]["cum_n"] == 3
+    # Exit ≡ final window (exact, by construction).
+    assert ex["counters"]["work.units"] == w3["counters"]["work.units"]["total"]
+    assert ex["histograms"]["work.latency"]["cum_n"] == 3
+    assert ex["t"] == w3["t1"]
+    assert trace_report._check_metrics_file(path) == []
+
+
+def test_seq_resumes_and_torn_tail_is_skipped(tmp_path):
+    """A relaunched incarnation appends a strictly-monotone stream even when
+    the previous incarnation died mid-write (torn final line)."""
+    reg = obs_metrics.MetricsRegistry()
+    clock = FakeClock()
+    path = str(tmp_path / "_metrics.jsonl")
+    rec = timeseries.TimeseriesRecorder(path, registry=reg, window_s=1.0,
+                                        sample_memory=False, clock=clock)
+    clock.advance(1.0)
+    rec.roll()
+    clock.advance(1.0)
+    rec.roll()
+    rec.stop()
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "window", "seq": 9999, "tr')  # torn tail
+
+    # 2 rolls + stop's final roll + exit = seqs 1..4; the tear adds nothing.
+    assert timeseries._resume_seq(path) == 4
+    good = _read_spool(path)                    # non-strict: skips the tear
+    assert [r["seq"] for r in good] == [1, 2, 3, 4]
+    with pytest.raises(ValueError):
+        list(timeseries.iter_windows(path, strict=True))
+
+    rec2 = timeseries.TimeseriesRecorder(path, registry=reg, window_s=1.0,
+                                         sample_memory=False, clock=clock)
+    clock.advance(1.0)
+    rec2.roll()
+    rec2.stop()
+    seqs = [r["seq"] for r in _read_spool(path)]
+    assert seqs == sorted(seqs) and seqs[-1] > 4
+
+
+def test_metrics_write_fault_drops_window_and_confesses(tmp_path, monkeypatch):
+    """The deliberate ``obs.metrics_write`` fault site: an injected sink
+    fault costs one window (drop-counted), the run survives, and the NEXT
+    window confesses the gap via ``obs.metrics_dropped`` — which is exactly
+    what lets the conservation checker accept the stream."""
+    monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps(
+        {"obs.metrics_write": {"mode": "fail", "kind": "permanent",
+                               "times": 1}}))
+    resilience.set_injector(None)               # rebuild from env
+    clock = FakeClock()
+    path = str(tmp_path / "_metrics.jsonl")
+    # Global registry on purpose: the drop counter lands there.
+    rec = timeseries.TimeseriesRecorder(path, window_s=5.0,
+                                        sample_memory=False, clock=clock)
+    obs_metrics.counter("work.units").inc(2)
+    clock.advance(5.0)
+    assert rec.roll() is not None               # rolled, but the write died
+    assert rec.dropped == 1
+    assert obs_metrics.counter("obs.metrics_dropped").value == 1.0
+    assert _read_spool(path) == []
+    obs_metrics.counter("work.units").inc(5)
+    clock.advance(5.0)
+    rec.roll()
+    rec.stop()
+
+    records = _read_spool(path)
+    assert [r["kind"] for r in records] == ["window", "window", "exit"]
+    first = records[0]
+    # The surviving stream states totals the dropped window never reported
+    # (total 7 with delta 5) AND carries the confession.
+    assert first["counters"]["work.units"]["total"] == 7.0
+    assert first["counters"]["work.units"]["delta"] == 5.0
+    assert first["counters"]["obs.metrics_dropped"]["total"] == 1.0
+    assert trace_report._check_metrics_file(path) == []
+
+
+def test_checker_rejects_seeded_corruption(tmp_path):
+    """Negative control for --check: conservation breaks, seq regressions,
+    and exit/window drift must each be flagged."""
+    reg = obs_metrics.MetricsRegistry()
+    clock = FakeClock()
+    clean = str(tmp_path / "_metrics.jsonl")
+    rec = timeseries.TimeseriesRecorder(clean, registry=reg, window_s=1.0,
+                                        sample_memory=False, clock=clock)
+    reg.counter("c").inc(2)
+    clock.advance(1.0)
+    rec.roll()
+    reg.counter("c").inc(1)
+    clock.advance(1.0)
+    rec.roll()
+    rec.stop()
+    records = _read_spool(clean)
+    assert trace_report._check_metrics_file(clean) == []
+
+    def _variant(name, mutate):
+        out = str(tmp_path / name)
+        lines = [dict(r) for r in records]
+        mutate(lines)
+        with open(out, "w") as f:
+            for r in lines:
+                f.write(json.dumps(r) + "\n")
+        return trace_report._check_metrics_file(out)
+
+    def _break_total(lines):
+        lines[1]["counters"]["c"]["total"] = 99.0
+
+    def _break_seq(lines):
+        lines[1]["seq"] = lines[0]["seq"]
+
+    def _break_exit(lines):
+        lines[-1]["counters"]["c"] = 123.0
+
+    errs = _variant("bad_total.jsonl", _break_total)
+    assert any("conservation" in e for e in errs)
+    errs = _variant("bad_seq.jsonl", _break_seq)
+    assert any("not increasing" in e for e in errs)
+    errs = _variant("bad_exit.jsonl", _break_exit)
+    assert any("exit" in e and "conservation" in e for e in errs)
+
+
+def test_merge_metrics_stamps_workers_and_renumbers(tmp_path):
+    """Fleet merge: per-worker spools concatenate into one checker-clean
+    stream — seq renumbered globally, every record worker-stamped, the
+    per-worker epochs intact."""
+    from taboo_brittleness_tpu.runtime import fleet
+
+    for wid, n in (("w0", 2), ("w1", 3)):
+        reg = obs_metrics.MetricsRegistry()
+        clock = FakeClock()
+        rec = timeseries.TimeseriesRecorder(
+            str(tmp_path / timeseries.metrics_filename(wid)),
+            registry=reg, window_s=1.0, sample_memory=False, clock=clock)
+        for _ in range(n):
+            reg.counter("c").inc()
+            clock.advance(1.0)
+            rec.roll()
+        rec.stop()
+
+    merged = fleet.merge_metrics(str(tmp_path), ["w0", "w1"])
+    # Per worker: n rolls + stop's final roll + one exit record.
+    assert merged == (2 + 2) + (3 + 2)
+    path = str(tmp_path / timeseries.METRICS_FILENAME)
+    records = _read_spool(path)
+    assert len(records) == merged
+    assert [r["seq"] for r in records] == list(range(1, merged + 1))
+    assert {r["worker"] for r in records} == {"w0", "w1"}
+    assert trace_report._check_metrics_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: the step-change regression the windowed view exists for.
+# ---------------------------------------------------------------------------
+
+def test_latency_step_change_moves_windowed_p99_within_two_windows():
+    """Seed a latency step-change: the windowed p99 reaches the regressed
+    value by the second window roll, while the cumulative p99 (the number
+    the heartbeat used to sell as "rolling") does not move at all."""
+    from taboo_brittleness_tpu.serve.scheduler import SlotScheduler
+
+    h = obs_metrics.histogram("serve.latency.chat")
+    for _ in range(512):
+        h.observe(0.08)                         # healthy steady state
+    h.roll_window()                             # window 1 closes
+    for _ in range(4):
+        h.observe(5.0)                          # the regression lands
+    h.roll_window()                             # window 2 closes
+
+    # Through the REAL serve surface (latency_percentiles reads the
+    # registry + the completed-scenario set; no engine needed).
+    sched = SlotScheduler.__new__(SlotScheduler)
+    sched._scenarios_completed = {"chat"}
+    pct = sched.latency_percentiles()
+    cell = pct["scenarios"]["chat"]
+    assert cell["window"]["p99_s"] == pytest.approx(5.0)
+    assert cell["window"]["n"] == 4
+    # 4 slow samples out of 516 sit far above the cumulative p99 rank: the
+    # since-start reservoir arithmetically masks the regression.
+    assert cell["cumulative"]["p99_s"] == pytest.approx(0.08)
+    assert cell["cumulative"]["n"] == 516
+
+
+def test_heartbeat_carries_latency_window_and_slo_block(tmp_path):
+    """The heartbeat contract: ``serving.latency`` keeps its window stamp
+    and the top-level ``slo`` block rides both serving updates and
+    ``set_slo`` (sweep mode)."""
+    rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
+                           interval=3600)
+    block = {"serve_goodput": {"burn": 3.5, "fast": 3.5, "slow": 4.0,
+                               "ok": False}}
+    rep.serving_update(in_flight=1, completed=2,
+                       latency={"window_s": 10.0, "scenarios": {}},
+                       slo=block)
+    snap = rep.snapshot()
+    assert snap["serving"]["latency"]["window_s"] == 10.0
+    assert snap["slo"]["serve_goodput"]["burn"] == 3.5
+    rep.set_slo({"serve_goodput": {"burn": 0.0, "fast": 0.0, "slow": 0.0,
+                                   "ok": True}})
+    assert rep.snapshot()["slo"]["serve_goodput"]["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# SLO burn engine.
+# ---------------------------------------------------------------------------
+
+def _goodput_target(**over):
+    kw = dict(name="serve_goodput", source="ratio", metric="serve.completed",
+              metric_b="serve.admitted", threshold=0.99, op="ge",
+              budget=0.01, fast_windows=1, slow_windows=6)
+    kw.update(over)
+    return obs_slo.SloTarget(**kw)
+
+
+def test_ratio_burn_rises_then_decays():
+    reg = obs_metrics.MetricsRegistry()
+    eng = obs_slo.SloEngine([_goodput_target()], registry=reg,
+                            emit_alerts=False)
+    block = eng.observe_window(
+        dur=10.0, hists={}, gauges={},
+        counter_deltas={"serve.admitted": 100.0, "serve.completed": 90.0})
+    cell = block["serve_goodput"]
+    # One bad window over a 1% budget burns 100x on both spans.
+    assert cell["fast"] == pytest.approx(100.0)
+    assert cell["burn"] == pytest.approx(100.0)
+    assert not cell["ok"]
+    assert reg.gauge("slo.burn.serve_goodput").value == pytest.approx(100.0)
+    # Good windows age the badness out: fast clears immediately, the burn
+    # gauge (min of spans) with it; after slow_windows the slow span is
+    # clean too.
+    for i in range(6):
+        block = eng.observe_window(
+            dur=10.0, hists={}, gauges={},
+            counter_deltas={"serve.admitted": 50.0, "serve.completed": 50.0})
+        assert block["serve_goodput"]["fast"] == 0.0
+        assert block["serve_goodput"]["burn"] == 0.0
+    assert block["serve_goodput"]["slow"] == 0.0
+    assert block["serve_goodput"]["ok"]
+
+
+def test_histogram_target_counts_per_sample_violations():
+    reg = obs_metrics.MetricsRegistry()
+    target = obs_slo.SloTarget(name="serve_latency", source="histogram",
+                               metric="serve.latency.*", threshold=1.0,
+                               op="le", budget=0.05)
+    eng = obs_slo.SloEngine([target], registry=reg, emit_alerts=False)
+    win = {"n": 10, "sum": 8.0, "min": 0.5, "max": 2.0,
+           "samples": [0.5] * 8 + [2.0] * 2, "cum_n": 10}
+    block = eng.observe_window(dur=10.0, hists={"serve.latency.chat": win},
+                               counter_deltas={}, gauges={})
+    # 2/10 samples over threshold against a 5% budget -> 4x burn, fanned
+    # out per scenario (the wildcard tail names the series).
+    assert block["serve_latency.chat"]["burn"] == pytest.approx(4.0)
+    assert reg.gauge("slo.burn.serve_latency.chat").value == pytest.approx(4.0)
+
+
+def test_gauge_target_and_idle_windows():
+    reg = obs_metrics.MetricsRegistry()
+    target = obs_slo.SloTarget(name="hbm_headroom", source="gauge",
+                               metric="mem.hbm.headroom_frac",
+                               threshold=0.05, op="ge", budget=0.01,
+                               slow_windows=3)
+    eng = obs_slo.SloEngine([target], registry=reg, emit_alerts=False)
+    block = eng.observe_window(dur=10.0, hists={}, counter_deltas={},
+                               gauges={"mem.hbm.headroom_frac": 0.01})
+    assert block["hbm_headroom"]["burn"] == pytest.approx(100.0)
+    # Idle windows (gauge gone) still advance the KNOWN series with (0, 0)
+    # so the episode ages out instead of latching forever.
+    for _ in range(3):
+        block = eng.observe_window(dur=10.0, hists={}, counter_deltas={},
+                                   gauges={})
+    assert block["hbm_headroom"]["burn"] == 0.0
+    assert block["hbm_headroom"]["ok"]
+
+
+def test_alert_latches_once_per_episode(monkeypatch):
+    import taboo_brittleness_tpu.obs as obs_pkg
+
+    calls = []
+    monkeypatch.setattr(obs_pkg, "warn",
+                        lambda msg, **kw: calls.append((msg, kw)))
+    reg = obs_metrics.MetricsRegistry()
+    eng = obs_slo.SloEngine([_goodput_target(slow_windows=1)], registry=reg)
+    bad = {"serve.admitted": 10.0, "serve.completed": 5.0}
+    good = {"serve.admitted": 10.0, "serve.completed": 10.0}
+    for _ in range(3):
+        eng.observe_window(dur=10.0, hists={}, gauges={}, counter_deltas=bad)
+    assert len(calls) == 1                      # sustained episode: one alert
+    assert calls[0][1]["name"] == "slo.alert"
+    eng.observe_window(dur=10.0, hists={}, gauges={}, counter_deltas=good)
+    eng.observe_window(dur=10.0, hists={}, gauges={}, counter_deltas=bad)
+    assert len(calls) == 2                      # recovery re-arms the latch
+
+
+def test_load_targets_from_env(monkeypatch, tmp_path):
+    spec = [{"name": "x", "source": "gauge", "metric": "g",
+             "threshold": 1.0, "op": "ge"}]
+    monkeypatch.setenv("TBX_SLO", json.dumps(spec))
+    targets = obs_slo.default_targets()
+    assert [t.name for t in targets] == ["x"]
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(spec))
+    monkeypatch.setenv("TBX_SLO", str(p))
+    assert [t.name for t in obs_slo.default_targets()] == ["x"]
+    with pytest.raises((ValueError, TypeError)):
+        obs_slo.load_targets(json.dumps([{"name": "bad", "source": "nope",
+                                          "metric": "m", "threshold": 1.0}]))
+
+
+def test_recorder_feeds_engine_and_spools_burn(tmp_path):
+    """End-to-end across timeseries+slo: a seeded latency regression rolls
+    into a window record carrying a nonzero burn block, and the burn gauge
+    itself rides the NEXT window (the spool sees its own alarm)."""
+    reg = obs_metrics.MetricsRegistry()
+    target = obs_slo.SloTarget(name="serve_latency", source="histogram",
+                               metric="serve.latency.*", threshold=0.5,
+                               op="le", budget=0.05)
+    eng = obs_slo.SloEngine([target], registry=reg, emit_alerts=False)
+    clock = FakeClock()
+    seen = []
+    rec = timeseries.TimeseriesRecorder(
+        str(tmp_path / "_metrics.jsonl"), registry=reg, window_s=1.0,
+        slo_engine=eng, on_window=seen.append, sample_memory=False,
+        clock=clock)
+    for _ in range(10):
+        reg.histogram("serve.latency.chat").observe(5.0)   # all bad
+    clock.advance(1.0)
+    rec.roll()
+    clock.advance(1.0)
+    rec.roll()
+    rec.stop()
+
+    assert seen[0]["slo"]["serve_latency.chat"]["burn"] == pytest.approx(20.0)
+    assert not seen[0]["slo"]["serve_latency.chat"]["ok"]
+    assert rec.last_slo() is not None
+    gauges = seen[1]["gauges"]
+    assert gauges["slo.burn.serve_latency.chat"] == pytest.approx(20.0)
+    assert trace_report._check_metrics_file(
+        str(tmp_path / "_metrics.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+def test_flightrec_ring_bounds_and_atomic_dump(tmp_path):
+    fr = flightrec.FlightRecorder(capacity=4)
+    assert fr.dump("early") is None             # unconfigured: no-op
+    fr.configure(str(tmp_path))
+    for i in range(7):
+        fr.record("step", i=i)
+    path = fr.dump("test", word="ship")
+    assert path == str(tmp_path / "_flightrec.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["v"] == flightrec.SCHEMA_VERSION
+    assert data["reason"] == "test" and data["capacity"] == 4
+    assert [r["i"] for r in data["ring"]] == [3, 4, 5, 6]   # bounded: last 4
+    assert all("t" in r and r["kind"] == "step" for r in data["ring"])
+    assert data["context"] == {"word": "ship"}
+    assert trace_report.check_flightrec(
+        str(tmp_path / "_events.jsonl")) == []
+    # capacity=0 disables recording wholesale.
+    off = flightrec.FlightRecorder(capacity=0)
+    off.configure(str(tmp_path))
+    off.record("step")
+    assert off.snapshot() == [] and off.dump("test") is None
+
+
+def test_quarantine_dump_freezes_the_ring(tmp_path):
+    """The resilience quarantine path (the trigger the fleet fixture uses):
+    run_guarded's final failure dumps the ring with the word's attempt and
+    quarantine records in it."""
+    flightrec.configure(str(tmp_path))
+    flightrec.record("word.step", word="ship", step=7)
+
+    def _boom():
+        raise TimeoutError("injected")          # transient: retried first
+
+    out = resilience.run_guarded(
+        "ship", _boom,
+        policy=resilience.RetryPolicy(max_retries=1, base_delay=0.0,
+                                      jitter=0.0))
+    assert not out.ok
+    with open(tmp_path / "_flightrec.json") as f:
+        data = json.load(f)
+    assert data["reason"] == "quarantine"
+    kinds = [r["kind"] for r in data["ring"]]
+    assert kinds[0] == "word.step"
+    assert "word.attempt" in kinds and "word.retry" in kinds
+    assert kinds[-1] == "word.quarantine"
+    assert data["ring"][-1]["word"] == "ship"
+
+
+def test_sigterm_drain_dumps_flightrec(tmp_path):
+    """The signal trigger, end to end in a real subprocess: SIGTERM (what
+    the supervisor sends before any wedge-kill escalates to SIGKILL) lands
+    in DrainController._handle, which freezes the ring from signal context
+    without touching any lock."""
+    child = (
+        "import os, sys, time\n"
+        "from taboo_brittleness_tpu.obs import flightrec\n"
+        "from taboo_brittleness_tpu.runtime import supervise\n"
+        "flightrec.configure(sys.argv[1])\n"
+        "flightrec.record('serve.step', in_flight=2, requests=['a', 'b'])\n"
+        "supervise.install_drain_handlers()\n"
+        "print('ready', flush=True)\n"
+        "t0 = time.monotonic()\n"
+        "while not supervise.drain_requested():\n"
+        "    if time.monotonic() - t0 > 30: sys.exit(3)\n"
+        "    time.sleep(0.05)\n"
+        "sys.exit(0)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", child, str(tmp_path)],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        proc.kill()
+    with open(tmp_path / "_flightrec.json") as f:
+        data = json.load(f)
+    assert data["reason"] == f"signal:{signal.SIGTERM}"
+    assert data["ring"][0]["kind"] == "serve.step"
+    assert data["ring"][0]["requests"] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# tbx top.
+# ---------------------------------------------------------------------------
+
+def test_top_renders_committed_fleet_fixture(capsys):
+    """The committed chaos fixture (3 workers, one killed, one quarantine
+    dump) must collect and render: worker lanes, spool windows, flightrec."""
+    state = top.collect(FLEET_FIXTURE)
+    lanes = {ln["lane"] for ln in state["lanes"]}
+    assert {"main", "w0", "w1", "w2"} <= lanes
+    assert state["n_windows"] > 0 and state["latest"] is not None
+    assert state["flightrec"] and state["flightrec"][0]["reason"]
+    out = top.render(state)
+    assert "lanes:" in out and "spool:" in out and "flightrec:" in out
+    for wid in ("w0", "w1", "w2"):
+        assert wid in out
+    assert top.main(["--dir", FLEET_FIXTURE, "--once"]) == 0
+    assert top.main_selfcheck(FLEET_FIXTURE) == 0
+    capsys.readouterr()
+
+
+def test_top_shows_seeded_slo_burn(tmp_path):
+    """Acceptance (c): a seeded latency regression produces a NONZERO
+    slo.burn in the rendered frame, flagged as alerting."""
+    reg = obs_metrics.MetricsRegistry()
+    target = obs_slo.SloTarget(name="serve_latency", source="histogram",
+                               metric="serve.latency.*", threshold=0.5,
+                               op="le", budget=0.05)
+    eng = obs_slo.SloEngine([target], registry=reg, emit_alerts=False)
+    clock = FakeClock()
+    rec = timeseries.TimeseriesRecorder(
+        str(tmp_path / "_metrics.jsonl"), registry=reg, window_s=1.0,
+        slo_engine=eng, sample_memory=False, clock=clock)
+    for _ in range(10):
+        reg.histogram("serve.latency.chat").observe(5.0)
+    clock.advance(1.0)
+    rec.roll()
+    # Keep the regression hot through stop()'s final roll so the LATEST
+    # window (the one top renders) still burns.
+    for _ in range(10):
+        reg.histogram("serve.latency.chat").observe(5.0)
+    clock.advance(1.0)
+    rec.stop()
+    rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
+                           interval=3600)
+    rep.serving_update(in_flight=1, completed=9)
+    rep.write_now()
+
+    state = top.collect(str(tmp_path))
+    assert state["latest"]["slo"]["serve_latency.chat"]["burn"] > 0
+    out = top.render(state)
+    assert "serve_latency.chat" in out
+    assert "ALERT" in out
+
+
+def test_top_tolerates_torn_spool_tail(tmp_path):
+    reg = obs_metrics.MetricsRegistry()
+    clock = FakeClock()
+    rec = timeseries.TimeseriesRecorder(
+        str(tmp_path / "_metrics.jsonl"), registry=reg, window_s=1.0,
+        sample_memory=False, clock=clock)
+    reg.counter("c").inc()
+    clock.advance(1.0)
+    rec.roll()
+    rec.stop()
+    with open(tmp_path / "_metrics.jsonl", "a") as f:
+        f.write('{"kind": "window", "seq": 99, "tor')
+    state = top.collect(str(tmp_path))
+    # roll + stop's final roll = 2 windows; the tear is skipped, not fatal.
+    assert state["n_windows"] == 2
+    assert top.render(state)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 6: telemetry must not grow the jit surface or the baseline.
+# ---------------------------------------------------------------------------
+
+def test_entry_points_and_baseline_unchanged():
+    from taboo_brittleness_tpu.analysis import deep
+
+    assert sorted(name for name, _ in deep.ENTRY_POINTS) == [
+        "grid.runner._cell_readout",
+        "ops.lens.aggregate_from_residual",
+        "ops.sae.latent_secret_correlation_stream",
+        "pipelines.interventions._nll_cached_jit",
+        "pipelines.interventions._residual_measure",
+        "runtime.decode.greedy_decode",
+        "runtime.decode.greedy_decode[multi_tap]",
+        "runtime.delta.apply_delta",
+        "runtime.fused.fused_study",
+        "runtime.speculate.draft_step",
+        "runtime.speculate.verify_block",
+        "serve.engine.serve_step",
+        "serve.engine.serve_step_multi",
+        "serve.spec_engine.serve_spec_draft",
+        "serve.spec_engine.serve_spec_verify",
+    ]
+    with open(os.path.join(_REPO, "tools", "tbx_baseline.json")) as f:
+        baseline = json.load(f)
+    assert baseline["version"] == 1
+    assert len(baseline["findings"]) == 13

@@ -273,6 +273,41 @@ def test_scheduler_quarantines_poisoned_session_not_batch(tiny):
     assert sched.quarantined == 1 and sched.completed == 2
 
 
+def test_serve_quarantine_dumps_flightrec(tiny, tmp_path):
+    """ISSUE 15 satellite: an injected ``serve.step`` quarantine freezes the
+    flight-recorder ring to ``_flightrec.json`` — and the poisoned step is
+    IN the frozen ring (recorded before the fault site fires)."""
+    from taboo_brittleness_tpu.obs import flightrec
+
+    flightrec.reset()
+    flightrec.configure(str(tmp_path))
+    try:
+        inj = FaultInjector()
+        inj.arm("serve.step", mode="fail", kind="permanent", times=1,
+                match="poison")
+        resilience.set_injector(inj)
+        engine = make_engine(tiny, slots=2, stop_ids=(-1,))
+        sc = Scenario(name="chat", max_new_tokens=4)
+        sched = SlotScheduler(engine, queue_limit=4)
+        sched.submit(Request(id="poison-1", prompt="Give me a hint",
+                             scenario=sc))
+        sched.submit(Request(id="ok-1", prompt="Give me a hint", scenario=sc))
+        resps = {r.id: r for r in sched.run_until_idle()}
+        assert not resps["poison-1"].ok and resps["ok-1"].ok
+
+        path = os.path.join(str(tmp_path), "_flightrec.json")
+        assert os.path.exists(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert data["reason"] == "serve.quarantine"
+        assert data["context"]["request"] == "poison-1"
+        steps = [r for r in data["ring"] if r["kind"] == "serve.step"]
+        assert steps and any("poison-1" in r["requests"] for r in steps)
+        assert data["ring"][-1]["kind"] == "serve.quarantine"
+    finally:
+        flightrec.reset()
+
+
 def test_scheduler_fault_plan_via_env(tiny, monkeypatch):
     """The operator path: TABOO_FAULT_PLAN arms the serve.step site."""
     monkeypatch.setenv("TABOO_FAULT_PLAN", json.dumps(
@@ -311,9 +346,10 @@ def test_progress_serving_snapshot_fields(tmp_path):
 
 
 def test_live_latency_percentiles_in_progress(tiny, tmp_path):
-    """ISSUE 7 satellite: rolling per-scenario latency percentiles ride the
-    serving heartbeat (``serving.latency``) so operators and ``tbx
-    supervise`` see SLO burn LIVE, not only in the exit-time _serve.json."""
+    """ISSUE 7/15 satellites: per-scenario latency percentiles ride the
+    serving heartbeat (``serving.latency``) with the WINDOWED view primary
+    and the cumulative view labeled as such, stamped with ``window_s`` and
+    per-view sample counts."""
     from taboo_brittleness_tpu.obs import metrics as obs_metrics
 
     obs_metrics.reset()        # per-scenario histograms are process-wide
@@ -328,25 +364,34 @@ def test_live_latency_percentiles_in_progress(tiny, tmp_path):
     sched.run_until_idle()
 
     pct = sched.latency_percentiles()
-    assert set(pct) == {"chat", "chat_lens"}
-    assert pct["chat"]["n"] == 3 and pct["chat_lens"]["n"] == 1
-    for cell in pct.values():
-        assert cell["p50_s"] >= 0.0
-        assert cell["p99_s"] >= cell["p50_s"]
-        assert cell["max_s"] >= cell["p99_s"]
+    assert pct["window_s"] > 0
+    scen = pct["scenarios"]
+    assert set(scen) == {"chat", "chat_lens"}
+    assert scen["chat"]["cumulative"]["n"] == 3
+    assert scen["chat_lens"]["cumulative"]["n"] == 1
+    # No window has rolled yet, so the window view covers everything so far.
+    assert scen["chat"]["window"]["n"] == 3
+    for cell in scen.values():
+        for view in ("window", "cumulative"):
+            assert cell[view]["p50_s"] >= 0.0
+            assert cell[view]["p99_s"] >= cell[view]["p50_s"]
+            assert cell[view]["max_s"] >= cell[view]["p99_s"]
 
     rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
                            interval=3600)
     rep.serving_update(in_flight=0, completed=4, latency=pct)
     rep.write_now()
     on_disk = read_progress(rep.path)
-    assert on_disk["serving"]["latency"]["chat"]["n"] == 3
-    assert on_disk["serving"]["latency"]["chat_lens"]["p99_s"] >= 0.0
+    disk_lat = on_disk["serving"]["latency"]
+    assert disk_lat["window_s"] == pct["window_s"]
+    assert disk_lat["scenarios"]["chat"]["cumulative"]["n"] == 3
+    assert disk_lat["scenarios"]["chat_lens"]["window"]["p99_s"] >= 0.0
     # The last known percentiles persist across latency-less heartbeats
     # (the serve loop only recomputes them when requests resolve).
     rep.serving_update(in_flight=0, completed=5)
     snap = rep.snapshot()
-    assert snap["serving"]["latency"]["chat"]["p50_s"] == pct["chat"]["p50_s"]
+    assert (snap["serving"]["latency"]["scenarios"]["chat"]["window"]["p50_s"]
+            == scen["chat"]["window"]["p50_s"])
     assert snap["serving"]["completed_requests"] == 5
 
 

@@ -94,6 +94,9 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
 #: round only — the contract is a budget, not a trend.
 ABSOLUTE_BUDGETS: Dict[str, float] = {
     "obs_overhead_pct": 2.0,                     # the obs <2% wall contract
+    # Same contract with the LIVE sampler armed (ISSUE 15): windowed
+    # metrics spool + SLO burn engine + flight recorder at a 0.5 s window.
+    "obs_live.overhead_pct": 2.0,
 }
 
 

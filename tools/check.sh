@@ -32,6 +32,9 @@ python tools/trace_report.py tests/fixtures/obs/device/_events.jsonl \
 echo "== trace_report fleet gate (committed multi-worker fixture)"
 python tools/trace_report.py --check tests/fixtures/obs/fleet/_events.jsonl
 
+echo "== tbx top selfcheck (render the committed fleet fixture)"
+JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu top --once --selfcheck
+
 echo "== serve loadgen selfcheck (CPU smoke: tiny model, 32 requests)"
 JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
 

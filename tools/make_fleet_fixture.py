@@ -5,10 +5,18 @@
 Runs a REAL chaos fleet — tiny model, 3 subprocess workers, worker ``w1``
 killed by a ``die`` fault at its first commit (``runtime.fleet.selfcheck``,
 the same scenario ``tbx fleet --selfcheck`` gates) — then copies the merged
-``_events.jsonl``, the per-worker ``_events.<wid>.jsonl`` streams, and the
-merged ``_failures.json`` into the fixture directory.  The committed files
-are what ``trace_report --check`` holds the fleet schema to (tools/check.sh),
-so the fleet event vocabulary and merge invariants cannot drift silently.
+``_events.jsonl``, the per-worker ``_events.<wid>.jsonl`` streams, the
+merged ``_failures.json``, the windowed metrics spool (``_metrics*.jsonl``),
+the per-worker progress heartbeats (``_progress*.json``), and the crash
+flight-recorder dump (``_flightrec*.json``) into the fixture directory.
+The fleet's ``die`` fault is deliberately dump-free (``os._exit``, the
+SIGKILL-equivalent the crash-consistency tests depend on), so the flight
+recorder is exercised here through its other real trigger: a quarantined
+word (``resilience.run_guarded`` with an exhausted retry policy) freezes
+the ring to ``_flightrec.json``.  The committed files are what
+``trace_report --check`` and ``tbx top --once --selfcheck`` hold the fleet
+schema to (tools/check.sh), so the fleet event vocabulary, the metrics
+conservation invariants, and the merge rules cannot drift silently.
 
     JAX_PLATFORMS=cpu python tools/make_fleet_fixture.py
 """
@@ -37,14 +45,39 @@ def main() -> int:
     print(f"fleet run: {res.status}, {res.committed} committed, "
           f"{res.reissued} re-issued, {res.lease_expiries} lease expirie(s)")
 
+    # The die fault is os._exit — no dump — so exercise the flight
+    # recorder's quarantine trigger for real: an exhausted retry policy
+    # freezes the ring to <out>/_flightrec.json via run_guarded.
+    from taboo_brittleness_tpu.obs import flightrec
+    from taboo_brittleness_tpu.runtime import resilience
+
+    flightrec.reset()
+    flightrec.configure(out)
+    flightrec.record("fleet.fixture", units=res.units_total,
+                     committed=res.committed, reissued=res.reissued)
+
+    def _boom() -> None:
+        raise RuntimeError("fixture: injected failure to freeze the ring")
+
+    outcome = resilience.run_guarded(
+        "fixture-word", _boom,
+        policy=resilience.RetryPolicy(max_retries=0, base_delay=0.0))
+    assert not outcome.ok, "injected failure unexpectedly succeeded"
+    assert os.path.exists(os.path.join(out, "_flightrec.json")), (
+        "quarantine did not dump the flight recorder")
+
     os.makedirs(FIXTURE_DIR, exist_ok=True)
-    for old in glob.glob(os.path.join(FIXTURE_DIR, "_events*.jsonl")):
-        os.unlink(old)
+    for pat in ("_events*.jsonl", "_metrics*.jsonl", "_progress*.json",
+                "_flightrec*.json"):
+        for old in glob.glob(os.path.join(FIXTURE_DIR, pat)):
+            os.unlink(old)
     copied = []
-    for src in sorted(glob.glob(os.path.join(out, "_events*.jsonl"))):
-        dst = os.path.join(FIXTURE_DIR, os.path.basename(src))
-        shutil.copyfile(src, dst)
-        copied.append(dst)
+    for pat in ("_events*.jsonl", "_metrics*.jsonl", "_progress*.json",
+                "_flightrec*.json"):
+        for src in sorted(glob.glob(os.path.join(out, pat))):
+            dst = os.path.join(FIXTURE_DIR, os.path.basename(src))
+            shutil.copyfile(src, dst)
+            copied.append(dst)
     ledger = os.path.join(out, "_failures.json")
     if os.path.exists(ledger):
         shutil.copyfile(ledger, os.path.join(FIXTURE_DIR, "_failures.json"))
@@ -61,6 +94,13 @@ def main() -> int:
     if rc != 0:
         print("make_fleet_fixture: regenerated fixture FAILS trace_report "
               "--check", file=sys.stderr)
+        return rc
+    from taboo_brittleness_tpu.obs import top
+
+    rc = top.main_selfcheck(FIXTURE_DIR)
+    if rc != 0:
+        print("make_fleet_fixture: regenerated fixture FAILS tbx top "
+              "--selfcheck", file=sys.stderr)
         return rc
     shutil.rmtree(out, ignore_errors=True)
     return 0

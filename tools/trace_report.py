@@ -805,6 +805,224 @@ def check_serve_spec(path: str, events: List[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def check_timeseries(events_path: str) -> List[str]:
+    """Windowed-metrics-spool invariants for ``--check`` (empty = clean;
+    no-op when no ``_metrics*.jsonl`` sits next to the events file).  Every
+    sibling spool (merged and per-worker) is held to:
+
+    - strict JSONL with known schema version and per-file strictly
+      monotone ``seq`` (the merge renumbers; per-worker files own theirs);
+    - per (worker, pid) epoch: window ``t0`` monotone, ``t1 >= t0``,
+      counter deltas >= 0, totals non-decreasing, and CONSERVATION —
+      ``total_i == total_{i-1} + delta_i`` exactly, except across a
+      dropped window, which the stream itself must confess via an
+      increased ``obs.metrics_dropped`` total;
+    - histogram windows: ``n <= cum_n``, ``cum_n`` non-decreasing,
+      ``p50 <= p99 <= max`` whenever the window saw samples;
+    - the ``exit`` record equals the epoch's final window snapshot
+      (counter totals and histogram ``cum_n``) — exact by construction
+      (``obs.timeseries``), so drift here means a writer bug.
+    """
+    import glob as _glob
+
+    d = os.path.dirname(os.path.abspath(events_path))
+    errors: List[str] = []
+    for path in sorted(_glob.glob(os.path.join(d, "_metrics*.jsonl"))):
+        errors += _check_metrics_file(path)
+    return errors
+
+
+def _check_metrics_file(path: str) -> List[str]:
+    from taboo_brittleness_tpu.obs import timeseries
+
+    errors: List[str] = []
+    last_seq = 0
+    # (worker, pid) → epoch state; an exit record closes the epoch so a
+    # later recorder in the same process starts fresh.
+    epochs: Dict[Tuple[Any, Any], Dict[str, Any]] = {}
+    try:
+        records = list(timeseries.iter_windows(path, strict=True))
+    except ValueError as e:
+        return [str(e)]
+    if not records:
+        return [f"{path}: no records"]
+    for i, rec in enumerate(records, start=1):
+        where = f"{path}:{i}"
+        for key in ("v", "kind", "seq", "pid", "wall"):
+            if key not in rec:
+                errors.append(f"{where}: missing required key {key!r}")
+        if rec.get("v", 0) > timeseries.SCHEMA_VERSION:
+            errors.append(
+                f"{where}: schema version {rec.get('v')} is newer than "
+                f"this reader ({timeseries.SCHEMA_VERSION})")
+        seq = rec.get("seq", 0)
+        if seq <= last_seq:
+            errors.append(
+                f"{where}: seq {seq} not increasing (prev {last_seq})")
+        last_seq = seq
+        key = (rec.get("worker"), rec.get("pid"))
+        epoch = epochs.setdefault(key, {"t0": None, "counters": {},
+                                        "cum_n": {}, "last": None})
+        kind = rec.get("kind")
+        if kind == "window":
+            errors += _check_window_record(where, rec, epoch)
+        elif kind == "exit":
+            errors += _check_exit_record(where, rec, epoch)
+            epochs.pop(key, None)
+        else:
+            errors.append(f"{where}: unknown record kind {kind!r}")
+    return errors
+
+
+def _check_window_record(where: str, rec: Dict[str, Any],
+                         epoch: Dict[str, Any]) -> List[str]:
+    errors: List[str] = []
+    t0, t1 = rec.get("t0"), rec.get("t1")
+    if not isinstance(t0, (int, float)) or not isinstance(t1, (int, float)):
+        return [f"{where}: window record missing numeric t0/t1"]
+    if t1 < t0:
+        errors.append(f"{where}: window t1 {t1} precedes t0 {t0}")
+    if epoch["t0"] is not None and t0 < epoch["t0"] - 1e-9:
+        errors.append(f"{where}: window t0 {t0} precedes the epoch's "
+                      f"previous window ({epoch['t0']})")
+    epoch["t0"] = t0
+    counters = rec.get("counters")
+    if not isinstance(counters, dict):
+        errors.append(f"{where}: window record missing counters dict")
+        counters = {}
+    prev = epoch["counters"]
+    prev_dropped = prev.get("obs.metrics_dropped", 0.0)
+    now_dropped = (counters.get("obs.metrics_dropped") or {}).get(
+        "total", prev_dropped)
+    confessed_drop = now_dropped > prev_dropped + 1e-9
+    for name, cell in sorted(counters.items()):
+        total = cell.get("total")
+        delta = cell.get("delta")
+        if not isinstance(total, (int, float)) or not isinstance(
+                delta, (int, float)):
+            errors.append(f"{where}: counter {name} missing total/delta")
+            continue
+        if delta < -1e-9:
+            errors.append(f"{where}: counter {name} delta {delta} < 0")
+        p = prev.get(name, 0.0)
+        if total < p - 1e-9:
+            errors.append(
+                f"{where}: counter {name} total {total} decreased "
+                f"(prev {p})")
+        elif abs(total - (p + delta)) > 1e-6 and not confessed_drop:
+            errors.append(
+                f"{where}: counter {name} conservation violated: total "
+                f"{total} != prev {p} + delta {delta} (and no dropped "
+                "window confessed via obs.metrics_dropped)")
+        prev[name] = float(total)
+    for name, h in sorted((rec.get("histograms") or {}).items()):
+        n, cum_n = h.get("n"), h.get("cum_n")
+        if not isinstance(n, int) or not isinstance(cum_n, int):
+            errors.append(f"{where}: histogram {name} missing n/cum_n")
+            continue
+        if n > cum_n:
+            errors.append(
+                f"{where}: histogram {name} window n {n} exceeds "
+                f"cumulative {cum_n}")
+        pc = epoch["cum_n"].get(name, 0)
+        if cum_n < pc:
+            errors.append(
+                f"{where}: histogram {name} cum_n {cum_n} decreased "
+                f"(prev {pc})")
+        epoch["cum_n"][name] = cum_n
+        if n > 0:
+            p50, p99, mx = h.get("p50"), h.get("p99"), h.get("max")
+            if (isinstance(p50, (int, float))
+                    and isinstance(p99, (int, float))
+                    and isinstance(mx, (int, float))
+                    and not p50 <= p99 + 1e-9 <= mx + 2e-9):
+                errors.append(
+                    f"{where}: histogram {name} quantiles disordered "
+                    f"(p50 {p50}, p99 {p99}, max {mx})")
+    epoch["last"] = rec
+    return errors
+
+
+def _check_exit_record(where: str, rec: Dict[str, Any],
+                       epoch: Dict[str, Any]) -> List[str]:
+    errors: List[str] = []
+    last = epoch.get("last")
+    if last is None:
+        # An exit with no window in this epoch (every stop() rolls a final
+        # window first, so only a dropped final window explains this; the
+        # drop then can't be confessed — flag it).
+        return [f"{where}: exit record with no preceding window in its "
+                "(worker, pid) epoch"]
+    last_counters = last.get("counters") or {}
+    for name, total in sorted((rec.get("counters") or {}).items()):
+        prev = (last_counters.get(name) or {}).get("total")
+        if prev is None:
+            errors.append(
+                f"{where}: exit counter {name} absent from the final "
+                "window")
+        elif (isinstance(total, (int, float))
+                and abs(total - prev) > 1e-9):
+            errors.append(
+                f"{where}: exit counter {name} total {total} != final "
+                f"window total {prev} — exit/window conservation violated")
+    last_hists = last.get("histograms") or {}
+    for name, h in sorted((rec.get("histograms") or {}).items()):
+        prev = (last_hists.get(name) or {}).get("cum_n")
+        cum_n = h.get("cum_n") if isinstance(h, dict) else None
+        if prev is not None and cum_n is not None and cum_n != prev:
+            errors.append(
+                f"{where}: exit histogram {name} cum_n {cum_n} != final "
+                f"window cum_n {prev}")
+    return errors
+
+
+def check_flightrec(events_path: str) -> List[str]:
+    """Flight-recorder dump invariants for ``--check`` (empty = clean;
+    no-op without ``_flightrec*.json`` siblings): parseable JSON with the
+    known schema version, a stated dump reason, and a bounded ring
+    (``len(ring) <= capacity``) of records each carrying a relative
+    timestamp and a kind."""
+    from taboo_brittleness_tpu.obs import flightrec as flightrec_mod
+
+    import glob as _glob
+
+    d = os.path.dirname(os.path.abspath(events_path))
+    errors: List[str] = []
+    for path in sorted(_glob.glob(os.path.join(d, "_flightrec*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable flight-recorder dump ({e})")
+            continue
+        if not isinstance(data, dict):
+            errors.append(f"{path}: dump is not a JSON object")
+            continue
+        if data.get("v", 0) > flightrec_mod.SCHEMA_VERSION:
+            errors.append(
+                f"{path}: schema version {data.get('v')} is newer than "
+                f"this reader ({flightrec_mod.SCHEMA_VERSION})")
+        if not data.get("reason"):
+            errors.append(f"{path}: dump carries no reason")
+        ring = data.get("ring")
+        capacity = data.get("capacity")
+        if not isinstance(ring, list):
+            errors.append(f"{path}: dump carries no ring list")
+            continue
+        if isinstance(capacity, int) and len(ring) > capacity:
+            errors.append(
+                f"{path}: ring holds {len(ring)} records, over its "
+                f"declared capacity {capacity}")
+        for i, cell in enumerate(ring):
+            if (not isinstance(cell, dict)
+                    or not isinstance(cell.get("t"), (int, float))
+                    or not cell.get("kind")):
+                errors.append(
+                    f"{path}: ring[{i}] missing t/kind")
+                break
+    return errors
+
+
 def report(events: List[Dict[str, Any]], *,
            roofline: Optional[Dict[str, Any]] = None,
            device_profile: Optional[Dict[str, Any]] = None) -> str:
@@ -1080,6 +1298,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # verify-block span must resolve to an accept record.
         errors += check_serve_spec(args.events,
                                    list(iter_events(args.events)))
+        # Windowed-metrics + flight-recorder invariants (obs.timeseries /
+        # obs.flightrec): no-ops when no sibling artifacts exist.
+        errors += check_timeseries(args.events)
+        errors += check_flightrec(args.events)
         if device_path is not None:
             errors += check_device(device_path,
                                    list(iter_events(args.events)))
