@@ -67,6 +67,7 @@ def iter_python_files(paths: Sequence[str],
 
 
 def run_check(paths: Sequence[str], *, deep: bool = False,
+              conc: bool = True,
               baseline: Optional[str] = None,
               default_excludes: bool = True,
               rules=None) -> Report:
@@ -77,6 +78,12 @@ def run_check(paths: Sequence[str], *, deep: bool = False,
     suppressed: List[Finding] = []
     for f in files:
         a, s = analyze_file(f, rel=_norm(f), rules=rules, repo=repo)
+        active.extend(a)
+        suppressed.extend(s)
+    if conc:
+        from taboo_brittleness_tpu.analysis.conc import run_conc
+
+        a, s = run_conc(files)
         active.extend(a)
         suppressed.extend(s)
     if deep:
@@ -96,13 +103,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m taboo_brittleness_tpu.analysis",
         description="tbx-check: JAX/TPU-aware static analysis gate "
-                    "(rules TBX001..TBX008; --deep adds the jaxpr pass).")
+                    "(rules TBX001..TBX010 plus the whole-program "
+                    "host-concurrency pass TBX201..TBX206; --deep adds "
+                    "the jaxpr pass).")
     ap.add_argument("paths", nargs="*", default=["taboo_brittleness_tpu"],
                     help="files or directories (default: the package)")
     ap.add_argument("--deep", action="store_true",
                     help="also trace the registered jit entry points and "
                          "audit their jaxprs for vocab-dim f32 "
                          "materialization (imports jax)")
+    ap.add_argument("--conc", dest="conc", action="store_true", default=True,
+                    help="run the whole-program host-concurrency pass "
+                         "(TBX201..TBX206); on by default")
+    ap.add_argument("--no-conc", dest="conc", action="store_false",
+                    help="skip the concurrency pass (static AST rules only)")
     ap.add_argument("--baseline", metavar="FILE",
                     help="filter findings already recorded in FILE")
     ap.add_argument("--write-baseline", metavar="FILE",
@@ -117,16 +131,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        from taboo_brittleness_tpu.analysis.conc import CONC_RULES
+
         for rule in RULES:
-            print(f"{rule.code}  {rule.alias:<12} {rule.summary}")
-        print("TBX100  deep-entry   [--deep] entry point failed to trace")
-        print("TBX101  deep-f32     [--deep] jaxpr f32 materialization on a "
-              "vocab-dim operand")
+            print(f"{rule.code}  {rule.alias:<14} {rule.summary}")
+        for rule in CONC_RULES:
+            print(f"{rule.code}  {rule.alias:<14} [--conc] {rule.summary}")
+        print("TBX100  deep-entry     [--deep] entry point failed to trace")
+        print("TBX101  deep-f32       [--deep] jaxpr f32 materialization on "
+              "a vocab-dim operand")
         return 0
 
     try:
         report = run_check(
-            args.paths, deep=args.deep, baseline=args.baseline,
+            args.paths, deep=args.deep, conc=args.conc,
+            baseline=args.baseline,
             default_excludes=not args.no_default_excludes)
     except (FileNotFoundError, ValueError) as e:
         print(f"tbx-check: error: {e}", file=sys.stderr)

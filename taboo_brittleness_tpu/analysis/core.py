@@ -24,6 +24,10 @@ class Finding:
     alias: str       # "host-sync" — usable in pragmas interchangeably with code
     message: str
     snippet: str = ""  # stripped source line: the line-number-free fingerprint basis
+    scope: str = ""    # module-relative qualname of the enclosing def/class
+    #                    ("TimeseriesRecorder.stop"); "" at module level.  The
+    #                    path-free half of the baseline fingerprint, so a pure
+    #                    file move does not churn the ratchet.
 
     def format(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.code} [{self.alias}] {self.message}"
@@ -153,6 +157,8 @@ class ModuleContext:
         self.parents: Dict[ast.AST, Optional[ast.FunctionDef]] = {}
         self.module_funcs: Dict[str, ast.FunctionDef] = {}
         self._index_functions()
+        self._scopes: List[Tuple[int, int, str]] = []
+        self._index_scopes()
 
         self.jit_bindings: List[JitBinding] = []
         self._collect_jit_bindings()
@@ -174,6 +180,33 @@ class ModuleContext:
 
         visit(self.tree, None)
 
+    def _index_scopes(self) -> None:
+        """Source spans of every def/class, with module-relative qualnames
+        (``Cls.method``, ``outer.<locals>-free: just dotted names).  Used to
+        stamp findings with a path-free anchor for baseline fingerprints."""
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno)
+                    self._scopes.append((child.lineno, end, qual))
+                    visit(child, qual)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def scope_of(self, lineno: int) -> str:
+        """Qualname of the innermost def/class containing ``lineno`` ("" at
+        module level)."""
+        best = ""
+        best_start = 0
+        for start, end, qual in self._scopes:
+            if start <= lineno <= end and start >= best_start:
+                best, best_start = qual, start
+        return best
+
     def dotted(self, node: ast.AST) -> Optional[str]:
         return dotted(node, self.aliases)
 
@@ -187,7 +220,7 @@ class ModuleContext:
         return Finding(path=self.rel, line=line,
                        col=getattr(node, "col_offset", 0) + 1,
                        code=code, alias=alias, message=message,
-                       snippet=self.line_text(line))
+                       snippet=self.line_text(line), scope=self.scope_of(line))
 
     # -- jit bindings ------------------------------------------------------
 

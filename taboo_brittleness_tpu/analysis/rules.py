@@ -266,7 +266,8 @@ class MissingDonationRule:
                          "donate_argnames — the caller's buffer and the "
                          "program's copy coexist in HBM; donate it (or "
                          "pragma with the reason it must stay live)"),
-                snippet=ctx.line_text(getattr(anchor, "lineno", b.line)))
+                snippet=ctx.line_text(getattr(anchor, "lineno", b.line)),
+                scope=ctx.scope_of(b.line))
 
 
 # ---------------------------------------------------------------------------

@@ -1329,10 +1329,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="Profile the sweep's compiled programs on the current "
                     "backend (obs/profile.py). Default: capture ONE "
                     "annotated launch of --phase under the XLA profiler and "
-                    "rank its ops by device time (the old "
-                    "tools/profile_sweep.py flow). --study-host instead "
-                    "runs real study words under nested host stage timers "
-                    "(the old tools/profile_study_host.py flow). For a "
+                    "rank its ops by device time. --study-host instead "
+                    "runs real study words under nested host stage timers. "
+                    "For a "
                     "whole-sweep device profile, run any sweep subcommand "
                     "with --profile and render _device_profile.json via "
                     "tools/trace_report.py --device.")

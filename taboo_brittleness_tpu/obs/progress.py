@@ -247,12 +247,16 @@ class ProgressReporter:
     def write_now(self) -> None:
         try:
             atomic_json_dump(self.snapshot(), self.path)
-            self._last_write = self._clock()
+            # Both the heartbeat thread and the main-side setters land here;
+            # the throttle mark has to be read/written under the lock.
+            with self._lock:
+                self._last_write = self._clock()
         except Exception:  # noqa: BLE001 — progress must never kill the sweep
             pass
 
     def _write_throttled(self) -> None:
-        last = self._last_write
+        with self._lock:
+            last = self._last_write
         if last is None or self._clock() - last >= self.min_write_interval:
             self.write_now()
 

@@ -1808,8 +1808,8 @@ def _atomic_json_dump(obj: Any, path: str) -> None:
 
     Thin module-level wrapper over the shared
     :func:`~taboo_brittleness_tpu.runtime.resilience.atomic_json_dump` —
-    kept as a *name* here because the host profiler
-    (tools/profile_study_host.py) wraps this attribute to time the study's
+    kept as a *name* here because the host profiler (`tbx profile
+    --study-host`, obs/profile.py) wraps this attribute to time the study's
     JSON tail; the implementation lives in the runtime layer so pipelines
     never import IO helpers from sibling pipelines.
     """
@@ -1912,8 +1912,10 @@ def run_intervention_studies(
         else:
             import threading
 
-            threading.Thread(target=_warm, daemon=True,
-                             name="tbx-aot-warmstart").start()
+            t = threading.Thread(target=_warm, daemon=True,
+                                 name="tbx-aot-warmstart")
+            warm_state["thread"] = t
+            t.start()
 
     def done_entry(w: str) -> Optional[Dict[str, Any]]:
         p = os.path.join(output_dir, f"{w}.json")
@@ -2046,4 +2048,9 @@ def run_intervention_studies(
                 out[word] = outcome.value
             if on_word_done is not None:
                 on_word_done(word, out[word])
+    # The warm-start compile normally finishes during word 0; bound the wait
+    # so a wedged AOT path cannot hold the sweep's exit hostage.
+    t = warm_state.get("thread")
+    if t is not None:
+        t.join(timeout=30.0)
     return out

@@ -367,7 +367,8 @@ def run_evaluation(
         results[word] = {**results[word], "predictions": predictions[word]}
 
     if output_path:
+        from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
         os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
-        with open(output_path, "w") as f:
-            json.dump(results, f, indent=2)
+        atomic_json_dump(results, output_path)
     return results

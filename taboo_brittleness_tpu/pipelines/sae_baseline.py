@@ -155,7 +155,8 @@ def save_metrics_csv(results: Mapping[str, Any], path: str) -> None:
     """Per-word + overall CSV (reference src/02_run_sae_baseline.py:168-207)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     cols = ("prompt_accuracy", "any_pass", "global_majority_vote")
-    with open(path, "w", newline="") as f:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", newline="") as f:
         writer = csv.writer(f)
         writer.writerow(["word", *cols])
         for word, block in results.items():
@@ -164,3 +165,4 @@ def save_metrics_csv(results: Mapping[str, Any], path: str) -> None:
             writer.writerow([word, *(block.get(c, "") for c in cols)])
         overall = results.get("overall", {})
         writer.writerow(["overall", *(overall.get(c, "") for c in cols)])
+    os.replace(tmp, path)

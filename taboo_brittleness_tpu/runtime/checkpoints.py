@@ -208,6 +208,8 @@ class CheckpointManager:
         def run():
             try:
                 resilience.fire("prefetch.thread", word=word)
+                # tbx: TBX201-ok — load()/drop_pending() join the thread
+                # before reading the slot: join() is the happens-before edge
                 self._pending_results[word] = (True, self._load_triple(word))
                 obs.event("checkpoint.prefetch.done", word=word)
             except BaseException as e:  # re-raised (or retried) by load()

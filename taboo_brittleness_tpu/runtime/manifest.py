@@ -180,9 +180,12 @@ class RunManifest:
         }
 
     def save(self, path: str) -> str:
+        from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_dict(), f, indent=2)
+        # The manifest is re-saved per stage and read by resume/supervise —
+        # a crash mid-save must never leave a torn file behind.
+        atomic_json_dump(self.to_dict(), path)
         return path
 
 

@@ -173,6 +173,8 @@ class DrainController:
             return
         self._event.set()
         try:
+            # tbx: TBX202-ok — single write(2) to an unbuffered-enough fd;
+            # no locks taken, and a torn notice line is harmless
             sys.stderr.write(
                 f"[supervise] caught signal {signum}: draining at the next "
                 "word boundary (send again to abort immediately)\n")
@@ -186,6 +188,8 @@ class DrainController:
         try:
             from taboo_brittleness_tpu.obs import flightrec
 
+            # tbx: TBX202-ok — the ring is lock-free (GIL-atomic deque) and
+            # dump() writes a fresh tmp file: no lock a signal can land inside
             flightrec.dump(f"signal:{signum}")
         except Exception:  # noqa: BLE001 — fail-open, always
             pass

@@ -1,0 +1,2 @@
+"""Arming corpus for the TBX206 fixture: only demo.read is exercised."""
+PLAN = '{"demo.read": {"mode": "fail", "times": 1}}'

@@ -1,0 +1,57 @@
+"""TBX201 corpus: a daemon counter whose thread and main side share attrs.
+
+`_count` crosses the boundary with no lock (hit); `_safe` is locked on both
+sides (clean twin); `Latched._flag` carries the demo pragma.
+"""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._count = 0
+        self._safe = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._count += 1
+            with self._lock:
+                self._safe += 1
+
+    def read(self):
+        with self._lock:
+            safe = self._safe
+        return self._count + safe
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+class Latched:
+    def __init__(self):
+        self._thread = None
+        self._flag = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._flag = 1  # tbx: TBX201-ok — one-shot monotonic latch (demo)
+
+    def done(self):
+        return self._flag == 1
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -1,0 +1,38 @@
+"""TBX202 corpus: the PR-5 signal-handler self-deadlock shape.
+
+`bad_handler` reaches a lock acquisition through its call graph (the tracer
+lock incident); `noted_handler` does I/O under a demo pragma; `good_handler`
+only sets a latch (clean twin).
+"""
+import signal
+import threading
+
+_TRACE_LOCK = threading.Lock()
+EVENTS = []
+DRAIN = threading.Event()
+
+
+def _emit(name):
+    with _TRACE_LOCK:
+        EVENTS.append(name)
+
+
+def bad_handler(signum, frame):
+    _emit(f"signal:{signum}")
+
+
+def noted_handler(signum, frame):
+    import sys
+
+    # tbx: TBX202-ok — demo: single fd write, no locks taken
+    sys.stderr.write("draining\n")
+
+
+def good_handler(signum, frame):
+    DRAIN.set()
+
+
+def install():
+    signal.signal(signal.SIGTERM, bad_handler)
+    signal.signal(signal.SIGINT, noted_handler)
+    signal.signal(signal.SIGUSR1, good_handler)

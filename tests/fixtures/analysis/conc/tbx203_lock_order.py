@@ -1,0 +1,39 @@
+"""TBX203 corpus: an A->B / B->A lock-order cycle (hit), a second cycle
+under a demo pragma, and a consistently ordered pair (clean twin)."""
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+_C_LOCK = threading.Lock()
+_D_LOCK = threading.Lock()
+_E_LOCK = threading.Lock()
+
+
+def ab():
+    with _A_LOCK:
+        with _B_LOCK:
+            return 1
+
+
+def ba():
+    with _B_LOCK:
+        with _A_LOCK:
+            return 2
+
+
+def de():
+    with _D_LOCK:
+        with _E_LOCK:  # tbx: TBX203-ok — demo: ed() only runs in tests
+            return 3
+
+
+def ed():
+    with _E_LOCK:
+        with _D_LOCK:
+            return 4
+
+
+def consistent():
+    with _A_LOCK:
+        with _C_LOCK:
+            return 5

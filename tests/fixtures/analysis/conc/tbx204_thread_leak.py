@@ -1,0 +1,48 @@
+"""TBX204 corpus: the PR-2 fire-and-forget leak shape (hit + pragma'd), and
+the three sanctioned lifecycles — direct join, dict-of-handles join (the
+fixed prefetch form), and the swap-then-join stop idiom."""
+import threading
+
+
+def leak_fire_and_forget(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def leak_with_pragma(fn):
+    # tbx: TBX204-ok — demo: watchdog may outlive its owner by design
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def joined(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class Prefetcher:
+    """The PR-2 shape, fixed form: handles kept and joined at load."""
+
+    def __init__(self):
+        self._pending = {}
+
+    def prefetch(self, word, fn):
+        t = threading.Thread(target=fn, name=f"prefetch-{word}", daemon=True)
+        self._pending[word] = t
+        t.start()
+
+    def load(self, word):
+        self._pending.pop(word).join()
+
+
+class Stoppable:
+    def __init__(self):
+        self._thread = None
+
+    def start(self, fn):
+        self._thread = threading.Thread(target=fn)
+        self._thread.start()
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -1,0 +1,31 @@
+"""TBX205 corpus: bare truncate-write of a durable artifact (hit +
+pragma'd) vs the tmp+os.replace protocol and an append-only log (exempt)."""
+import json
+import os
+
+
+def bare_write(results, path):
+    with open(path, "w") as f:
+        json.dump(results, f)
+
+
+def pragmad_write(rows, path):
+    with open(path, "w") as f:  # tbx: TBX205-ok — demo: scratch file
+        f.write("\n".join(rows))
+
+
+def atomic_write(results, path):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(results, f)
+    os.replace(tmp, path)
+
+
+def append_log(line, path):
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+def read_back(path):
+    with open(path) as f:
+        return json.load(f)

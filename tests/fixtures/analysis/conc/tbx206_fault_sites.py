@@ -1,0 +1,24 @@
+"""TBX206 corpus: one registry exercising every drift class.  The paired
+arming corpus (fake_tests/) mentions only demo.read."""
+FAULT_SITES = (
+    "demo.read",       # fired + armed: clean
+    "demo.write",      # fired, never armed in tests: hit
+    "demo.orphan",     # registered, never fired: hit
+    "demo.reserved",   # tbx: TBX206-ok — demo: reserved for the next rev
+)
+
+
+def fire(site, **context):
+    del site, context
+
+
+def do_read():
+    fire("demo.read")
+
+
+def do_write():
+    fire("demo.write")
+
+
+def do_rogue():
+    fire("demo.rogue")

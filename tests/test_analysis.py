@@ -89,7 +89,7 @@ def test_tbx010_fixture_and_path_scope():
     assert [f.code for f in suppressed] == ["TBX010"]       # the pragma'd one
 
     for exempt_rel in ("taboo_brittleness_tpu/analysis/deep.py",
-                       "tools/profile_sweep.py", "tests/test_x.py"):
+                       "tools/trace_report.py", "tests/test_x.py"):
         active, _ = analyze_file(path, rel=exempt_rel)
         assert [f for f in active if f.code == "TBX010"] == [], exempt_rel
 
@@ -168,7 +168,7 @@ def test_baseline_roundtrip_filters_known_findings(tmp_path):
     assert n == len({baseline_mod.fingerprint(f) for f in report.findings})
     with open(bl) as f:
         doc = json.load(f)
-    assert doc["version"] == 1 and doc["findings"]
+    assert doc["version"] == 2 and doc["findings"]
 
     again = run_check([fixture], baseline=str(bl), default_excludes=False)
     assert again.findings == []

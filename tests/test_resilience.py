@@ -500,6 +500,27 @@ def test_manager_drop_pending_discards_thread_state():
     mgr.drop_pending("never-prefetched")  # idempotent / unknown word ok
 
 
+def test_manager_prefetch_thread_site_is_armable():
+    """Arm the 'prefetch.thread' FAULT_SITES entry (the worker-thread site):
+    the injected fault fails the prefetch *inside* the worker, and load()
+    then retries it like any transient error — proving the schedule reaches
+    the thread and the error routes through _pending_results, not a crash."""
+    loaded = []
+    mgr = _FlakyManager({}, loaded)
+    inj = FaultInjector()
+    inj.arm("prefetch.thread", mode="fail", times=1, match="ship")
+    resilience.set_injector(inj)
+    try:
+        mgr.prefetch("ship")
+        mgr._pending["ship"].join()
+        assert mgr._pending_results["ship"][0] is False
+        assert isinstance(mgr._pending_results["ship"][1], InjectedFault)
+        assert mgr.load("ship")[0] == "params-ship"
+        assert loaded == ["ship"]  # attempt 1 was the injected thread fault
+    finally:
+        resilience.set_injector(None)
+
+
 def test_manager_load_deadline_classifies_hang_as_transient():
     from taboo_brittleness_tpu.config import ModelConfig
     from taboo_brittleness_tpu.runtime.checkpoints import CheckpointManager

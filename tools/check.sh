@@ -1,57 +1,104 @@
 #!/usr/bin/env bash
-# One gate for the whole repo: lint (ruff, when installed) + tbx-check
-# (static TBX rules, then the deep jaxpr audit against the committed
-# baseline) + the tier-1 test suite.  Run from anywhere:
+# One gate for the whole repo.  Run from anywhere:
 #
-#     tools/check.sh
+#     tools/check.sh          # every gate: lint, selfchecks, tbx-check
+#                             # (static + deep + conc), tier-1 pytest
+#     tools/check.sh --fast   # static-only loop: ruff + tbx-check
+#                             # (static/deep/conc vs baseline) + the three
+#                             # trace_report fixture gates; no pytest
 #
-# Exit is non-zero if any stage fails; CI and pre-merge run exactly this.
-set -euo pipefail
+# Every gate RUNS even after an earlier one fails; the per-gate PASS/FAIL
+# table at exit shows the whole board, and the exit code is non-zero if
+# any gate failed.  CI and pre-merge run the full mode exactly.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
+FAST=0
+for arg in "$@"; do
+  case "$arg" in
+    --fast) FAST=1 ;;
+    *) echo "usage: tools/check.sh [--fast]" >&2; exit 2 ;;
+  esac
+done
+
+GATE_NAMES=()
+GATE_STATUS=()
+FAILED=0
+
+# gate <name> <cmd...> — run a gate, record PASS/FAIL, never abort the run.
+gate() {
+  local name="$1"; shift
+  echo "== ${name}"
+  if "$@"; then
+    GATE_NAMES+=("$name"); GATE_STATUS+=("PASS")
+  else
+    GATE_NAMES+=("$name"); GATE_STATUS+=("FAIL")
+    FAILED=1
+  fi
+}
+
+skip() {
+  GATE_NAMES+=("$1"); GATE_STATUS+=("SKIP")
+}
+
 if command -v ruff >/dev/null 2>&1; then
-  echo "== ruff"
-  ruff check taboo_brittleness_tpu tools tests
+  gate "ruff" ruff check taboo_brittleness_tpu tools tests
 else
   echo "== ruff: not installed; skipping lint (pip install ruff to enable)" >&2
+  skip "ruff"
 fi
 
-echo "== report sync (exec-summary bench table vs BENCH_r*.json)"
-python tools/report_bench_row.py --check reports/exec_summary/executive_summary.md
-
-echo "== bench regression sentinel (latest BENCH_r*.json vs predecessor)"
-python tools/bench_compare.py --check
-
-echo "== trace_report schema gate (committed obs fixture)"
-python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
-
-echo "== trace_report device-join gate (committed device-profile fixture)"
-python tools/trace_report.py tests/fixtures/obs/device/_events.jsonl \
-  --check --device
-
-echo "== trace_report fleet gate (committed multi-worker fixture)"
-python tools/trace_report.py --check tests/fixtures/obs/fleet/_events.jsonl
-
-echo "== tbx top selfcheck (render the committed fleet fixture)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu top --once --selfcheck
-
-echo "== serve loadgen selfcheck (CPU smoke: tiny model, 32 requests)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
-
-echo "== fleet selfcheck (chaos smoke: 3 tiny workers, one killed mid-word)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
-
-echo "== delta-pack selfcheck (pack/apply bit-exactness on the tiny model)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu delta-pack --selfcheck
-
-echo "== grid selfcheck (chaos smoke: 2x2 grid x 2 words, one faulted cell)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu grid --selfcheck
-
-echo "== tbx-check (static + deep; baseline tools/tbx_baseline.json)"
-JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
+gate "tbx-check (static + deep + conc)" \
+  env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \
   taboo_brittleness_tpu/ tools/ tests/
 
-echo "== tier-1 pytest"
-JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-  --continue-on-collection-errors -p no:cacheprovider
+gate "trace_report schema (obs fixture)" \
+  python tools/trace_report.py --check tests/fixtures/obs/_events.jsonl
+
+gate "trace_report device-join (device fixture)" \
+  python tools/trace_report.py tests/fixtures/obs/device/_events.jsonl \
+  --check --device
+
+gate "trace_report fleet (multi-worker fixture)" \
+  python tools/trace_report.py --check tests/fixtures/obs/fleet/_events.jsonl
+
+if [ "$FAST" -eq 0 ]; then
+  gate "report sync (exec-summary bench table)" \
+    python tools/report_bench_row.py --check \
+    reports/exec_summary/executive_summary.md
+
+  gate "bench regression sentinel" \
+    python tools/bench_compare.py --check
+
+  gate "tbx top selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu top --once --selfcheck
+
+  gate "serve loadgen selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
+
+  gate "fleet selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
+
+  gate "delta-pack selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu delta-pack --selfcheck
+
+  gate "grid selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu grid --selfcheck
+
+  gate "tier-1 pytest" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo
+echo "== gate summary"
+printf '%-44s %s\n' "gate" "status"
+printf '%-44s %s\n' "----" "------"
+for i in "${!GATE_NAMES[@]}"; do
+  printf '%-44s %s\n' "${GATE_NAMES[$i]}" "${GATE_STATUS[$i]}"
+done
+if [ "$FAILED" -ne 0 ]; then
+  echo "check.sh: FAILED" >&2
+fi
+exit "$FAILED"
