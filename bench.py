@@ -1472,6 +1472,51 @@ def _fleet_recovery_bench(on_accel: bool) -> dict:
     }
 
 
+def _serve_fleet_recovery_bench(on_accel: bool) -> dict:
+    """``serve_fleet_recovery`` stage (BENCH_SERVE_FLEET=1, CPU-smoke
+    default-on): how fast the replica serving fleet heals a replica death
+    (ISSUE 17).
+
+    Runs the REAL stack — 3 supervised ``serve --replica`` subprocesses
+    over a shared request spool, replica ``w1`` killed by a ``die`` fault
+    at its FIRST response commit — and commits the numbers the serving
+    robustness story is judged by: ``recovery_seconds`` (first lease
+    expiry → every re-spooled request answered), re-spooled request count,
+    parked duplicate-response count, and the router's shed rate.  Replicas
+    are pinned to CPU even on an accelerator round for the same reason as
+    ``fleet_recovery``: the stage measures the control plane (lease
+    expiry, re-spool, restart, admission), not model throughput."""
+    import tempfile
+
+    from taboo_brittleness_tpu.serve import replica as replica_mod
+
+    n_requests = int(os.environ.get("BENCH_SERVE_FLEET_REQUESTS", "12"))
+    n_replicas = int(os.environ.get("BENCH_SERVE_FLEET_REPLICAS", "3"))
+    root = tempfile.mkdtemp(prefix="tbx_bench_serve_fleet_")
+    t0 = time.perf_counter()
+    try:
+        res = replica_mod.chaos_smoke(
+            root, n_requests=n_requests, n_replicas=n_replicas,
+            lease_s=3.0, max_wall_s=600.0)
+    except Exception as e:  # noqa: BLE001 — a broken stage must not void the round
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    return {
+        "status": res.status,
+        "requests": res.requests_total,
+        "replicas": n_replicas,
+        "completed": res.completed,
+        "respooled_requests": res.respooled,
+        "lease_expiries": res.lease_expiries,
+        "duplicate_responses": res.duplicate_commits,
+        "shed_requests": res.shed,
+        "shed_rate": res.shed_rate,
+        "recovery_seconds": res.recovery_seconds,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+        "replica_incarnations": {r["worker_id"]: r["incarnations"]
+                                 for r in res.replicas},
+    }
+
+
 def _delta_switch_bench(on_accel: bool) -> dict:
     """``delta_switch`` stage (BENCH_DELTA=1, CPU-smoke default-on): the
     base-resident word-switch path (ISSUE 12).
@@ -1795,6 +1840,10 @@ def main() -> int:
     if os.environ.get("BENCH_FLEET", "1") == "1":
         fleet_stage = _fleet_recovery_bench(on_accel)
 
+    serve_fleet_stage = None
+    if os.environ.get("BENCH_SERVE_FLEET", "1") == "1":
+        serve_fleet_stage = _serve_fleet_recovery_bench(on_accel)
+
     delta_stage = None
     if os.environ.get("BENCH_DELTA", "1") == "1":
         delta_stage = _delta_switch_bench(on_accel)
@@ -1894,6 +1943,21 @@ def main() -> int:
              "reissued_units": fleet_stage.get("reissued_units"),
              "duplicate_commits": fleet_stage.get("duplicate_commits")}
             if fleet_stage and "error" not in fleet_stage else None),
+        # Replica-serving recovery (serve/replica.py, stage
+        # serve_fleet_recovery): a real 3-replica chaos run with one
+        # injected death at first response commit — how long the
+        # lease-expiry → re-spool chain takes to answer everything, plus
+        # re-spool / parked-duplicate counts and the router's shed rate;
+        # full stage in the detail block.
+        "serve_fleet_recovery": (
+            {"recovery_seconds": serve_fleet_stage.get("recovery_seconds"),
+             "respooled_requests":
+                 serve_fleet_stage.get("respooled_requests"),
+             "duplicate_responses":
+                 serve_fleet_stage.get("duplicate_responses"),
+             "shed_rate": serve_fleet_stage.get("shed_rate")}
+            if serve_fleet_stage and "error" not in serve_fleet_stage
+            else None),
         # Base-resident delta switch (runtime/delta.py, stage delta_switch):
         # pack word−base deltas, then time warmed load→apply→ready word
         # switches over the resident base — median latency, delta-vs-full
@@ -1961,6 +2025,7 @@ def main() -> int:
              "serve_latency": serve_stage,
              "serve_spec_ab": serve_spec_stage,
              "fleet_recovery": fleet_stage,
+             "serve_fleet_recovery": serve_fleet_stage,
              "delta_switch": delta_stage,
              "grid_sweep": grid_stage,
              "device_profile": device_profile},

@@ -572,10 +572,71 @@ def cmd_serve(args) -> int:
         lens_target_id=lens_tgt,
         queue_limit=args.queue_limit,
         max_requests=args.max_requests,
-        poll_s=args.poll)
+        poll_s=args.poll,
+        replica=args.replica,
+        lease_s=args.lease)
     # tbx: TBX009-ok — CLI stdout contract (serve summary JSON)
     print(json.dumps({"status": res.status, "completed": res.completed,
                       "steps": res.steps}))
+    return res.exit_code
+
+
+def cmd_serve_fleet(args) -> int:
+    """Replica-fleet serving coordinator (``serve.replica``): N supervised
+    ``serve --replica`` children over ONE shared request spool — leased
+    request ownership, death→re-spool recovery, first-writer-wins
+    responses, and a burn-rate admission router steering intake by each
+    replica's ``slo.burn.*`` heartbeat."""
+    from taboo_brittleness_tpu.serve import replica as replica_mod
+
+    if args.selfcheck:
+        return replica_mod.main_selfcheck()
+    if not args.output_dir:
+        raise SystemExit(
+            "serve-fleet: --output-dir is required (or --selfcheck)")
+    out = args.output_dir
+
+    def replica_argv(wid: str) -> List[str]:
+        argv = [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+                "--output-dir", out, "--replica",
+                "-c", args.config,
+                "--slots", str(args.slots),
+                "--max-context", str(args.max_context),
+                "--prompt-cols", str(args.prompt_cols),
+                "--max-new-tokens", str(args.max_new_tokens),
+                "--queue-limit", str(args.queue_limit),
+                "--poll", str(args.poll)]
+        if args.synthetic:
+            argv.append("--synthetic")
+        if args.word:
+            argv += ["--word", args.word]
+        if args.words:
+            argv += ["--words", *args.words]
+        if args.delta_root:
+            argv += ["--delta-root", args.delta_root]
+        if args.checkpoint_root:
+            argv += ["--checkpoint-root", args.checkpoint_root]
+        if args.sae_npz:
+            argv += ["--sae-npz", args.sae_npz]
+        if args.lease is not None:
+            argv += ["--lease", str(args.lease)]
+        return argv
+
+    res = replica_mod.run_serve_fleet(
+        out, replica_argv=replica_argv, n_replicas=args.replicas,
+        lease_s=args.lease, max_requests=args.max_requests,
+        max_wall_s=args.max_wall, max_incarnations=args.max_incarnations,
+        grace=args.grace, wedge_after=args.wedge_after,
+        burn_cap=args.burn_cap)
+    # tbx: TBX009-ok — CLI stdout contract (serve-fleet summary JSON)
+    print(json.dumps({"status": res.status, "requests": res.requests_total,
+                      "completed": res.completed, "shed": res.shed,
+                      "respooled": res.respooled,
+                      "lease_expiries": res.lease_expiries,
+                      "duplicate_responses": res.duplicate_commits,
+                      "recovery_seconds": res.recovery_seconds,
+                      "shed_rate": res.shed_rate,
+                      "replicas": res.replicas}))
     return res.exit_code
 
 
@@ -1259,7 +1320,69 @@ def build_parser() -> argparse.ArgumentParser:
                          "(counts prior incarnations'; default: run forever)")
     se.add_argument("--poll", type=float, default=0.05,
                     help="idle spool poll interval seconds")
+    se.add_argument("--replica", action="store_true",
+                    help="run as ONE replica of a serve-fleet: claim "
+                         "assigned requests under renewed leases and commit "
+                         "responses first-writer-wins (normally launched "
+                         "by `serve-fleet`)")
+    se.add_argument("--lease", type=float, default=None,
+                    help="replica-mode lease seconds before an unrenewed "
+                         "claim is re-spooled (default: TBX_FLEET_LEASE_S "
+                         "or 10)")
     se.set_defaults(fn=cmd_serve)
+
+    sf = sub.add_parser(
+        "serve-fleet",
+        help="N supervised serve replicas over one shared request spool "
+             "(leased claims, death→re-spool, burn-rate admission router)",
+        description="Run N `serve --replica` children under per-replica "
+                    "supervision over ONE request spool. The coordinator "
+                    "routes intake to healthy replicas weighted by "
+                    "fast-burn headroom read off _progress.<wid>.json, "
+                    "sheds with a typed rejection when every live replica "
+                    "burns past the cap, re-spools requests whose lease "
+                    "expired (replica death / wedge) with the dead holder "
+                    "excluded, and merges per-replica telemetry at exit. "
+                    "Responses commit first-writer-wins so duplicate "
+                    "completions are benign. SIGTERM drains the fleet "
+                    "(exit 75); per-replica SIGTERM is a rolling restart "
+                    "that drops nothing.")
+    _serve_common(sf)
+    sf.add_argument("--output-dir", default=None,
+                    help="shared spool + telemetry directory (required "
+                         "unless --selfcheck)")
+    sf.add_argument("--replicas", type=int, default=3,
+                    help="replica subprocess count")
+    sf.add_argument("--queue-limit", type=int, default=64,
+                    help="per-replica bounded admission queue")
+    sf.add_argument("--max-requests", type=int, default=None,
+                    help="exit 0 once this many responses exist "
+                         "(default: run until drained)")
+    sf.add_argument("--poll", type=float, default=0.05,
+                    help="per-replica idle spool poll interval seconds")
+    sf.add_argument("--lease", type=float, default=None,
+                    help="request lease seconds before re-spool "
+                         "(default: TBX_FLEET_LEASE_S or 10)")
+    sf.add_argument("--max-incarnations", type=int, default=None,
+                    help="per-replica supervisor restart budget")
+    sf.add_argument("--grace", type=float, default=None,
+                    help="per-replica SIGTERM->SIGKILL grace seconds")
+    sf.add_argument("--wedge-after", type=float, default=None,
+                    help="kill a replica with in-flight work but no decode "
+                         "step for this long while its heartbeat stays "
+                         "fresh")
+    sf.add_argument("--max-wall", type=float, default=None,
+                    help="hard coordinator wall-clock bound (safety valve)")
+    sf.add_argument("--burn-cap", type=float, default=None,
+                    help="fast-burn multiple at which a replica's admission "
+                         "weight reaches zero (default: TBX_ROUTER_BURN_CAP "
+                         "or 2.0)")
+    sf.add_argument("--selfcheck", action="store_true",
+                    help="CPU-sized CI chaos smoke: 3 synthetic replicas, "
+                         "one killed at its first response commit, asserts "
+                         "every request answered exactly once through the "
+                         "lease-expiry→re-spool path")
+    sf.set_defaults(fn=cmd_serve_fleet)
 
     lg = sub.add_parser(
         "loadgen",

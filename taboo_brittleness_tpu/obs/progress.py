@@ -157,7 +157,15 @@ class ProgressReporter:
         admit on it without parsing the spool."""
         now = self._clock()
         with self._lock:
-            if stepped or self._last_step_mono is None:
+            prev_in_flight = (int(self._serving.get("in_flight", 0))
+                              if self._serving else 0)
+            # The step clock restarts when work ARRIVES (0 -> >0), not just
+            # when a step completes: the serve loop publishes in-flight
+            # before stepping so a step that wedges is visible, and an
+            # idle-for-hours server must not read as instantly wedged the
+            # moment its first request lands.
+            if (stepped or self._last_step_mono is None
+                    or (in_flight > 0 and prev_in_flight == 0)):
                 self._last_step_mono = now
             if latency is not None:
                 self._serving_latency = latency

@@ -126,6 +126,8 @@ def collect(output_dir: str) -> Dict[str, Any]:
         "lanes": lanes,
         "fleet": _read_json(os.path.join(output_dir, "_fleet.json")),
         "serve": _read_json(os.path.join(output_dir, "_serve.json")),
+        "serve_fleet": _read_json(os.path.join(output_dir,
+                                               "_serve_fleet.json")),
         "windows": windows,
         "exits": exits,
         "n_windows": n_windows,
@@ -164,6 +166,19 @@ def _lane_line(lane: Dict[str, Any]) -> str:
                     f"completed {sv.get('completed_requests', 0)}  "
                     f"queued {sv.get('queued', 0)}  "
                     f"step-age {_fmt_s(sv.get('last_step_age_seconds'))}")
+        # The burn column the serve-fleet router steers by: the lane's
+        # worst fast-window serve burn, straight off its own heartbeat.
+        fast = None
+        for key, cell in (lane.get("slo") or {}).items():
+            if not str(key).startswith("serve"):
+                continue
+            try:
+                val = float((cell or {}).get("fast", 0.0))
+            except (TypeError, ValueError):
+                continue
+            fast = val if fast is None else max(fast, val)
+        if fast is not None:
+            bits.append(f"burn {fast:.2f}x")
     else:
         word = lane.get("current_word")
         phase = lane.get("phase")
@@ -247,6 +262,18 @@ def render(state: Dict[str, Any]) -> str:
             f"lease-expiries {fleet.get('lease_expiries', 0)}"
             + (f"  recovery {fleet['recovery_seconds']:.1f}s"
                if fleet.get("recovery_seconds") is not None else ""))
+    sf = state.get("serve_fleet")
+    if sf:
+        lines.append(
+            f"serve-fleet: {sf.get('status', '?')}  "
+            f"answered {sf.get('completed', 0)}/"
+            f"{sf.get('requests_total', 0)}  "
+            f"shed {sf.get('shed', 0)}  "
+            f"respooled {sf.get('respooled', 0)}  "
+            f"lease-expiries {sf.get('lease_expiries', 0)}  "
+            f"dupes {sf.get('duplicate_commits', 0)}"
+            + (f"  recovery {sf['recovery_seconds']:.1f}s"
+               if sf.get("recovery_seconds") is not None else ""))
     lanes = state.get("lanes") or []
     if lanes:
         lines.append("lanes:")
@@ -298,11 +325,19 @@ def default_fixture_dir() -> str:
     return os.path.join(root, "tests", "fixtures", "obs", "fleet")
 
 
+def default_serve_fleet_fixture_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tests", "fixtures", "obs", "serve_fleet")
+
+
 def main_selfcheck(fixture_dir: Optional[str] = None) -> int:
     """CI smoke (``tbx top --once --selfcheck``): render the committed fleet
     fixture and assert the frame carries the load-bearing sections — worker
     lanes and spool windows — so a silent collection regression fails the
-    gate instead of rendering an empty screen forever."""
+    gate instead of rendering an empty screen forever.  When the serve-fleet
+    fixture is committed too, render it and assert replica lanes plus the
+    serve-fleet summary line."""
     fixture_dir = fixture_dir or default_fixture_dir()
     state = collect(fixture_dir)
     frame = render(state)
@@ -314,6 +349,23 @@ def main_selfcheck(fixture_dir: Optional[str] = None) -> int:
         problems.append("no metrics windows in fixture")
     if not state["flightrec"]:
         problems.append("no flight-recorder dump in fixture")
+    sf_dir = default_serve_fleet_fixture_dir()
+    if fixture_dir == default_fixture_dir() and os.path.isdir(sf_dir):
+        sf_state = collect(sf_dir)
+        sf_frame = render(sf_state)
+        # tbx: TBX009-ok — CLI stdout contract (selfcheck frame)
+        print(sf_frame)
+        replica_lanes = [ln for ln in sf_state["lanes"]
+                         if ln.get("workload") == "serve"]
+        if len(replica_lanes) < 2:
+            problems.append("serve_fleet fixture: fewer than 2 replica "
+                            "serve lanes")
+        if not sf_state.get("serve_fleet"):
+            problems.append("serve_fleet fixture: no _serve_fleet.json "
+                            "summary")
+        elif "serve-fleet:" not in sf_frame:
+            problems.append("serve_fleet fixture: summary line not "
+                            "rendered")
     if problems:
         # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict)
         print("top selfcheck FAILED: " + "; ".join(problems))

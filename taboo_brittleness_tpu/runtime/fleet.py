@@ -92,9 +92,10 @@ from taboo_brittleness_tpu.runtime.resilience import (
 from taboo_brittleness_tpu.runtime import resilience
 
 __all__ = [
-    "FleetResult", "FleetSpool", "LeaseKeeper", "WorkerResult",
-    "holder_token", "main_selfcheck", "merge_fleet_artifacts",
-    "merge_metrics", "run_fleet", "run_worker", "unit_id",
+    "FleetResult", "FleetSpool", "LeaseKeeper", "LeaseStore", "WorkerResult",
+    "exclusive_commit", "holder_token", "main_selfcheck",
+    "merge_fleet_artifacts", "merge_metrics", "run_fleet", "run_worker",
+    "unit_id",
 ]
 
 SPOOL_DIRNAME = "spool"
@@ -135,6 +136,112 @@ def holder_token(worker_id: str, incarnation: Optional[int] = None) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Lease core: unit-type-agnostic ownership machinery (ISSUE 17).
+#
+# A "lease" knows nothing about what it protects — only that some holder
+# claimed item ``uid`` at attempt ``attempt`` and must renew before
+# ``expires_at`` or lose it.  Factoring the file machinery out of FleetSpool
+# lets serve.server.RequestSpool lease REQUESTS with the exact same expiry /
+# re-issue / exclusion semantics the sweep fleet chaos-proved.
+# ---------------------------------------------------------------------------
+
+
+class LeaseStore:
+    """The leases/ directory: one JSON file per held ``(uid, attempt)``.
+
+    Expiry is a CROSS-PROCESS deadline, so every timestamp here is epoch
+    wall-clock: the coordinator compares ``expires_at`` against its own
+    clock — monotonic bases do not transfer between processes."""
+
+    def __init__(self, leases_dir: str):
+        self.leases_dir = leases_dir
+
+    def ensure(self) -> "LeaseStore":
+        os.makedirs(self.leases_dir, exist_ok=True)
+        return self
+
+    def lease_path(self, uid: str, attempt: int) -> str:
+        return os.path.join(self.leases_dir, f"{uid}.a{attempt}.json")
+
+    def write_lease(self, uid: str, attempt: int, holder: str, worker: str,
+                    lease_s: float, *,
+                    claimed_at: Optional[float] = None) -> None:
+        # tbx: wallclock-ok — cross-process lease deadline (see class doc)
+        now = time.time()
+        atomic_json_dump({"v": 1, "uid": uid, "attempt": attempt,
+                          "holder": holder, "worker": worker,
+                          "pid": os.getpid(),
+                          "claimed_at": claimed_at if claimed_at is not None
+                          else now,
+                          "renewed_at": now,
+                          "expires_at": now + float(lease_s)},
+                         self.lease_path(uid, attempt))
+
+    def drop_lease(self, uid: str, attempt: int) -> None:
+        try:
+            os.unlink(self.lease_path(uid, attempt))
+        except OSError:
+            pass
+
+    def leases(self) -> List[Dict[str, Any]]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.leases_dir))
+        except OSError:
+            return []
+        for n in names:
+            if not n.endswith(".json"):
+                continue
+            path = os.path.join(self.leases_dir, n)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            rec["_path"] = path
+            out.append(rec)
+        return out
+
+
+def exclusive_commit(dst_path: str, payload: Dict[str, Any], *,
+                     holder: str, duplicates_dir: str) -> bool:
+    """First-writer-wins commit of ``payload`` to ``dst_path``: write a
+    holder-private tmp next to it, then ``os.link`` — creation is exclusive,
+    so exactly one racer wins.  The loser's payload parks in
+    ``duplicates_dir`` (duplicate completions are expected under speculative
+    or re-issued work, never a conflict).  Returns True when THIS call
+    created ``dst_path``."""
+    d = os.path.dirname(dst_path)
+    base = os.path.basename(dst_path)
+    tmp = os.path.join(d, f".{base}.{holder}.tmp")
+    stem = base[:-5] if base.endswith(".json") else base
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    try:
+        os.link(tmp, dst_path)
+        won = True
+    except FileExistsError:
+        won = False
+        try:
+            os.makedirs(duplicates_dir, exist_ok=True)
+            os.replace(tmp, os.path.join(duplicates_dir,
+                                         f"{stem}.{holder}.json"))
+        except OSError:
+            pass
+    except OSError:
+        # No hardlink support: fall back to the create-exclusive dance.
+        won = not os.path.exists(dst_path)
+        if won:
+            os.replace(tmp, dst_path)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return won
+
+
+# ---------------------------------------------------------------------------
 # The durable spool.
 # ---------------------------------------------------------------------------
 
@@ -156,6 +263,7 @@ class FleetSpool:
         self.done_dir = os.path.join(root, "done")
         self.duplicates_dir = os.path.join(root, "duplicates")
         self.quarantined_dir = os.path.join(root, "quarantined")
+        self.lease_store = LeaseStore(self.leases_dir)
 
     def ensure(self) -> "FleetSpool":
         for d in (self.units_dir, self.claimed_dir, self.leases_dir,
@@ -266,21 +374,10 @@ class FleetSpool:
         return out
 
     def leases(self) -> List[Dict[str, Any]]:
-        out = []
-        for n in self._listdir(self.leases_dir):
-            if not n.endswith(".json"):
-                continue
-            rec = self._parse(os.path.join(self.leases_dir, n))
-            if rec is not None:
-                rec["_path"] = os.path.join(self.leases_dir, n)
-                out.append(rec)
-        return out
+        return self.lease_store.leases()
 
     def drop_lease(self, uid: str, attempt: int) -> None:
-        try:
-            os.unlink(self.lease_path(uid, attempt))
-        except OSError:
-            pass
+        self.lease_store.drop_lease(uid, attempt)
 
     # -- worker side ---------------------------------------------------------
 
@@ -322,54 +419,23 @@ class FleetSpool:
         return None
 
     def lease_path(self, uid: str, attempt: int) -> str:
-        return os.path.join(self.leases_dir, f"{uid}.a{attempt}.json")
+        return self.lease_store.lease_path(uid, attempt)
 
     def write_lease(self, uid: str, attempt: int, holder: str, worker: str,
                     lease_s: float, *,
                     claimed_at: Optional[float] = None) -> None:
-        # tbx: wallclock-ok — lease expiry is a CROSS-PROCESS deadline; the
-        # coordinator compares against its own epoch clock, monotonic bases
-        # do not transfer between processes.
-        now = time.time()
-        atomic_json_dump({"v": 1, "uid": uid, "attempt": attempt,
-                          "holder": holder, "worker": worker,
-                          "pid": os.getpid(),
-                          "claimed_at": claimed_at if claimed_at is not None
-                          else now,
-                          "renewed_at": now,
-                          "expires_at": now + float(lease_s)},
-                         self.lease_path(uid, attempt))
+        self.lease_store.write_lease(uid, attempt, holder, worker, lease_s,
+                                     claimed_at=claimed_at)
 
     def commit(self, uid: str, payload: Dict[str, Any], *,
                holder: str) -> bool:
-        """First-writer-wins atomic commit.  Returns True when THIS call
-        created ``done/<uid>.json``; False means another attempt already
-        committed and this result parked in ``duplicates/`` — benign by
-        design (speculative re-dispatch makes duplicate completions
-        expected, not exceptional)."""
-        tmp = os.path.join(self.done_dir, f".{uid}.{holder}.tmp")
-        with open(tmp, "w") as f:
-            json.dump(payload, f, indent=2)
-        try:
-            os.link(tmp, self.done_path(uid))
-            won = True
-        except FileExistsError:
-            won = False
-            try:
-                os.replace(tmp, os.path.join(self.duplicates_dir,
-                                             f"{uid}.{holder}.json"))
-            except OSError:
-                pass
-        except OSError:
-            # No hardlink support: fall back to the create-exclusive dance.
-            won = not os.path.exists(self.done_path(uid))
-            if won:
-                os.replace(tmp, self.done_path(uid))
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        """First-writer-wins atomic commit (:func:`exclusive_commit`).
+        Returns True when THIS call created ``done/<uid>.json``; False means
+        another attempt already committed and this result parked in
+        ``duplicates/`` — benign by design (speculative re-dispatch makes
+        duplicate completions expected, not exceptional)."""
+        won = exclusive_commit(self.done_path(uid), payload, holder=holder,
+                               duplicates_dir=self.duplicates_dir)
         flightrec.record("fleet.commit", uid=uid, won=won)
         return won
 
@@ -402,9 +468,13 @@ class LeaseKeeper:
     (transient IO, injected ``fleet.lease_renew`` fault) lets the lease
     expire and the unit get re-issued — the first-writer-wins commit makes
     that a duplicate, never a conflict.  A ``die``-mode fault at the
-    renewal site kills the whole process, the crash the harness simulates."""
+    renewal site kills the whole process, the crash the harness simulates.
 
-    def __init__(self, spool: FleetSpool, uid: str, attempt: int,
+    ``spool`` only needs ``write_lease``/``drop_lease`` — a bare
+    :class:`LeaseStore` works; the serve fleet's multi-request keeper
+    (``serve.server.ServeLeaseKeeper``) builds on the store directly."""
+
+    def __init__(self, spool: Any, uid: str, attempt: int,
                  holder: str, worker: str, lease_s: float):
         self.spool = spool
         self.uid = uid

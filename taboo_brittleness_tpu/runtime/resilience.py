@@ -560,6 +560,21 @@ FAULT_SITES = (
     #                       run_guarded retry→quarantine path, so a poisoned
     #                       cell quarantines while the rest of the grid
     #                       commits (tests/test_grid.py)
+    "serve.claim",        # serve.server.RequestSpool.claim_assigned — fired
+    #                       per replica leased-claim attempt (context:
+    #                       request + worker + holder); the replica's serve
+    #                       loop retries a failed claim on its next poll,
+    #                       mirroring fleet.claim
+    "serve.lease_renew",  # serve.server.ServeLeaseKeeper — fired per held
+    #                       request per renewal cycle; a fault lets the
+    #                       request lease expire (coordinator re-spools,
+    #                       then benign duplicate response), `die` here is
+    #                       the mid-renewal replica SIGKILL harness
+    "serve.respond",      # serve.server.RequestSpool.respond_exclusive —
+    #                       fired just before the first-writer-wins response
+    #                       link; `die` here is the "replica killed at first
+    #                       commit, response never lands" chaos case the
+    #                       serve-fleet selfcheck arms
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

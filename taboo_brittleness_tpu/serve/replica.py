@@ -1,0 +1,733 @@
+"""Replica-fleet serving: leased request ownership + burn-rate routing.
+
+``tbx serve`` is one resident engine per spool directory — a SIGKILL'd or
+wedged server takes every claimed request down with it until a restart.
+This module (ISSUE 17) generalizes the sweep fleet's ownership machinery
+(``runtime.fleet``: time-bounded leases, expiry→re-issue, first-writer-wins
+commits, per-worker supervision) from sweep units to serve REQUESTS:
+
+- **N supervised replicas.**  Each replica is a ``tbx serve --replica``
+  child (resident engine + scheduler) under ``supervise(worker_id=wid)``:
+  per-worker ``_progress.<wid>.json`` / ``_events.<wid>.jsonl`` /
+  ``_metrics.<wid>.jsonl``, wedge detection, bounded restarts — the sweep
+  fleet's supervisor story, reused not reimplemented.
+- **Leased claims.**  A replica claims its routed assignments by rename and
+  renews ``leases/<id>.a<k>.json`` from one keeper thread
+  (``server.ServeLeaseKeeper``).  Replica death (SIGKILL / OOM / ``die``
+  fault) stops renewal; the coordinator expires the lease and RE-SPOOLS the
+  request to a live replica with the dead holder excluded.  Responses
+  commit first-writer-wins (``os.link`` exclusive), so duplicate
+  completions from re-spooled or raced replicas are benign by construction.
+- **Burn-rate admission router.**  The coordinator reads each replica's
+  ``slo.burn.*`` block and heartbeat age straight off
+  ``_progress.<wid>.json`` (``obs.progress.read_progress``; the contract
+  ISSUE 15 put on every serve heartbeat) and steers new requests toward
+  healthy replicas, weighted by fast-burn headroom
+  (``weight = 1 - fast / TBX_ROUTER_BURN_CAP``).  When every live replica
+  is burning past the cap, intake is SHED with a typed rejection
+  (``all-replicas-burning``) instead of queueing into a fire.  A stale or
+  absent heartbeat weighs zero — a dead or restarting replica receives no
+  new work until it heartbeats again.
+- **Drain.**  SIGTERM on the coordinator latches the shared drain flag;
+  each per-replica supervisor forwards it, replicas finish in-flight work
+  and exit 75, and the coordinator exits 75 itself — unclaimed assignments
+  stay on disk and the next coordinator incarnation re-routes them.  A
+  SIGTERM delivered to ONE replica child drains just that replica; its
+  supervisor relaunches it (rolling restart) and nothing is dropped.
+
+Fault sites ``serve.claim`` / ``serve.lease_renew`` / ``serve.respond``
+(``TABOO_FAULT_PLAN``) make the whole thing chaos-provable the way the
+sweep fleet was: ``selfcheck()`` kills one replica at its first response
+commit and asserts every request is answered exactly once through the
+lease-expiry→re-spool path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.obs.progress import read_progress
+from taboo_brittleness_tpu.runtime import supervise
+from taboo_brittleness_tpu.runtime import fleet as fleet_mod
+from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
+from taboo_brittleness_tpu.serve.scheduler import (
+    REJECT_ALL_REPLICAS_BURNING, Response)
+from taboo_brittleness_tpu.serve.server import CLAIMED_SUFFIX, RequestSpool
+
+__all__ = [
+    "BurnRouter", "SERVE_FLEET_SUMMARY_FILENAME", "ServeFleetResult",
+    "main_selfcheck", "reroute_orphans", "run_serve_fleet", "selfcheck",
+]
+
+SERVE_FLEET_SUMMARY_FILENAME = "_serve_fleet.json"
+
+#: The coordinator's holder identity for shed (router-rejected) responses.
+ROUTER_HOLDER = "router"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def router_burn_cap() -> float:
+    """Fast-burn ceiling (``TBX_ROUTER_BURN_CAP``): at this multiple of the
+    SLO budget a replica's admission weight reaches zero and it counts as
+    burning.  2.0 = twice the budgeted burn rate, the conventional
+    fast-window page threshold."""
+    return max(0.1, _env_float("TBX_ROUTER_BURN_CAP", 2.0))
+
+
+# ---------------------------------------------------------------------------
+# The burn-rate admission router.
+# ---------------------------------------------------------------------------
+
+
+class BurnRouter:
+    """Steers intake toward healthy replicas using ONLY what every serve
+    heartbeat already publishes (``_progress.<wid>.json``): liveness
+    (status + staleness), the ``slo`` burn block, and queue occupancy.
+
+    Per replica: ``fast`` = the worst fast-window burn over the heartbeat's
+    serve SLO series (``serve_latency.*``, ``serve_goodput``);
+    ``weight = max(0, 1 - fast / burn_cap)`` — full weight with zero burn,
+    zero at the cap.  Routing is seeded weighted-random (deterministic per
+    coordinator), so a replica at a quarter of the healthy weight receives
+    about a quarter of the healthy share — measurably less, never zero
+    until it actually burns past the cap."""
+
+    def __init__(self, output_dir: str, replica_ids: Sequence[str], *,
+                 burn_cap: Optional[float] = None, seed: int = 0):
+        self.output_dir = output_dir
+        self.replica_ids = list(replica_ids)
+        self.burn_cap = (float(burn_cap) if burn_cap is not None
+                         else router_burn_cap())
+        self._rng = random.Random(f"tbx-router:{seed}")
+        self.routed: Dict[str, int] = {}
+        self.sheds = 0
+
+    def view(self) -> Dict[str, Dict[str, Any]]:
+        """One admission snapshot per replica (pure read; unit-testable
+        against fabricated heartbeat files)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for wid in self.replica_ids:
+            p = read_progress(
+                os.path.join(self.output_dir, f"_progress.{wid}.json"),
+                missing_ok=True)
+            alive = p.get("status") == "running" and not p.get("stale")
+            fast = 0.0
+            for key, cell in (p.get("slo") or {}).items():
+                if not str(key).startswith("serve"):
+                    continue
+                try:
+                    fast = max(fast, float((cell or {}).get("fast", 0.0)))
+                except (TypeError, ValueError):
+                    continue
+            burning = bool(alive and fast >= self.burn_cap)
+            weight = 0.0 if not alive else max(
+                0.0, 1.0 - fast / self.burn_cap)
+            serving = p.get("serving") or {}
+            out[wid] = {
+                "alive": alive,
+                "burning": burning,
+                "fast_burn": round(fast, 4),
+                "weight": round(weight, 4),
+                "heartbeat_age": p.get("age_seconds"),
+                "in_flight": int(serving.get("in_flight", 0) or 0),
+                "completed": int(serving.get("completed_requests", 0) or 0),
+            }
+        return out
+
+    @staticmethod
+    def any_alive(view: Dict[str, Dict[str, Any]]) -> bool:
+        return any(v["alive"] for v in view.values())
+
+    @staticmethod
+    def all_burning(view: Dict[str, Dict[str, Any]]) -> bool:
+        """True when there ARE live replicas and every one is past the cap
+        — the typed-shed condition.  No live replicas is NOT burning: that
+        is startup or a rolling restart, and intake should wait."""
+        live = [v for v in view.values() if v["alive"]]
+        return bool(live) and all(v["burning"] for v in live)
+
+    def pick(self, view: Optional[Dict[str, Dict[str, Any]]] = None, *,
+             exclude: Sequence[str] = ()) -> Optional[str]:
+        """Weighted choice among live, non-excluded replicas with headroom;
+        None when nothing is routable (caller distinguishes wait vs shed
+        via :meth:`any_alive` / :meth:`all_burning`)."""
+        view = self.view() if view is None else view
+        weighted = {w: v["weight"] for w, v in view.items()
+                    if v["alive"] and v["weight"] > 0 and w not in exclude}
+        if not weighted:
+            return None
+        total = sum(weighted.values())
+        r = self._rng.random() * total
+        acc = 0.0
+        chosen = None
+        for w in sorted(weighted):
+            acc += weighted[w]
+            if chosen is None and r <= acc:
+                chosen = w
+        chosen = chosen or sorted(weighted)[-1]
+        self.routed[chosen] = self.routed.get(chosen, 0) + 1
+        return chosen
+
+
+# ---------------------------------------------------------------------------
+# Coordinator.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServeFleetResult:
+    """Coordinator outcome.  Field names ``status`` / ``reissue_chains`` /
+    ``lease_expiries`` / ``duplicate_commits`` deliberately match
+    ``fleet.FleetResult`` so ``fleet.merge_ledgers`` folds the serve
+    fleet's re-spool chains into ``_failures.json`` unchanged."""
+
+    status: str                    # done | drained | stalled
+    exit_code: int
+    requests_total: int
+    completed: int
+    shed: int
+    respooled: int
+    lease_expiries: int
+    duplicate_commits: int
+    recovery_seconds: Optional[float]
+    wall_seconds: float
+    replicas: List[Dict[str, Any]]
+    reissue_chains: Dict[str, List[Dict[str, Any]]]
+    router: Dict[str, Any]
+
+    @property
+    def shed_rate(self) -> float:
+        return round(self.shed / self.requests_total, 4) \
+            if self.requests_total else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dataclasses.asdict(self)
+        out["version"] = 1
+        out["shed_rate"] = self.shed_rate
+        return out
+
+
+def reroute_orphans(spool: RequestSpool, router: BurnRouter, worker: str, *,
+                    view: Optional[Dict[str, Dict[str, Any]]] = None,
+                    ob: Any = None) -> int:
+    """Move a PERMANENTLY-dead replica's unclaimed assignments to live
+    replicas (drain→re-spool: nothing a drained or budget-exhausted replica
+    never claimed is lost).  Returns how many were moved; stops early when
+    no live target exists (retried next coordinator round)."""
+    moved = 0
+    for rec in spool.assigned_entries(worker):
+        target = router.pick(view, exclude=(worker,))
+        if target is None:
+            break
+        rid = str(rec.get("id"))
+        spool.assign(rid, dict(rec.get("request") or {}), target,
+                     attempt=int(rec.get("attempt", 0)),
+                     excluded=rec.get("excluded", ()))
+        try:
+            os.unlink(rec["_path"])
+        except OSError:
+            pass
+        moved += 1
+        if ob is not None:
+            ob.event("serve_fleet.reroute", request=rid, worker=target,
+                     from_worker=worker)
+    return moved
+
+
+def _tombstone_payloads(spool: RequestSpool) -> Dict[str, Dict[str, Any]]:
+    """Payloads of routed-but-unanswered intake tombstones — the resume
+    pass re-routes any that never made it into assigned/ or claimed/."""
+    try:
+        names = sorted(os.listdir(spool.requests_dir))
+    except OSError:
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in names:
+        if not name.endswith(CLAIMED_SUFFIX):
+            continue
+        payload = spool._parse(os.path.join(spool.requests_dir, name))
+        if payload is None or "prompt" not in payload:
+            continue
+        rid = str(payload.get("id") or "")
+        if rid and spool.get_response(rid) is None:
+            out[rid] = payload
+    return out
+
+
+def _shed(spool: RequestSpool, rid: str,
+          payload: Dict[str, Any]) -> None:
+    """Typed load-shed response: the client sees WHY (every live replica
+    past the burn cap), committed first-writer-wins like any response so a
+    racing late replica completion stays benign."""
+    spool.respond_exclusive(
+        Response(id=rid, ok=False,
+                 scenario=str(payload.get("scenario", "chat")),
+                 finish="rejected",
+                 reject_reason=REJECT_ALL_REPLICAS_BURNING,
+                 error=f"admission rejected ({REJECT_ALL_REPLICAS_BURNING})"),
+        holder=ROUTER_HOLDER)
+
+
+def run_serve_fleet(
+    output_dir: str,
+    *,
+    replica_argv: Callable[[str], Sequence[str]],
+    n_replicas: int = 3,
+    replica_ids: Optional[Sequence[str]] = None,
+    replica_env: Optional[Dict[str, str]] = None,
+    lease_s: Optional[float] = None,
+    poll_s: float = 0.2,
+    max_requests: Optional[int] = None,
+    max_wall_s: Optional[float] = None,
+    max_incarnations: Optional[int] = None,
+    supervise_poll: Optional[float] = None,
+    grace: Optional[float] = None,
+    wedge_after: Optional[float] = None,
+    policy: Optional[RetryPolicy] = None,
+    burn_cap: Optional[float] = None,
+    router_seed: int = 0,
+    sleep=time.sleep,
+) -> ServeFleetResult:
+    """Run N supervised serve replicas over one shared request spool until
+    ``max_requests`` responses exist (status ``done``), a drain lands
+    (``drained``, exit 75), or the fleet stalls (every supervisor dead or
+    ``max_wall_s`` exceeded; exit 1).  See the module docstring for the
+    routing / lease / re-spool contract."""
+    t_start = time.monotonic()
+    lease_s = float(lease_s) if lease_s is not None \
+        else fleet_mod.lease_seconds()
+    wids = (list(replica_ids) if replica_ids
+            else [f"w{i}" for i in range(int(n_replicas))])
+    spool = RequestSpool(output_dir, fleet=True)
+    spool.clear_stop()
+    router = BurnRouter(output_dir, wids, burn_cap=burn_cap,
+                        seed=router_seed)
+
+    # Resume pass: a prior coordinator's routed-but-unassigned tombstones
+    # (crash between route_intake and assign) go back into the route queue.
+    known = ({e["id"] for e in spool.assigned_entries()}
+             | {m["id"] for m in spool.claimed_markers()})
+    reroute_queue: Dict[str, Dict[str, Any]] = {
+        rid: payload for rid, payload in _tombstone_payloads(spool).items()
+        if rid not in known}
+
+    results: Dict[str, supervise.SuperviseResult] = {}
+
+    def _supervise_one(wid: str) -> None:
+        results[wid] = supervise.supervise(
+            list(replica_argv(wid)), output_dir, worker_id=wid,
+            max_incarnations=max_incarnations, poll_interval=supervise_poll,
+            grace=grace, wedge_after=wedge_after, policy=policy,
+            env=dict(replica_env or {}))
+
+    threads: List[threading.Thread] = []
+    for wid in wids:
+        t = threading.Thread(target=_supervise_one, args=(wid,),
+                             name=f"serve-replica-{wid}", daemon=True)
+        t.start()
+        threads.append(t)
+
+    issued: Dict[str, int] = {}               # rid -> latest attempt
+    reissue_chains: Dict[str, List[Dict[str, Any]]] = {}
+    reissued_ids: set = set()
+    rerouted_dead: set = set()
+    lease_expiries = 0
+    respooled = 0
+    shed = 0
+    first_expiry_mono: Optional[float] = None
+    recovery_seconds: Optional[float] = None
+    status = "stalled"
+
+    with obs.sweep_observer(output_dir, pipeline="serve-fleet") as ob:
+        ob.event("serve_fleet.start", replicas=list(wids),
+                 lease_s=lease_s,
+                 **({"max_requests": max_requests}
+                    if max_requests is not None else {}))
+
+        def _respool(rid: str, attempt: int, holder: str, lworker: str,
+                     wrapper: Dict[str, Any], target: str,
+                     reason: str) -> None:
+            nonlocal respooled
+            excluded = sorted(set(wrapper.get("excluded", ())) | {holder})
+            nxt = attempt + 1
+            spool.assign(rid, dict(wrapper.get("request") or {}), target,
+                         attempt=nxt, excluded=excluded)
+            spool.release_claimed(rid, attempt, holder)
+            issued[rid] = nxt
+            reissued_ids.add(rid)
+            respooled += 1
+            reissue_chains.setdefault(rid, []).append({
+                "holder": holder, "worker": lworker,
+                "from_attempt": attempt, "to_attempt": nxt,
+                "reason": reason,
+                # tbx: wallclock-ok — serialized metadata for humans
+                "at": time.time()})
+            ob.event("serve_fleet.respool", request=rid, worker=target,
+                     attempt=nxt, excluded=excluded, reason=reason)
+
+        while True:
+            now_mono = time.monotonic()
+            view = router.view()
+
+            # (1) Admission: route intake + resume-queue via burn weights;
+            # shed typed when every live replica is burning; wait when none
+            # is live yet (startup / rolling restart).
+            if BurnRouter.any_alive(view):
+                if BurnRouter.all_burning(view):
+                    for rid in spool.intake_ids():
+                        payload = spool.route_intake(rid)
+                        if payload is None:
+                            continue
+                        _shed(spool, rid, payload)
+                        shed += 1
+                        router.sheds += 1
+                        issued.setdefault(rid, 0)
+                        ob.event("serve_fleet.shed", request=rid,
+                                 reason=REJECT_ALL_REPLICAS_BURNING)
+                else:
+                    for rid, payload in list(reroute_queue.items()):
+                        target = router.pick(view)
+                        if target is None:
+                            break
+                        spool.assign(rid, payload, target, attempt=0)
+                        issued.setdefault(rid, 0)
+                        del reroute_queue[rid]
+                        ob.event("serve_fleet.route", request=rid,
+                                 worker=target, resumed=True)
+                    for rid in spool.intake_ids():
+                        target = router.pick(view)
+                        if target is None:
+                            break
+                        payload = spool.route_intake(rid)
+                        if payload is None:
+                            continue
+                        spool.assign(rid, payload, target, attempt=0)
+                        issued.setdefault(rid, 0)
+                        ob.event("serve_fleet.route", request=rid,
+                                 worker=target,
+                                 fast_burn=view[target]["fast_burn"])
+
+            # (2) Lease expiry → re-spool with the dead holder excluded.
+            # tbx: wallclock-ok — lease deadlines are cross-process epoch
+            now = time.time()
+            leased_keys = set()
+            for lr in spool.lease_store.leases():
+                rid = str(lr.get("uid", ""))
+                attempt = int(lr.get("attempt", 0))
+                holder = str(lr.get("holder", ""))
+                leased_keys.add((rid, attempt))
+                if float(lr.get("expires_at", 0.0)) > now:
+                    continue
+                if spool.get_response(rid) is not None:
+                    # Answered while the lease ran out: pure cleanup.
+                    spool.release_claimed(rid, attempt, holder)
+                    continue
+                marker = os.path.join(
+                    spool.claimed_dir, f"{rid}.a{attempt}.{holder}.json")
+                wrapper = spool._parse(marker)
+                if wrapper is None:
+                    spool.lease_store.drop_lease(rid, attempt)
+                    continue
+                target = router.pick(view)
+                if target is None:
+                    continue       # no live replica; lease stays expired
+                lease_expiries += 1
+                if first_expiry_mono is None:
+                    first_expiry_mono = now_mono
+                ob.event("serve_fleet.lease_expired", request=rid,
+                         holder=holder, worker=str(lr.get("worker", "")),
+                         attempt=attempt)
+                _respool(rid, attempt, holder, str(lr.get("worker", "")),
+                         wrapper, target, "lease-expired")
+
+            # (3) Orphaned claims: a claimed marker with NO lease (the
+            # replica died in the claim→first-lease window, or dropped its
+            # leases at shutdown).  The marker-age grace skips claims whose
+            # first lease write is simply still in flight.
+            for m in spool.claimed_markers():
+                rid, attempt = m["id"], m["attempt"]
+                if (rid, attempt) in leased_keys:
+                    continue
+                if spool.get_response(rid) is not None:
+                    spool.release_claimed(rid, attempt, m["holder"])
+                    continue
+                try:
+                    age = now - os.path.getmtime(m["_path"])
+                except OSError:
+                    continue
+                if age <= lease_s:
+                    continue
+                target = router.pick(view)
+                if target is None:
+                    continue
+                if first_expiry_mono is None:
+                    first_expiry_mono = now_mono
+                wrapper = spool._parse(m["_path"]) or {}
+                ob.event("serve_fleet.lease_expired", request=rid,
+                         holder=m["holder"], worker="", attempt=attempt,
+                         orphaned=True)
+                lease_expiries += 1
+                _respool(rid, attempt, m["holder"], "", wrapper, target,
+                         "orphaned-claim")
+
+            # (4) A replica whose supervisor FINISHED is gone for good —
+            # its unclaimed backlog moves to live replicas (drain contract:
+            # rolling restarts never reach here; budget exhaustion does).
+            for wid, t in zip(wids, threads):
+                if t.is_alive() or wid in rerouted_dead:
+                    continue
+                if reroute_orphans(spool, router, wid, view=view, ob=ob) \
+                        or not spool.assigned_entries(wid):
+                    rerouted_dead.add(wid)
+
+            # (5) Recovery clock: first expiry → every re-spooled request
+            # answered (the serve_fleet_recovery bench headline).
+            if (first_expiry_mono is not None and recovery_seconds is None
+                    and reissued_ids
+                    and all(spool.get_response(r) is not None
+                            for r in reissued_ids)):
+                recovery_seconds = now_mono - first_expiry_mono
+                ob.event("serve_fleet.recovered",
+                         requests=sorted(reissued_ids),
+                         seconds=round(recovery_seconds, 3))
+                # Rides the existing fleet_recovery SLO target: serve-fleet
+                # recovery is the same promise at request granularity.
+                obs_metrics.histogram(
+                    "fleet.recovery_seconds").observe(recovery_seconds)
+
+            completed = spool.completed_count()
+            obs_metrics.gauge("serve_fleet.completed").set(completed)
+            obs_metrics.gauge("serve_fleet.shed").set(shed)
+
+            if supervise.drain_requested():
+                status = "drained"
+                ob.mark_drained()
+                break
+            if (max_requests is not None and completed >= max_requests
+                    and not spool.intake_ids() and not reroute_queue):
+                status = "done"
+                break
+            if all(not t.is_alive() for t in threads):
+                status = "stalled"
+                break
+            if max_wall_s is not None and now_mono - t_start > max_wall_s:
+                status = "stalled"
+                break
+            sleep(poll_s)
+
+        # Goal reached (or fleet abandoned): stop the replicas and wait for
+        # their supervisors to fold per-worker artifacts.
+        spool.write_stop()
+        for t in threads:
+            t.join(timeout=max(60.0, 6.0 * lease_s))
+
+        unanswered = [rid for rid in sorted(issued)
+                      if spool.get_response(rid) is None]
+        if status == "done" and unanswered:
+            status = "stalled"
+        ob.event("serve_fleet.exit", status=status,
+                 completed=spool.completed_count(), shed=shed,
+                 respooled=respooled, lease_expiries=lease_expiries,
+                 duplicates=spool.duplicate_count(),
+                 unanswered=len(unanswered))
+
+    if status == "drained":
+        exit_code = supervise.EXIT_DRAINED
+    else:
+        exit_code = 0 if status == "done" else 1
+    result = ServeFleetResult(
+        status=status, exit_code=exit_code,
+        requests_total=len(issued), completed=spool.completed_count(),
+        shed=shed, respooled=respooled, lease_expiries=lease_expiries,
+        duplicate_commits=spool.duplicate_count(),
+        recovery_seconds=(round(recovery_seconds, 3)
+                          if recovery_seconds is not None else None),
+        wall_seconds=round(time.monotonic() - t_start, 3),
+        replicas=[{
+            "worker_id": wid,
+            "status": results[wid].status if wid in results else "unknown",
+            "exit_code": (results[wid].exit_code
+                          if wid in results else None),
+            "incarnations": (len(results[wid].incarnations)
+                             if wid in results else 0),
+        } for wid in wids],
+        reissue_chains=reissue_chains,
+        router={"burn_cap": router.burn_cap, "routed": dict(router.routed),
+                "sheds": router.sheds})
+    merge_serve_fleet_artifacts(output_dir, wids, result=result)
+    return result
+
+
+def merge_serve_fleet_artifacts(output_dir: str, worker_ids: Sequence[str],
+                                *, result: ServeFleetResult) -> None:
+    """Fold per-replica streams into the run-level views (reusing the fleet
+    mergers — ServeFleetResult duck-types the fields merge_ledgers reads)
+    and persist ``_serve_fleet.json``.  Fail-open: a merge failure must not
+    eat the fleet result."""
+    for step in (
+            lambda: fleet_mod.merge_events(output_dir, worker_ids),
+            lambda: fleet_mod.merge_metrics(output_dir, worker_ids),
+            lambda: fleet_mod.merge_ledgers(output_dir, worker_ids,
+                                            result=result)):
+        try:
+            step()
+        except Exception:  # noqa: BLE001 — merge is best-effort
+            pass
+    try:
+        from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+
+        atomic_json_dump(result.to_dict(),
+                         os.path.join(output_dir,
+                                      SERVE_FLEET_SUMMARY_FILENAME))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Chaos selfcheck (the `tbx serve-fleet --selfcheck` CI gate).
+# ---------------------------------------------------------------------------
+
+_MIX_SCENARIOS = ("chat", "sae_ablate", "forcing")
+
+
+def chaos_smoke(output_dir: str, *, n_requests: int = 12,
+                n_replicas: int = 3, lease_s: float = 3.0,
+                max_wall_s: float = 600.0,
+                fault_plan: Optional[Dict[str, Any]] = None,
+                ) -> ServeFleetResult:
+    """One chaos round over synthetic replicas: spool ``n_requests`` mixed
+    requests, kill replica w1 at its FIRST response commit
+    (``serve.respond`` die, incarnation 0), and run the fleet to
+    completion.  There is no speculative re-dispatch in the serve fleet —
+    recovery MUST heal through the lease-expiry→re-spool path — which is
+    exactly what the asserting callers (selfcheck, bench) verify."""
+    spool = RequestSpool(output_dir, fleet=True)
+
+    # Feed the spool only once EVERY replica heartbeats as running, so the
+    # router spreads the batch across the whole fleet and the w1-targeted
+    # fault deterministically gets work to die on (pre-spooling would race
+    # replica startup and could route everything to the first one up).
+    def _feed() -> None:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            views = [read_progress(
+                os.path.join(output_dir, f"_progress.w{i}.json"),
+                missing_ok=True) for i in range(int(n_replicas))]
+            if all(v.get("status") == "running" for v in views):
+                break
+            time.sleep(0.1)
+        for i in range(int(n_requests)):
+            spool.put({"id": f"r{i:03d}",
+                       "prompt": f"selfcheck request {i}",
+                       "scenario": _MIX_SCENARIOS[i % len(_MIX_SCENARIOS)],
+                       "seed": i})
+
+    feeder = threading.Thread(target=_feed, name="serve-fleet-feeder",
+                              daemon=True)
+    feeder.start()
+    plan = fault_plan if fault_plan is not None else {
+        "serve.respond": [
+            {"mode": "die", "times": 1, "match": "w1", "incarnation": 0}]}
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "TABOO_FAULT_PLAN": json.dumps(plan),
+        "TBX_OBS_PROGRESS_S": "0.2",
+        "TBX_SUPERVISE_BACKOFF_S": "0",
+    }
+
+    def argv(wid: str) -> List[str]:
+        return [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+                "--synthetic", "--output-dir", output_dir, "--replica",
+                "--slots", "4", "--queue-limit", "6",
+                "--max-new-tokens", "4", "--poll", "0.05",
+                "--lease", str(lease_s)]
+
+    try:
+        return run_serve_fleet(
+            output_dir, replica_argv=argv, n_replicas=n_replicas,
+            replica_env=env, lease_s=lease_s, poll_s=0.2,
+            max_requests=int(n_requests), max_wall_s=max_wall_s,
+            max_incarnations=4, supervise_poll=0.2, grace=2.0,
+            wedge_after=30.0,
+            policy=RetryPolicy(max_retries=6, base_delay=0.0))
+    finally:
+        # The run can only finish "done" after every fed request is
+        # answered, so the feeder is already past its puts by then; the
+        # bounded join covers the stalled-run paths.
+        feeder.join(timeout=130.0)
+
+
+def selfcheck(output_dir: str, *, n_requests: int = 12) -> Dict[str, Any]:
+    """Assert the chaos contract: every spooled request answered EXACTLY
+    once (duplicates parked, not merged), recovery went through the lease
+    path (>=1 expiry, >=1 re-spool), and nothing on disk is corrupt."""
+    result = chaos_smoke(output_dir, n_requests=n_requests)
+    spool = RequestSpool(output_dir, fleet=True)
+    problems: List[str] = []
+    if result.status != "done" or result.exit_code != 0:
+        problems.append(
+            f"fleet status {result.status} exit {result.exit_code}")
+    rids = [f"r{i:03d}" for i in range(n_requests)]
+    unanswered = [r for r in rids if spool.get_response(r) is None]
+    if unanswered:
+        problems.append(f"unanswered requests: {unanswered}")
+    try:
+        n_responses = sum(1 for n in os.listdir(spool.responses_dir)
+                          if n.endswith(".json"))
+    except OSError:
+        n_responses = -1
+    if n_responses != n_requests:
+        problems.append(
+            f"expected exactly {n_requests} responses, found {n_responses} "
+            "(duplicates must park in _duplicates/, never merge)")
+    if result.lease_expiries < 1:
+        problems.append("no lease expiry — the die fault did not bite")
+    if result.respooled < 1:
+        problems.append("no re-spool — recovery did not use the lease path")
+    corrupt = [os.path.join(r, n) for r, _, files in os.walk(output_dir)
+               for n in files if n.endswith(".corrupt")]
+    if corrupt:
+        problems.append(f"corrupt artifacts: {corrupt}")
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "result": result.to_dict(),
+    }
+
+
+def main_selfcheck() -> int:
+    """``tbx serve-fleet --selfcheck``: run the chaos smoke in a temp dir
+    and print the verdict."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="tbx-serve-fleet-selfcheck-")
+    try:
+        verdict = selfcheck(os.path.join(tmp, "fleet"))
+        out = {"ok": verdict["ok"], "problems": verdict["problems"],
+               "status": verdict["result"]["status"],
+               "completed": verdict["result"]["completed"],
+               "respooled": verdict["result"]["respooled"],
+               "lease_expiries": verdict["result"]["lease_expiries"],
+               "duplicate_responses": verdict["result"]["duplicate_commits"],
+               "recovery_seconds": verdict["result"]["recovery_seconds"]}
+        # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict)
+        print(json.dumps(out, indent=2))
+        return 0 if verdict["ok"] else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -50,7 +50,18 @@ from taboo_brittleness_tpu.obs import flightrec
 from taboo_brittleness_tpu.obs import metrics as obs_metrics
 from taboo_brittleness_tpu.obs import timeseries
 from taboo_brittleness_tpu.runtime import chat, resilience
+from taboo_brittleness_tpu.runtime.resilience import current_worker_id
 from taboo_brittleness_tpu.serve.engine import ServeEngine
+
+#: Typed admission-rejection reasons (ISSUE 17): every rejected submit and
+#: every rejected :class:`Response` carries exactly one of these, so the
+#: router, the spool, and the tests key off constants instead of prose.
+REJECT_DRAINING = "draining"
+REJECT_QUEUE_FULL = "queue-full"
+REJECT_UNKNOWN_WORD = "unknown-word"
+REJECT_PROMPT_TOO_LONG = "prompt-too-long"
+REJECT_UNKNOWN_SCENARIO = "unknown-scenario"   # server-side (pre-submit)
+REJECT_ALL_REPLICAS_BURNING = "all-replicas-burning"  # router shed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +143,12 @@ class Response:
     latency_seconds: float = 0.0
     lens_probs: Optional[List[float]] = None
     error: Optional[str] = None
+    # Which replica worker answered (``TBX_WORKER_ID``; None standalone) —
+    # the serve-fleet e2e reads this to prove re-spooled requests were
+    # answered by a replica other than the dead holder.
+    replica: Optional[str] = None
+    # Typed admission-rejection reason (REJECT_*; None when served).
+    reject_reason: Optional[str] = None
     # Speculation accounting (always 0/None on a vanilla engine).
     drafted: int = 0
     accepted: int = 0
@@ -184,6 +201,10 @@ class SlotScheduler:
         self.rejected = 0
         self.completed = 0
         self.quarantined = 0
+        # Why the most recent submit() returned False (a REJECT_* constant):
+        # the caller builds its typed rejected Response from this without
+        # changing the bool submit contract.
+        self.last_reject_reason: Optional[str] = None
 
     # -- introspection -------------------------------------------------------
 
@@ -207,28 +228,19 @@ class SlotScheduler:
         shape envelope.  True = the request WILL be served (queued or
         admitted on the next ``step``)."""
         if self.draining or len(self._queue) >= self.queue_limit:
-            self.rejected += 1
-            obs_metrics.counter("serve.rejected").inc()
-            obs.event("serve.reject", request=req.id,
-                      scenario=req.scenario.name,
-                      reason="draining" if self.draining else "queue-full")
+            self._reject(req, REJECT_DRAINING if self.draining
+                         else REJECT_QUEUE_FULL)
             return False
         if self.engine.word_index(req.word) is None:
             # Admission is by (word, scenario): a word this engine does not
             # hold resident is an explicit rejection, not a silent default.
-            self.rejected += 1
-            obs_metrics.counter("serve.rejected").inc()
-            obs.event("serve.reject", request=req.id,
-                      scenario=req.scenario.name, word=req.word,
-                      reason="unknown-word")
+            self._reject(req, REJECT_UNKNOWN_WORD, word=req.word)
             return False
         ids = self._encode(req)
         if not self.engine.capacity_ok(len(ids), req.scenario.max_new_tokens):
-            self.rejected += 1
-            obs_metrics.counter("serve.rejected").inc()
-            obs.event("serve.reject", request=req.id,
-                      scenario=req.scenario.name, reason="prompt-too-long")
+            self._reject(req, REJECT_PROMPT_TOO_LONG)
             return False
+        self.last_reject_reason = None
         req.submitted_at = self._clock()
         self._queue.append(req)
         obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
@@ -236,6 +248,19 @@ class SlotScheduler:
                   scenario=req.scenario.name, prompt_tokens=len(ids))
         self._fill_slots()
         return True
+
+    def _reject(self, req: Request, reason: str, **attrs: Any) -> None:
+        self.rejected += 1
+        self.last_reject_reason = reason
+        obs_metrics.counter("serve.rejected").inc()
+        obs.event("serve.reject", request=req.id,
+                  scenario=req.scenario.name, reason=reason, **attrs)
+
+    def active_ids(self) -> List[str]:
+        """Request ids this scheduler currently owns (queued + in-flight) —
+        the server's mid-run claimed-but-unanswered audit subtracts these."""
+        return ([s.request.id for s in self._sessions.values()]
+                + [r.id for r in self._queue])
 
     def drain(self) -> None:
         """Stop admitting; in-flight AND already-queued sessions run to
@@ -323,8 +348,11 @@ class SlotScheduler:
                                    for s in self._sessions.values()])
         for slot, sess in list(self._sessions.items()):
             try:
+                # ``worker`` joins the context so a fleet chaos plan can
+                # poison ONE replica (match: "w1") instead of one request.
                 resilience.fire("serve.step", request=sess.request.id,
-                                scenario=sess.request.scenario.name)
+                                scenario=sess.request.scenario.name,
+                                worker=current_worker_id() or "")
                 if self._speculative:
                     self._fire_spec_verify(sess)
             except Exception as exc:  # noqa: BLE001 — quarantine one session
@@ -409,6 +437,7 @@ class SlotScheduler:
             lens_probs=(list(sess.lens_probs)
                         if req.scenario.lens_readout else None),
             error=f"{type(exc).__name__}: {exc}"[:300] if exc else None,
+            replica=current_worker_id(),
             drafted=sess.drafted, accepted=sess.accepted,
             exited_early=sess.early,
             early_agreement=(round(sess.early_agree / sess.early, 4)

@@ -11,11 +11,35 @@ exactly this loop.
 Layout under ``<output_dir>``::
 
     requests/<id>.json             a submitted request (atomic write)
-    requests/<id>.json.claimed     ...claimed by the server (rename)
-    responses/<id>.json            the response (atomic write)
+    requests/<id>.json.claimed     ...claimed by the server (rename); GC'd
+                                   once the response exists
+    responses/<id>.json            the response (atomic write; in fleet mode
+                                   an os.link first-writer-wins commit)
     _progress.json                 serving-mode heartbeat (obs.progress)
     _events.jsonl                  span/point stream (obs.trace)
     _serve.json                    exit summary incl. AOT step-program stats
+
+Replica-fleet mode (ISSUE 17; ``tbx serve-fleet`` / ``serve.replica``) adds
+the leased-ownership layout generalized from ``runtime.fleet``::
+
+    assigned/<wid>/<id>.a<k>.json  request routed to replica <wid> at
+                                   attempt k (wrapper: id/attempt/excluded/
+                                   request payload)
+    claimed/<id>.a<k>.<holder>.json  ...claimed by one replica incarnation
+                                   (rename; exactly-one-winner)
+    leases/<id>.a<k>.json          time-bounded ownership, renewed by the
+                                   replica's ServeLeaseKeeper thread; an
+                                   expired lease lets the coordinator
+                                   RE-SPOOL the request with the dead
+                                   holder excluded
+    responses/_duplicates/         first-writer-wins losers (benign)
+    _stop                          coordinator's "goal reached" marker
+
+In fleet mode the coordinator routes intake (``requests/``) onto replicas;
+a replica's telemetry lands in per-worker files (``_progress.<wid>.json``,
+``_events.<wid>.jsonl``, ``_metrics.<wid>.jsonl``) exactly as fleet sweep
+workers do, so ``supervise(worker_id=)`` and the fleet merge apply
+unchanged.
 
 Request schema: ``{"id": str, "prompt": str, "scenario": str,
 "seed": int?, "max_new_tokens": int?, "word": str?}`` — ``scenario`` names
@@ -46,35 +70,69 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
+import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from taboo_brittleness_tpu import obs
+from taboo_brittleness_tpu.obs import flightrec
 from taboo_brittleness_tpu.obs.progress import (
     PROGRESS_FILENAME, ProgressReporter)
 from taboo_brittleness_tpu.obs.trace import EVENTS_FILENAME
-from taboo_brittleness_tpu.runtime import supervise
-from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.fleet import (
+    LeaseStore, exclusive_commit, holder_token, lease_seconds)
+from taboo_brittleness_tpu.runtime.resilience import (
+    atomic_json_dump, current_worker_id)
 from taboo_brittleness_tpu.serve.engine import ServeEngine
 from taboo_brittleness_tpu.serve.scheduler import (
-    Request, Response, Scenario, SlotScheduler)
+    REJECT_UNKNOWN_SCENARIO, Request, Response, Scenario, SlotScheduler)
 
 SERVE_SUMMARY_FILENAME = "_serve.json"
 REQUESTS_DIRNAME = "requests"
 RESPONSES_DIRNAME = "responses"
 CLAIMED_SUFFIX = ".claimed"
+ASSIGNED_DIRNAME = "assigned"
+CLAIMED_DIRNAME = "claimed"
+LEASES_DIRNAME = "leases"
+DUPLICATES_DIRNAME = "_duplicates"
+STOP_MARKER = "_stop"
+
+#: How often the serve loop sweeps resolved ``.claimed`` tombstones (the
+#: GC satellite): cheap, but not every 50ms poll.
+_GC_INTERVAL_S = 2.0
+
+_ASSIGNED_RE = re.compile(r"(.+)\.a(\d+)\.json$")
+_CLAIMED_RE = re.compile(r"(.+)\.a(\d+)\.(.+)\.json$")
 
 
 class RequestSpool:
-    """Filesystem request/response exchange (see module docstring)."""
+    """Filesystem request/response exchange (see module docstring).
 
-    def __init__(self, root: str):
+    ``fleet=True`` grows the replica-fleet layout: routed assignments,
+    holder-stamped leased claims, first-writer-wins responses — the
+    ``runtime.fleet`` ownership machinery applied to requests."""
+
+    def __init__(self, root: str, *, fleet: bool = False):
         self.root = root
+        self.fleet = bool(fleet)
         self.requests_dir = os.path.join(root, REQUESTS_DIRNAME)
         self.responses_dir = os.path.join(root, RESPONSES_DIRNAME)
+        self.assigned_dir = os.path.join(root, ASSIGNED_DIRNAME)
+        self.claimed_dir = os.path.join(root, CLAIMED_DIRNAME)
+        self.leases_dir = os.path.join(root, LEASES_DIRNAME)
+        self.duplicates_dir = os.path.join(self.responses_dir,
+                                           DUPLICATES_DIRNAME)
+        self.lease_store = LeaseStore(self.leases_dir)
+        self._last_gc: Optional[float] = None
         os.makedirs(self.requests_dir, exist_ok=True)
         os.makedirs(self.responses_dir, exist_ok=True)
+        if self.fleet:
+            for d in (self.assigned_dir, self.claimed_dir, self.leases_dir,
+                      self.duplicates_dir):
+                os.makedirs(d, exist_ok=True)
 
     # -- client side --------------------------------------------------------
 
@@ -161,6 +219,324 @@ class RequestSpool:
         except OSError:
             return 0
 
+    # -- claimed-file GC / mid-run audit (ISSUE 17 satellites) ---------------
+
+    def claimed_unanswered(self) -> List[str]:
+        """Ids of intake ``.claimed`` tombstones with no response yet —
+        either in-flight (this server's scheduler owns them) or ORPHANED
+        (claimed by a process that died): the mid-run audit subtracts the
+        scheduler's active set to tell them apart."""
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(CLAIMED_SUFFIX):
+                continue
+            payload = self._parse(os.path.join(self.requests_dir, name))
+            rid = str((payload or {}).get("id")
+                      or name[:-len(CLAIMED_SUFFIX)].rsplit(".json", 1)[0])
+            if rid and self.get_response(rid) is None:
+                out.append(rid)
+        return out
+
+    def gc_claimed(self, *, force: bool = False) -> Optional[int]:
+        """Remove ``.claimed`` tombstones whose response exists — without
+        this a long-lived server's requests dir grows one dead file per
+        completed request, forever.  Throttled to every ``_GC_INTERVAL_S``
+        unless ``force`` (the drain path sweeps unconditionally); returns
+        the number removed, or None when the throttle skipped the sweep."""
+        now = time.monotonic()
+        if (not force and self._last_gc is not None
+                and now - self._last_gc < _GC_INTERVAL_S):
+            return None
+        self._last_gc = now
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            return 0
+        removed = 0
+        for name in names:
+            if not name.endswith(CLAIMED_SUFFIX):
+                continue
+            path = os.path.join(self.requests_dir, name)
+            payload = self._parse(path)
+            rid = str((payload or {}).get("id")
+                      or name[:-len(CLAIMED_SUFFIX)].rsplit(".json", 1)[0])
+            if rid and self.get_response(rid) is not None:
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    # -- stop marker (fleet coordinator -> replicas) -------------------------
+
+    def write_stop(self) -> None:
+        atomic_json_dump({"stopped": True},
+                         os.path.join(self.root, STOP_MARKER))
+
+    def clear_stop(self) -> None:
+        try:
+            os.unlink(os.path.join(self.root, STOP_MARKER))
+        except OSError:
+            pass
+
+    def stopped(self) -> bool:
+        return os.path.exists(os.path.join(self.root, STOP_MARKER))
+
+    # -- fleet coordinator side (serve.replica) ------------------------------
+
+    def route_intake(self, rid: str) -> Optional[Dict[str, Any]]:
+        """Claim one intake file for ROUTING (coordinator side): rename to
+        the ``.claimed`` tombstone (exactly-one-winner), return the payload.
+        The tombstone stays until the response lands (then GC'd), so a
+        coordinator crash between route and assign is recoverable — the
+        resume pass re-routes claimed-but-unassigned requests."""
+        path = os.path.join(self.requests_dir, f"{rid}.json")
+        payload = self._parse(path)
+        if payload is None or "prompt" not in payload:
+            return None
+        try:
+            os.replace(path, path + CLAIMED_SUFFIX)
+        except OSError:
+            return None
+        return payload
+
+    def intake_ids(self) -> List[str]:
+        """Unrouted intake request ids (parseable, prompt present)."""
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            payload = self._parse(os.path.join(self.requests_dir, name))
+            if payload is not None and "prompt" in payload:
+                out.append(str(payload.get("id") or name[:-5]))
+        return out
+
+    def assign(self, rid: str, payload: Dict[str, Any], worker: str, *,
+               attempt: int = 0, excluded: Any = ()) -> str:
+        """Issue (or re-spool) one request to ``assigned/<worker>/``.
+        Atomic write; re-spools are new files at ``attempt+1`` carrying the
+        holders excluded from reclaiming it."""
+        d = os.path.join(self.assigned_dir, worker)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{rid}.a{int(attempt)}.json")
+        atomic_json_dump({"v": 1, "id": rid, "attempt": int(attempt),
+                          "excluded": sorted(set(excluded)),
+                          "request": payload}, path)
+        return path
+
+    def assigned_entries(self, worker: Optional[str] = None,
+                         ) -> List[Dict[str, Any]]:
+        """Parsed assignment wrappers (``_path``/``_worker`` added), for one
+        replica or all of them."""
+        try:
+            workers = [worker] if worker else sorted(
+                os.listdir(self.assigned_dir))
+        except OSError:
+            return []
+        out = []
+        for wid in workers:
+            d = os.path.join(self.assigned_dir, wid)
+            try:
+                names = sorted(os.listdir(d))
+            except OSError:
+                continue
+            for name in names:
+                if not _ASSIGNED_RE.match(name):
+                    continue
+                rec = self._parse(os.path.join(d, name))
+                if rec is not None:
+                    rec["_path"] = os.path.join(d, name)
+                    rec["_worker"] = wid
+                    out.append(rec)
+        return out
+
+    def claimed_markers(self) -> List[Dict[str, Any]]:
+        """``[{id, attempt, holder, _path}]`` parsed from claimed/ names."""
+        try:
+            names = sorted(os.listdir(self.claimed_dir))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _CLAIMED_RE.match(name)
+            if m:
+                out.append({"id": m.group(1), "attempt": int(m.group(2)),
+                            "holder": m.group(3),
+                            "_path": os.path.join(self.claimed_dir, name)})
+        return out
+
+    # -- fleet replica side --------------------------------------------------
+
+    def claim_assigned(self, worker: str, holder: str,
+                       limit: int) -> List[Dict[str, Any]]:
+        """Claim up to ``limit`` of this replica's assignments under the
+        rename-exclusive contract (``serve.claim`` fault site fires per
+        attempt).  Assignments of already-answered requests are GC'd on the
+        way; assignments excluding this holder (a restarted predecessor's
+        re-spools) are left for the coordinator to reroute."""
+        if limit <= 0:
+            return []
+        d = os.path.join(self.assigned_dir, worker)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        out: List[Dict[str, Any]] = []
+        for name in names:
+            if len(out) >= limit:
+                break
+            if not _ASSIGNED_RE.match(name):
+                continue
+            src = os.path.join(d, name)
+            rec = self._parse(src)
+            if rec is None:
+                continue                    # mid-flight assign; later poll
+            rid = str(rec.get("id", ""))
+            if not rid:
+                continue
+            if self.get_response(rid) is not None:
+                # A stale re-spooled copy of an answered request: GC it
+                # instead of decoding it again.
+                try:
+                    os.unlink(src)
+                except OSError:
+                    pass
+                continue
+            if holder in rec.get("excluded", ()):
+                continue
+            resilience.fire("serve.claim", request=rid, worker=worker,
+                            holder=holder)
+            dst = os.path.join(
+                self.claimed_dir,
+                f"{rid}.a{int(rec.get('attempt', 0))}.{holder}.json")
+            try:
+                os.replace(src, dst)
+            except OSError:
+                continue                    # raced / vanished; scan on
+            flightrec.record("serve.claim", request=rid,
+                             attempt=int(rec.get("attempt", 0)),
+                             worker=worker)
+            out.append(rec)
+        return out
+
+    def respond_exclusive(self, resp: Response, *, holder: str) -> bool:
+        """First-writer-wins response commit (``os.link`` exclusive via
+        ``fleet.exclusive_commit``): duplicate completions from re-spooled
+        or raced replicas park in ``responses/_duplicates/`` — benign by
+        construction.  The ``serve.respond`` fault site fires BEFORE the
+        link: a ``die`` here is the "replica killed at first commit"
+        chaos case."""
+        resilience.fire("serve.respond", request=resp.id,
+                        worker=current_worker_id() or "", holder=holder)
+        won = exclusive_commit(self.response_path(resp.id), resp.to_dict(),
+                               holder=holder,
+                               duplicates_dir=self.duplicates_dir)
+        flightrec.record("serve.respond", request=resp.id, won=won)
+        return won
+
+    def release_claimed(self, rid: str, attempt: int, holder: str) -> None:
+        """Post-response cleanup: drop the lease and the claimed marker."""
+        self.lease_store.drop_lease(rid, attempt)
+        try:
+            os.unlink(os.path.join(self.claimed_dir,
+                                   f"{rid}.a{attempt}.{holder}.json"))
+        except OSError:
+            pass
+
+    def duplicate_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.duplicates_dir)
+                       if n.endswith(".json"))
+        except OSError:
+            return 0
+
+
+class ServeLeaseKeeper:
+    """ONE renewal thread for ALL of a replica's held request leases —
+    the per-unit :class:`runtime.fleet.LeaseKeeper` generalized to a
+    many-requests holder (a replica holds up to ``queue_limit`` leases; a
+    thread per request would not scale).
+
+    Renewal is fail-open: a failed renewal (transient IO, injected
+    ``serve.lease_renew`` fault) lets that request's lease expire and the
+    coordinator re-spool it — first-writer-wins makes the eventual double
+    completion a counted duplicate, never a conflict.  A ``die``-mode fault
+    at the renewal site kills the whole replica, the mid-decode SIGKILL the
+    chaos tests arm."""
+
+    def __init__(self, store: LeaseStore, *, holder: str, worker: str,
+                 lease_s: float):
+        self.store = store
+        self.holder = holder
+        self.worker = worker
+        self.lease_s = float(lease_s)
+        self._held: Dict[Tuple[str, int], float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, rid: str, attempt: int) -> None:
+        """Start leasing one claimed request (writes the first lease
+        synchronously, so ownership is on disk before the request is
+        admitted)."""
+        # tbx: wallclock-ok — cross-process lease timestamps use the epoch
+        now = time.time()
+        with self._lock:
+            self._held[(rid, int(attempt))] = now
+        self.store.write_lease(rid, int(attempt), self.holder, self.worker,
+                               self.lease_s, claimed_at=now)
+
+    def remove(self, rid: str, attempt: int) -> None:
+        with self._lock:
+            self._held.pop((rid, int(attempt)), None)
+
+    def start(self) -> "ServeLeaseKeeper":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"serve-lease-{self.worker}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        interval = max(0.1, self.lease_s / 3.0)
+        while not self._stop.wait(interval):
+            with self._lock:
+                held = dict(self._held)
+            for (rid, attempt), claimed_at in sorted(held.items()):
+                try:
+                    resilience.fire("serve.lease_renew", request=rid,
+                                    worker=self.worker, holder=self.holder)
+                    self.store.write_lease(rid, attempt, self.holder,
+                                           self.worker, self.lease_s,
+                                           claimed_at=claimed_at)
+                    flightrec.record("serve.lease_renew", request=rid,
+                                     attempt=attempt)
+                except Exception:  # noqa: BLE001 — fail-open; expiry is benign
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        # Any lease still held at shutdown is dropped so the coordinator
+        # re-spools immediately instead of waiting out the expiry.
+        with self._lock:
+            held = sorted(self._held)
+            self._held.clear()
+        for rid, attempt in held:
+            self.store.drop_lease(rid, attempt)
+
 
 @dataclasses.dataclass
 class ServeResult:
@@ -195,6 +571,8 @@ def serve_forever(
     queue_limit: int = 64,
     max_requests: Optional[int] = None,
     poll_s: float = 0.05,
+    replica: bool = False,
+    lease_s: Optional[float] = None,
     idle_sleep=time.sleep,
     clock=time.monotonic,
 ) -> ServeResult:
@@ -205,27 +583,45 @@ def serve_forever(
     ``max_requests`` counts responses ON DISK (including prior
     incarnations') so a supervised relaunch resumes toward the same goal
     instead of restarting the count.
+
+    ``replica=True`` is fleet mode (ISSUE 17; launched by ``serve.replica``
+    under ``supervise(worker_id=)``): instead of claiming raw intake the
+    loop claims its ``assigned/<wid>/`` routed requests under time-bounded
+    leases (one :class:`ServeLeaseKeeper` renews them all), commits
+    responses first-writer-wins, and exits 0 when the coordinator writes
+    the ``_stop`` marker.  Startup ``recover()`` is skipped — in fleet mode
+    a dead replica's claims come back via lease expiry + coordinator
+    re-spool, never self-rescue.
     """
     os.makedirs(output_dir, exist_ok=True)
-    spool = RequestSpool(output_dir)
-    tracer = obs.activate(os.path.join(output_dir, EVENTS_FILENAME),
+    spool = RequestSpool(output_dir, fleet=replica)
+    # A fleet replica's telemetry is per-worker (same contract as sweep
+    # fleet workers) so N replicas share the directory without interleaving
+    # seq counters, and the supervisor watches _progress.<wid>.json.
+    wid = current_worker_id()
+    events_name = (EVENTS_FILENAME if wid is None
+                   else f"_events.{wid}.jsonl")
+    progress_name = (PROGRESS_FILENAME if wid is None
+                     else f"_progress.{wid}.json")
+    tracer = obs.activate(os.path.join(output_dir, events_name),
                           run_id=uuid.uuid4().hex[:12]) if obs.enabled() else None
     run_span = None
     reporter = None
     recorder = None
     slo_engine = None
     if tracer is not None:
-        from taboo_brittleness_tpu.obs import flightrec, slo, timeseries
+        from taboo_brittleness_tpu.obs import slo, timeseries
         from taboo_brittleness_tpu.runtime.resilience import (
-            current_incarnation, current_worker_id)
+            current_incarnation)
 
         inc = current_incarnation()
         run_span = tracer.span(
             "serve", kind="run", pipeline="serve",
             slots=engine.ec.slots, scenarios=sorted(scenarios),
-            **({"incarnation": inc} if inc else {}))
+            **({"incarnation": inc} if inc else {}),
+            **({"worker": wid} if wid else {}))
         reporter = ProgressReporter(
-            os.path.join(output_dir, PROGRESS_FILENAME),
+            os.path.join(output_dir, progress_name),
             total_words=0, run_id=tracer.run_id, tracer=tracer).start()
         reporter.serving_update(in_flight=0,
                                 completed=spool.completed_count())
@@ -246,9 +642,33 @@ def serve_forever(
             recorder = None
             slo_engine = None
 
+    worker = wid or "serve"
+    holder = holder_token(worker) if replica else None
+    keeper: Optional[ServeLeaseKeeper] = None
+    held: Dict[str, int] = {}       # rid -> attempt (this holder's claims)
+    if replica:
+        keeper = ServeLeaseKeeper(
+            spool.lease_store, holder=holder, worker=worker,
+            lease_s=lease_s if lease_s is not None
+            else lease_seconds()).start()
+
+    def _respond(resp: Response) -> None:
+        """Response writer: plain atomic in single mode; first-writer-wins
+        commit + lease/claim release in fleet mode."""
+        if not replica:
+            spool.respond(resp)
+            return
+        attempt = held.pop(resp.id, 0)
+        won = spool.respond_exclusive(resp, holder=holder)
+        if keeper is not None:
+            keeper.remove(resp.id, attempt)
+        spool.release_claimed(resp.id, attempt, holder)
+        obs.event("serve.respond", request=resp.id, attempt=attempt,
+                  duplicate=not won)
+
     sched = SlotScheduler(engine, queue_limit=queue_limit,
                           lens_target_id=lens_target_id,
-                          on_complete=spool.respond, clock=clock)
+                          on_complete=_respond, clock=clock)
     warm = engine.warm_start()
     obs.event("serve.warm_start", **{k: v for k, v in warm.items()
                                      if k in ("source", "trace_seconds",
@@ -260,20 +680,64 @@ def serve_forever(
         explicit rejected response instead of dropping it silently."""
         req = _to_request(payload, scenarios)
         if req is None:
-            spool.respond(Response(
+            _respond(Response(
                 id=str(payload.get("id")), ok=False,
                 scenario=str(payload.get("scenario")),
-                finish="rejected", error="unknown scenario"))
+                finish="rejected", replica=wid,
+                reject_reason=REJECT_UNKNOWN_SCENARIO,
+                error="unknown scenario"))
             return
         if not sched.submit(req):
-            spool.respond(Response(
+            reason = sched.last_reject_reason
+            _respond(Response(
                 id=req.id, ok=False, scenario=req.scenario.name,
-                finish="rejected",
-                error="admission rejected (capacity envelope or draining)"))
+                finish="rejected", replica=wid, reject_reason=reason,
+                error="admission rejected "
+                      f"({reason or 'capacity envelope or draining'})"))
+
+    def _claim_into_scheduler() -> None:
+        limit = queue_limit - sched.queue_depth
+        if not replica:
+            for payload in spool.claim(limit):
+                _take(payload)
+            return
+        try:
+            wrappers = spool.claim_assigned(worker, holder, limit)
+        except Exception as exc:  # noqa: BLE001 — serve.claim fault / IO
+            obs.event("serve.claim_failed", worker=worker,
+                      error=f"{type(exc).__name__}: {exc}"[:200])
+            return
+        for rec in wrappers:
+            rid = str(rec.get("id"))
+            attempt = int(rec.get("attempt", 0))
+            held[rid] = attempt
+            keeper.add(rid, attempt)
+            _take(dict(rec.get("request") or {}))
 
     # Resume: a predecessor's claimed-but-unanswered requests come first.
-    for payload in spool.recover():
-        _take(payload)
+    # Fleet replicas skip this — their recovery route is lease expiry.
+    if not replica:
+        for payload in spool.recover():
+            _take(payload)
+
+    warned_orphans: set = set()
+
+    def _audit_orphans() -> None:
+        """Mid-run blind-spot audit (single mode): a ``.claimed`` file with
+        no response that this scheduler does NOT own was claimed by some
+        other (dead) process — startup recovery never sees it, so warn
+        once per request instead of staying silent."""
+        active = set(sched.active_ids())
+        for rid in spool.claimed_unanswered():
+            if rid in active or rid in warned_orphans:
+                continue
+            warned_orphans.add(rid)
+            obs.warn(
+                f"[serve] request {rid!r} is claimed but unanswered and "
+                "not owned by this server — claimed by a dead process? "
+                "single-server recovery only runs at startup; use the "
+                "replica fleet (tbx serve-fleet) for lease-expiry rescue",
+                name="serve.claimed_unanswered", request=rid)
 
     status, exit_code = "done", 0
     try:
@@ -281,14 +745,25 @@ def serve_forever(
             if supervise.drain_requested() and not sched.draining:
                 sched.drain()
             if not sched.draining:
-                for payload in spool.claim(queue_limit - sched.queue_depth):
-                    _take(payload)
+                _claim_into_scheduler()
             stepped = False
             resolved = 0
             if sched.in_flight or sched.queue_depth:
+                # Publish in-flight BEFORE stepping: if step() itself wedges
+                # (stuck collective, injected delay), the heartbeat must
+                # already carry in_flight > 0 or the supervisor's wedge
+                # classifier reads the stall as idle-but-alive and never
+                # kills the replica.
+                if reporter is not None:
+                    reporter.serving_update(
+                        in_flight=sched.in_flight,
+                        completed=spool.completed_count(),
+                        queued=sched.queue_depth)
                 resolved = len(sched.step())
                 stepped = True
             completed = spool.completed_count()
+            if spool.gc_claimed() is not None and not replica:
+                _audit_orphans()
             if reporter is not None:
                 # Rolling per-scenario p50/p99 ride the heartbeat so SLO
                 # burn is visible live; recomputed only when requests
@@ -303,12 +778,18 @@ def serve_forever(
             if sched.draining and sched.idle:
                 status, exit_code = "drained", supervise.EXIT_DRAINED
                 break
+            if (replica and sched.idle and spool.stopped()
+                    and not spool.assigned_entries(worker)):
+                break
             if (max_requests is not None and sched.idle
                     and completed >= max_requests):
                 break
             if not stepped:
                 idle_sleep(poll_s)
     finally:
+        if keeper is not None:
+            keeper.stop()
+        spool.gc_claimed(force=True)
         summary = {
             "status": status,
             "completed_responses": spool.completed_count(),
@@ -325,9 +806,15 @@ def serve_forever(
                 **engine.accept_stats(),
                 "scenarios": sched.accept_summary(),
             }
+        if replica:
+            summary["replica"] = worker
+            summary["duplicate_responses"] = spool.duplicate_count()
+        # Fleet replicas write per-worker summaries (N of them share the
+        # directory); the coordinator's _serve_fleet.json owns the merge.
+        summary_name = (SERVE_SUMMARY_FILENAME if wid is None
+                        else f"_serve.{wid}.json")
         try:
-            atomic_json_dump(summary,
-                             os.path.join(output_dir, SERVE_SUMMARY_FILENAME))
+            atomic_json_dump(summary, os.path.join(output_dir, summary_name))
         except OSError:
             pass
         if recorder is not None:

@@ -1,0 +1,591 @@
+"""Replica-fleet serving acceptance (ISSUE 17): leased request ownership,
+death→re-spool recovery, burn-rate admission routing.
+
+The centerpiece is a REAL chaos e2e: 3 ``tbx serve --replica`` subprocesses
+over one shared request spool and ≥24 mixed-scenario requests, with replica
+``w1`` killed by a ``die`` fault mid-decode and replica ``w2`` wedged past
+the supervisor's wedge threshold by a ``delay`` fault.  Every request must
+be answered EXACTLY once (first-writer-wins — duplicate completions park in
+``responses/_duplicates/``, they are counted, never merged), nothing on
+disk may be ``.corrupt``, the failure ledger must carry the
+lease-expiry→re-spool chains, and the merged ``_events.jsonl`` must stay
+green under ``trace_report --check``.
+
+Around it: burn-router unit tests (weighted steering off fabricated
+``slo.burn.*`` heartbeats, typed all-burning shed, wait-don't-shed when no
+replica is live, drain→re-spool of a dead replica's backlog), the
+claimed-file GC satellite (a 100-request single-server run leaves zero
+stale ``.claimed`` entries), the mid-run claimed-but-unanswered audit
+warning, in-process fault-site drills for ``serve.claim`` /
+``serve.lease_renew`` / ``serve.respond``, serve_fleet trace invariants,
+and the ``serve_fleet_recovery`` bench_compare gate.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.obs.progress import read_progress
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.fleet import holder_token
+from taboo_brittleness_tpu.runtime.resilience import (
+    InjectedFault, RetryPolicy)
+from taboo_brittleness_tpu.serve.replica import (
+    BurnRouter, ServeFleetResult, _shed, reroute_orphans, run_serve_fleet)
+from taboo_brittleness_tpu.serve.scheduler import (
+    REJECT_ALL_REPLICAS_BURNING)
+from taboo_brittleness_tpu.serve.server import (
+    CLAIMED_SUFFIX, RequestSpool, ServeLeaseKeeper)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+MIX = ("chat", "sae_ablate", "forcing")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+    monkeypatch.delenv("TBX_WORKER_ID", raising=False)
+    monkeypatch.delenv("TABOO_FAULT_PLAN", raising=False)
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TBX_OBS_PROGRESS_S"] = "0.2"
+    env["TBX_SUPERVISE_BACKOFF_S"] = "0"
+    env.pop("TABOO_FAULT_PLAN", None)
+    env.pop("TBX_INCARNATION", None)
+    env.pop("TBX_WORKER_ID", None)
+    return env
+
+
+def _replica_argv(out, lease_s):
+    def argv(wid):
+        return [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+                "--synthetic", "--output-dir", out, "--replica",
+                "--slots", "4", "--queue-limit", "8",
+                "--max-new-tokens", "4", "--poll", "0.05",
+                "--lease", str(lease_s)]
+    return argv
+
+
+def _heartbeat(out, wid, *, status="running", age=0.0, fast=0.0,
+               in_flight=0):
+    """Fabricate the ``_progress.<wid>.json`` contract the router reads."""
+    path = os.path.join(out, f"_progress.{wid}.json")
+    payload = {
+        "v": 1, "worker": wid, "status": status,
+        # tbx: wallclock-ok — the heartbeat contract is epoch-stamped
+        "updated_at": time.time() - age,
+        "heartbeat_seconds": 0.2, "workload": "serve",
+        "serving": {"in_flight": in_flight, "completed_requests": 0,
+                    "queued": 0},
+        "slo": {"serve_latency.chat":
+                {"burn": fast, "fast": fast, "slow": fast,
+                 "ok": fast < 1.0}},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def _no_corrupt(root):
+    return [p for p in glob.glob(os.path.join(root, "**", "*.corrupt"),
+                                 recursive=True)]
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance e2e.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_fleet_chaos_e2e(tmp_path, monkeypatch):
+    """3 replicas, 24 mixed requests fed once the fleet is up; w1 die'd
+    mid-decode, w2 wedged past the supervisor's wedge threshold → every
+    request answered exactly once through the lease-expiry→re-spool path,
+    zero corruption, ledger chains, trace gate green."""
+    out = str(tmp_path / "fleet")
+    n_requests, lease_s = 24, 2.5
+    # Both faults ride serve.step (fired per decode step with the worker in
+    # context): the FIRST matching spec wins, so the w1/w2 specs are
+    # independent.  die = replica SIGKILL mid-decode; the long delay wedges
+    # w2 (its heartbeat thread stays fresh, decode stops) until the
+    # supervisor kills it at wedge_after.
+    plan = {"serve.step": [
+        {"mode": "die", "times": 1, "match": "w1", "incarnation": 0},
+        {"mode": "delay", "delay": 30.0, "times": 1, "match": "w2",
+         "incarnation": 0},
+    ]}
+    for k, v in _env().items():
+        monkeypatch.setenv(k, v)
+    spool = RequestSpool(out, fleet=True)
+
+    def _feed():
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            views = [read_progress(
+                os.path.join(out, f"_progress.w{i}.json"), missing_ok=True)
+                for i in range(3)]
+            if all(v.get("status") == "running" for v in views):
+                break
+            time.sleep(0.1)
+        for i in range(n_requests):
+            spool.put({"id": f"e2e{i:03d}",
+                       "prompt": "Give me a hint about the word",
+                       "scenario": MIX[i % len(MIX)], "seed": i})
+
+    threading.Thread(target=_feed, daemon=True).start()
+    res = run_serve_fleet(
+        out, replica_argv=_replica_argv(out, lease_s), n_replicas=3,
+        replica_env={"JAX_PLATFORMS": "cpu",
+                     "TABOO_FAULT_PLAN": json.dumps(plan),
+                     "TBX_OBS_PROGRESS_S": "0.2",
+                     "TBX_SUPERVISE_BACKOFF_S": "0"},
+        lease_s=lease_s, poll_s=0.2, max_requests=n_requests,
+        max_wall_s=300.0, max_incarnations=4, supervise_poll=0.2,
+        grace=2.0, wedge_after=4.0,
+        policy=RetryPolicy(max_retries=6, base_delay=0.0))
+
+    assert res.status == "done" and res.exit_code == 0, res.to_dict()
+    # Exactly once: one response file per request, duplicates PARKED (and
+    # counted), never merged into responses/.
+    rids = [f"e2e{i:03d}" for i in range(n_requests)]
+    for rid in rids:
+        assert spool.get_response(rid) is not None, f"{rid} unanswered"
+    n_responses = sum(1 for n in os.listdir(spool.responses_dir)
+                      if n.endswith(".json"))
+    assert n_responses == n_requests
+    assert res.duplicate_commits == spool.duplicate_count()
+    assert res.duplicate_commits >= 0
+
+    # Recovery went through the lease path, and both chaos victims burned
+    # an incarnation (w1 died, w2 was wedge-killed).
+    assert res.lease_expiries >= 1 and res.respooled >= 1, res.to_dict()
+    assert res.recovery_seconds is not None
+    incs = {r["worker_id"]: r["incarnations"] for r in res.replicas}
+    assert incs["w1"] >= 2, f"w1 was never killed+relaunched: {incs}"
+    assert incs["w2"] >= 2, f"w2 was never wedge-killed: {incs}"
+
+    # Ledger carries the lease-expiry→re-spool chains.
+    assert res.reissue_chains, "no re-spool chains recorded"
+    with open(os.path.join(out, "_failures.json")) as f:
+        ledger = json.load(f)
+    assert ledger, "merged _failures.json empty"
+
+    assert _no_corrupt(out) == []
+    # No stale intake tombstones or claim markers survive a clean finish.
+    spool.gc_claimed(force=True)
+    assert spool.claimed_unanswered() == []
+
+    # The merged event stream is green under the drift gate (which now
+    # includes the serve_fleet invariants).
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--check", os.path.join(out, "_events.jsonl")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate router units.
+# ---------------------------------------------------------------------------
+
+
+def test_router_burn_weighted_steering(tmp_path):
+    """A fast-burning replica gets measurably less admission weight: at
+    fast=1.5 under cap 2.0 its weight is 0.25 vs the healthy replica's
+    1.0, so over 400 seeded picks it receives well under half the healthy
+    replica's share."""
+    out = str(tmp_path)
+    _heartbeat(out, "w0", fast=0.0)
+    _heartbeat(out, "w1", fast=1.5)
+    router = BurnRouter(out, ["w0", "w1"], burn_cap=2.0, seed=1)
+    view = router.view()
+    assert view["w0"]["weight"] == 1.0
+    assert view["w1"]["weight"] == 0.25
+    assert not view["w1"]["burning"]
+    for _ in range(400):
+        assert router.pick(view) in ("w0", "w1")
+    assert router.routed["w1"] < 0.5 * router.routed["w0"], router.routed
+    assert router.routed["w1"] > 0, "burning-but-under-cap must not starve"
+
+
+def test_router_all_burning_sheds_typed(tmp_path):
+    """Every live replica past the cap → no pick, and the coordinator's
+    shed writes a typed ``all-replicas-burning`` rejection response."""
+    out = str(tmp_path)
+    _heartbeat(out, "w0", fast=2.5)
+    _heartbeat(out, "w1", fast=3.0)
+    router = BurnRouter(out, ["w0", "w1"], burn_cap=2.0, seed=0)
+    view = router.view()
+    assert BurnRouter.any_alive(view)
+    assert BurnRouter.all_burning(view)
+    assert all(v["burning"] for v in view.values())
+    assert router.pick(view) is None
+
+    spool = RequestSpool(out, fleet=True)
+    rid = spool.put({"id": "shed0", "prompt": "p", "scenario": "chat"})
+    payload = spool.route_intake(rid)
+    _shed(spool, rid, payload)
+    resp = spool.get_response(rid)
+    assert resp is not None and resp["ok"] is False
+    assert resp["reject_reason"] == REJECT_ALL_REPLICAS_BURNING
+    assert resp["finish"] == "rejected"
+
+
+def test_router_waits_when_no_replica_alive(tmp_path):
+    """Stale or absent heartbeats mean startup / rolling restart, NOT
+    overload: nothing is alive, nothing burns, intake must wait."""
+    out = str(tmp_path)
+    _heartbeat(out, "w0", age=60.0)           # stale: presumed dead
+    _heartbeat(out, "w1", status="done")      # exited
+    router = BurnRouter(out, ["w0", "w1", "w2"], burn_cap=2.0)
+    view = router.view()
+    assert not BurnRouter.any_alive(view)
+    assert not BurnRouter.all_burning(view)
+    assert router.pick(view) is None
+    assert view["w2"]["alive"] is False       # no heartbeat at all
+
+
+def test_reroute_orphans_moves_dead_replicas_backlog(tmp_path):
+    """Drain→re-spool: a permanently-dead replica's unclaimed assignments
+    move to a live replica, excluding the dead one as a target."""
+    out = str(tmp_path)
+    spool = RequestSpool(out, fleet=True)
+    _heartbeat(out, "w0", fast=0.0)
+    for i in range(3):
+        spool.assign(f"q{i}", {"id": f"q{i}", "prompt": "p",
+                               "scenario": "chat"}, "w1", attempt=1,
+                     excluded=("w1-i0",))
+    router = BurnRouter(out, ["w0", "w1"], burn_cap=2.0, seed=0)
+    moved = reroute_orphans(spool, router, "w1")
+    assert moved == 3
+    assert spool.assigned_entries("w1") == []
+    entries = spool.assigned_entries("w0")
+    assert sorted(e["id"] for e in entries) == ["q0", "q1", "q2"]
+    # Attempt counts and holder exclusions survive the move.
+    assert all(e["attempt"] == 1 and e["excluded"] == ["w1-i0"]
+               for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# Claimed-file GC + the recover() blind-spot audit (satellites).
+# ---------------------------------------------------------------------------
+
+
+def test_claimed_gc_leaves_zero_stale_entries_after_100_requests(tmp_path):
+    """The RequestSpool claimed-file leak fix: a 100-request single-server
+    run leaves ZERO stale ``.claimed`` tombstones behind."""
+    out = str(tmp_path / "serve")
+    spool = RequestSpool(out)
+    for i in range(100):
+        spool.put({"id": f"gc{i:03d}", "prompt": "hint",
+                   "scenario": MIX[i % len(MIX)]})
+    proc = subprocess.run(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+         "--synthetic", "--output-dir", out, "--slots", "8",
+         "--queue-limit", "128", "--max-new-tokens", "2",
+         "--poll", "0.02", "--max-requests", "100"],
+        env=_env(), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert spool.completed_count() == 100
+    stale = [n for n in os.listdir(spool.requests_dir)
+             if n.endswith(CLAIMED_SUFFIX)]
+    assert stale == [], f"stale .claimed tombstones: {stale}"
+
+
+def test_gc_claimed_removes_only_resolved_claims(tmp_path):
+    spool = RequestSpool(str(tmp_path))
+    r1 = spool.put({"id": "a1", "prompt": "p", "scenario": "chat"})
+    r2 = spool.put({"id": "a2", "prompt": "p", "scenario": "chat"})
+    for rid in (r1, r2):
+        path = os.path.join(spool.requests_dir, f"{rid}.json")
+        os.replace(path, path + CLAIMED_SUFFIX)
+    # Only a1 has a response: GC must remove exactly its tombstone.
+    with open(spool.response_path("a1"), "w") as f:
+        json.dump({"id": "a1", "ok": True}, f)
+    assert spool.gc_claimed(force=True) == 1
+    left = [n for n in os.listdir(spool.requests_dir)
+            if n.endswith(CLAIMED_SUFFIX)]
+    assert left == [f"a2.json{CLAIMED_SUFFIX}"]
+    # Throttled call (not forced, within the interval) reports None.
+    assert spool.gc_claimed() is None
+    assert spool.claimed_unanswered() == ["a2"]
+
+
+def test_midrun_claimed_unanswered_emits_audit_warning(tmp_path):
+    """The recover() blind spot: a claimed-but-unanswered file appearing
+    MID-RUN (not at startup) must be surfaced with an obs warning."""
+    out = str(tmp_path / "serve")
+    spool = RequestSpool(out)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+         "--synthetic", "--output-dir", out, "--slots", "2",
+         "--max-new-tokens", "2", "--poll", "0.02"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        # Wait until a real request is ANSWERED: only then is the server
+        # past warm-up and startup recovery (which would legitimately adopt
+        # a claimed file instead of flagging it) and into its main loop.
+        spool.put({"id": "warmup", "prompt": "p", "scenario": "chat"})
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if spool.get_response("warmup") is not None:
+                break
+            time.sleep(0.1)
+        assert spool.get_response("warmup") is not None, "server never up"
+        # An orphaned claim the scheduler knows nothing about — the
+        # signature a concurrent writer's crash leaves behind.
+        with open(os.path.join(spool.requests_dir,
+                               f"orphan.json{CLAIMED_SUFFIX}"), "w") as f:
+            json.dump({"id": "orphan", "prompt": "p", "scenario": "chat"},
+                      f)
+        events_path = os.path.join(out, "_events.jsonl")
+        warned = []
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not warned:
+            time.sleep(0.3)
+            try:
+                with open(events_path) as f:
+                    warned = [json.loads(ln) for ln in f
+                              if '"serve.claimed_unanswered"' in ln]
+            except (OSError, ValueError):
+                warned = []
+        assert warned, "no serve.claimed_unanswered warning emitted"
+        assert warned[0]["attrs"]["request"] == "orphan"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    assert proc.returncode == supervise.EXIT_DRAINED
+    # The audit warns ONCE per orphan, not once per poll.
+    with open(os.path.join(out, "_events.jsonl")) as f:
+        n_warn = sum(1 for ln in f if '"serve.claimed_unanswered"' in ln)
+    assert n_warn == 1
+
+
+# ---------------------------------------------------------------------------
+# Fault-site drills (in-process): serve.claim / serve.lease_renew /
+# serve.respond.
+# ---------------------------------------------------------------------------
+
+
+def test_fault_site_serve_claim_is_retried_next_poll(tmp_path):
+    """A transient fault at serve.claim loses the attempt, not the
+    request: the next poll claims it."""
+    spool = RequestSpool(str(tmp_path), fleet=True)
+    spool.assign("c0", {"id": "c0", "prompt": "p", "scenario": "chat"},
+                 "w0")
+    inj = resilience.FaultInjector()
+    inj.arm("serve.claim", mode="fail", times=1)
+    resilience.set_injector(inj)
+    with pytest.raises(InjectedFault):
+        spool.claim_assigned("w0", holder_token("w0"), 4)
+    claimed = spool.claim_assigned("w0", holder_token("w0"), 4)
+    assert [c["id"] for c in claimed] == ["c0"]
+    assert spool.assigned_entries("w0") == []
+
+
+def test_fault_site_serve_lease_renew_lets_lease_expire(tmp_path):
+    """Failed renewals (the keeper fails open) leave the lease to expire —
+    exactly what the coordinator's re-spool scan keys on."""
+    spool = RequestSpool(str(tmp_path), fleet=True)
+    holder = holder_token("w0")
+    inj = resilience.FaultInjector()
+    inj.arm("serve.lease_renew", mode="fail", times=100)
+    resilience.set_injector(inj)
+    keeper = ServeLeaseKeeper(spool.lease_store, holder=holder,
+                              worker="w0", lease_s=0.5).start()
+    try:
+        keeper.add("r0", 0)
+        time.sleep(1.2)
+        recs = spool.lease_store.leases()
+        assert len(recs) == 1
+        # tbx: wallclock-ok — comparing against the on-disk lease deadline
+        assert recs[0]["expires_at"] < time.time(), (
+            "lease was renewed despite the injected renewal faults")
+    finally:
+        keeper.stop()
+
+
+def test_fault_site_serve_respond_and_first_writer_wins(tmp_path):
+    from taboo_brittleness_tpu.serve.scheduler import Response
+
+    spool = RequestSpool(str(tmp_path), fleet=True)
+    resp = Response(id="r0", scenario="chat", ok=True, text="x")
+    inj = resilience.FaultInjector()
+    inj.arm("serve.respond", mode="fail", times=1)
+    resilience.set_injector(inj)
+    with pytest.raises(InjectedFault):
+        spool.respond_exclusive(resp, holder=holder_token("w0"))
+    # The fault fired BEFORE the link: nothing landed, a retry wins.
+    assert spool.get_response("r0") is None
+    assert spool.respond_exclusive(resp, holder=holder_token("w0")) is True
+    # A raced duplicate from another holder loses benignly and is parked.
+    dup = Response(id="r0", scenario="chat", ok=True, text="y")
+    assert spool.respond_exclusive(dup, holder=holder_token("w1")) is False
+    assert spool.get_response("r0")["text"] == "x"
+    assert spool.duplicate_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# trace_report: the serve_fleet invariants.
+# ---------------------------------------------------------------------------
+
+
+def _serve_fleet_stream(tmp_path, points):
+    path = str(tmp_path / "_events.jsonl")
+    seq = 0
+    lines = []
+
+    def add(rec):
+        nonlocal seq
+        seq += 1
+        lines.append(json.dumps({"v": 1, "seq": seq, "t": float(seq),
+                                 **rec}))
+
+    add({"ev": "start", "kind": "run", "name": "sweep", "id": 1,
+         "attrs": {"pipeline": "serve-fleet"}})
+    for name, attrs in points:
+        add({"ev": "point", "kind": "point", "name": name, "parent": 1,
+             "attrs": attrs})
+    add({"ev": "end", "kind": "run", "name": "sweep", "id": 1, "dur": 1.0,
+         "status": "ok"})
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def test_check_serve_fleet_flags_double_answer(tmp_path):
+    path = _serve_fleet_stream(tmp_path, [
+        ("serve_fleet.route", {"request": "r0", "worker": "w0"}),
+        ("serve.respond", {"request": "r0", "duplicate": False}),
+        ("serve.respond", {"request": "r0", "duplicate": False}),
+        ("serve_fleet.exit", {"status": "done"}),
+    ])
+    errors = trace_report.check_serve_fleet(
+        path, list(trace_report.iter_events(path)))
+    assert any("first-writer-wins violated" in e for e in errors)
+
+
+def test_check_serve_fleet_flags_unresolved_expiry(tmp_path):
+    path = _serve_fleet_stream(tmp_path, [
+        ("serve_fleet.route", {"request": "r0", "worker": "w0"}),
+        ("serve_fleet.lease_expired", {"request": "r0",
+                                       "holder": "w0-i0"}),
+        ("serve_fleet.exit", {"status": "done"}),
+    ])
+    errors = trace_report.check_serve_fleet(
+        path, list(trace_report.iter_events(path)))
+    assert any("never re-spooled" in e for e in errors)
+    assert any("never answered" in e for e in errors)
+
+
+def test_check_serve_fleet_clean_chain_passes(tmp_path):
+    path = _serve_fleet_stream(tmp_path, [
+        ("serve_fleet.route", {"request": "r0", "worker": "w0"}),
+        ("serve_fleet.lease_expired", {"request": "r0",
+                                       "holder": "w0-i0"}),
+        ("serve_fleet.respool", {"request": "r0", "worker": "w1"}),
+        ("serve.respond", {"request": "r0", "duplicate": False}),
+        ("serve.respond", {"request": "r0", "duplicate": True}),
+        ("serve_fleet.shed", {"request": "r1",
+                              "reason": "all-replicas-burning"}),
+        ("serve_fleet.exit", {"status": "done"}),
+    ])
+    assert trace_report.check_serve_fleet(
+        path, list(trace_report.iter_events(path))) == []
+
+
+def test_check_serve_fleet_noop_on_plain_streams():
+    path = os.path.join(REPO, "tests", "fixtures", "obs", "_events.jsonl")
+    assert trace_report.check_serve_fleet(
+        path, list(trace_report.iter_events(path))) == []
+
+
+def test_committed_serve_fleet_fixture_is_green():
+    fixture = os.path.join(REPO, "tests", "fixtures", "obs", "serve_fleet",
+                           "_events.jsonl")
+    assert os.path.exists(fixture), "serve_fleet fixture not committed"
+    assert trace_report.main(["--check", fixture]) == 0
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the serve_fleet_recovery regression gate.
+# ---------------------------------------------------------------------------
+
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_serve_fleet_recovery_within_band(tmp_path):
+    _write_round(tmp_path, 1,
+                 {"serve_fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2,
+                 {"serve_fleet_recovery": {"recovery_seconds": 5.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_serve_fleet_recovery_flags_regression(tmp_path):
+    _write_round(tmp_path, 1,
+                 {"serve_fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2,
+                 {"serve_fleet_recovery": {"recovery_seconds": 9.0}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("serve_fleet_recovery.recovery_seconds" in r
+               for r in regressions)
+
+
+def test_bench_compare_serve_fleet_recovery_missing_is_skipped(tmp_path):
+    _write_round(tmp_path, 1,
+                 {"serve_fleet_recovery": {"recovery_seconds": 4.0}})
+    _write_round(tmp_path, 2, {})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("serve_fleet_recovery.recovery_seconds" in line
+               and "skipped" in line for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# ServeFleetResult shape.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_fleet_result_duck_types_merge_ledgers():
+    """merge_ledgers reads status / reissue_chains / lease_expiries /
+    duplicate_commits off FleetResult; ServeFleetResult must keep those
+    exact names so the serve fleet reuses the merger unchanged."""
+    res = ServeFleetResult(
+        status="done", exit_code=0, requests_total=2, completed=2, shed=0,
+        respooled=1, lease_expiries=1, duplicate_commits=1,
+        recovery_seconds=0.5, wall_seconds=1.0, replicas=[],
+        reissue_chains={"r0": [{"reason": "lease-expired"}]}, router={})
+    for attr in ("status", "reissue_chains", "lease_expiries",
+                 "duplicate_commits"):
+        assert hasattr(res, attr)
+    d = res.to_dict()
+    assert d["version"] == 1 and d["shed_rate"] == 0.0
+    assert ServeFleetResult(**{**{f.name: getattr(res, f.name)
+                                  for f in res.__dataclass_fields__.values()
+                                  }, "shed": 1}).shed_rate == 0.5

@@ -364,9 +364,14 @@ def test_supervised_sweep_survives_die_and_wedge(tmp_path):
             {"mode": "fail", "times": 1, "incarnation": 2, "match": "w05"},
         ],
     }
+    # wedge_after must sit ABOVE the child's longest honest event-quiet
+    # stretch (the first word's jit compile can pause events for a few
+    # seconds while the heartbeat stays fresh — at 1.5s incarnation 0 was
+    # flakily misclassified as wedged before its die fault fired) and
+    # below the 60s delay that IS the wedge.
     res = supervise.supervise(
         [sys.executable, driver, out], out,
-        max_incarnations=4, poll_interval=0.1, grace=1.0, wedge_after=1.5,
+        max_incarnations=4, poll_interval=0.1, grace=1.0, wedge_after=8.0,
         policy=FAST, env=_child_env(plan))
 
     assert res.exit_code == 0, res.incarnations

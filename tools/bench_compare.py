@@ -70,6 +70,13 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # must not creep up.  Wide band (±50%): the path crosses subprocess
     # relaunch + poll intervals, so run-to-run jitter is structural.
     "fleet_recovery.recovery_seconds": (0.50, False, 0.0),
+    # Replica-serving recovery (bench.py serve_fleet_recovery, ISSUE 17):
+    # the time from a replica death's lease expiry to every re-spooled
+    # request being answered must not creep up.  Same wide ±50% band as
+    # fleet_recovery and for the same reason: the path crosses subprocess
+    # relaunch + lease + poll intervals, so run-to-run jitter is
+    # structural.
+    "serve_fleet_recovery.recovery_seconds": (0.50, False, 0.0),
     # Base-resident delta switch (bench.py delta_switch, ISSUE 12): the
     # word-switch latency over the resident base must not creep up (wide
     # ±50% band: the path crosses filesystem reads, so run-to-run jitter is

@@ -80,6 +80,9 @@ if [ "$FAST" -eq 0 ]; then
   gate "fleet selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
 
+  gate "serve-fleet selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu serve-fleet --selfcheck
+
   gate "delta-pack selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu delta-pack --selfcheck
 

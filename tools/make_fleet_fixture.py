@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Regenerate the committed multi-worker fleet fixture
-(tests/fixtures/obs/fleet/).
+"""Regenerate the committed multi-worker fleet fixtures
+(tests/fixtures/obs/fleet/ and tests/fixtures/obs/serve_fleet/).
 
 Runs a REAL chaos fleet — tiny model, 3 subprocess workers, worker ``w1``
 killed by a ``die`` fault at its first commit (``runtime.fleet.selfcheck``,
@@ -18,6 +18,13 @@ the ring to ``_flightrec.json``.  The committed files are what
 schema to (tools/check.sh), so the fleet event vocabulary, the metrics
 conservation invariants, and the merge rules cannot drift silently.
 
+The serve_fleet fixture is regenerated the same way from the replica
+serving chaos smoke (``serve.replica.selfcheck``, the scenario
+``tbx serve-fleet --selfcheck`` gates): replica ``w1`` killed at its first
+response commit, every request healed through the lease-expiry→re-spool
+path.  ``tbx top --once --selfcheck`` renders it and asserts replica lanes
+plus the serve-fleet summary line.
+
     JAX_PLATFORMS=cpu python tools/make_fleet_fixture.py
 """
 
@@ -34,6 +41,65 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 FIXTURE_DIR = os.path.join(_REPO, "tests", "fixtures", "obs", "fleet")
+SERVE_FLEET_FIXTURE_DIR = os.path.join(_REPO, "tests", "fixtures", "obs",
+                                       "serve_fleet")
+
+_COPY_PATTERNS = ("_events*.jsonl", "_metrics*.jsonl", "_progress*.json",
+                  "_flightrec*.json")
+
+
+def _copy_artifacts(out: str, fixture_dir: str,
+                    extra_files: tuple = ("_failures.json",)) -> list:
+    os.makedirs(fixture_dir, exist_ok=True)
+    for pat in _COPY_PATTERNS + extra_files:
+        for old in glob.glob(os.path.join(fixture_dir, pat)):
+            os.unlink(old)
+    copied = []
+    for pat in _COPY_PATTERNS:
+        for src in sorted(glob.glob(os.path.join(out, pat))):
+            dst = os.path.join(fixture_dir, os.path.basename(src))
+            shutil.copyfile(src, dst)
+            copied.append(dst)
+    for name in extra_files:
+        src = os.path.join(out, name)
+        if os.path.exists(src):
+            dst = os.path.join(fixture_dir, name)
+            shutil.copyfile(src, dst)
+            copied.append(dst)
+    return copied
+
+
+def _make_serve_fleet_fixture() -> int:
+    from taboo_brittleness_tpu.serve import replica as replica_mod
+
+    out = tempfile.mkdtemp(prefix="tbx_serve_fleet_fixture_")
+    verdict = replica_mod.selfcheck(os.path.join(out, "fleet"))
+    res = verdict["result"]
+    print(f"serve-fleet run: {res['status']}, {res['completed']} answered, "
+          f"{res['respooled']} re-spooled, "
+          f"{res['lease_expiries']} lease expirie(s)")
+    if not verdict["ok"]:
+        print(f"make_fleet_fixture: serve-fleet chaos smoke FAILED: "
+              f"{verdict['problems']}", file=sys.stderr)
+        return 1
+    copied = _copy_artifacts(os.path.join(out, "fleet"),
+                             SERVE_FLEET_FIXTURE_DIR,
+                             extra_files=("_failures.json",
+                                          "_serve_fleet.json"))
+    for p in copied:
+        print(f"  -> {os.path.relpath(p, _REPO)}")
+
+    import trace_report
+
+    rc = trace_report.main(
+        ["--check",
+         os.path.join(SERVE_FLEET_FIXTURE_DIR, "_events.jsonl")])
+    if rc != 0:
+        print("make_fleet_fixture: regenerated serve_fleet fixture FAILS "
+              "trace_report --check", file=sys.stderr)
+        return rc
+    shutil.rmtree(out, ignore_errors=True)
+    return 0
 
 
 def main() -> int:
@@ -66,22 +132,7 @@ def main() -> int:
     assert os.path.exists(os.path.join(out, "_flightrec.json")), (
         "quarantine did not dump the flight recorder")
 
-    os.makedirs(FIXTURE_DIR, exist_ok=True)
-    for pat in ("_events*.jsonl", "_metrics*.jsonl", "_progress*.json",
-                "_flightrec*.json"):
-        for old in glob.glob(os.path.join(FIXTURE_DIR, pat)):
-            os.unlink(old)
-    copied = []
-    for pat in ("_events*.jsonl", "_metrics*.jsonl", "_progress*.json",
-                "_flightrec*.json"):
-        for src in sorted(glob.glob(os.path.join(out, pat))):
-            dst = os.path.join(FIXTURE_DIR, os.path.basename(src))
-            shutil.copyfile(src, dst)
-            copied.append(dst)
-    ledger = os.path.join(out, "_failures.json")
-    if os.path.exists(ledger):
-        shutil.copyfile(ledger, os.path.join(FIXTURE_DIR, "_failures.json"))
-        copied.append(os.path.join(FIXTURE_DIR, "_failures.json"))
+    copied = _copy_artifacts(out, FIXTURE_DIR)
     for p in copied:
         print(f"  -> {os.path.relpath(p, _REPO)}")
 
@@ -95,14 +146,20 @@ def main() -> int:
         print("make_fleet_fixture: regenerated fixture FAILS trace_report "
               "--check", file=sys.stderr)
         return rc
+    shutil.rmtree(out, ignore_errors=True)
+
+    rc = _make_serve_fleet_fixture()
+    if rc != 0:
+        return rc
+
+    # Both fixtures committed: the top gate renders fleet AND serve_fleet.
     from taboo_brittleness_tpu.obs import top
 
     rc = top.main_selfcheck(FIXTURE_DIR)
     if rc != 0:
-        print("make_fleet_fixture: regenerated fixture FAILS tbx top "
+        print("make_fleet_fixture: regenerated fixtures FAIL tbx top "
               "--selfcheck", file=sys.stderr)
         return rc
-    shutil.rmtree(out, ignore_errors=True)
     return 0
 
 

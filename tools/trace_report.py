@@ -805,6 +805,77 @@ def check_serve_spec(path: str, events: List[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def check_serve_fleet(path: str, events: List[Dict[str, Any]]) -> List[str]:
+    """Replica-fleet serving invariants for ``--check`` (empty = clean;
+    no-op on streams without ``serve_fleet.*`` points).  Gated over the
+    merged ``_events.jsonl`` a ``tbx serve-fleet`` run leaves behind
+    (``serve/replica.py``):
+
+    - exactly-once responses: no request carries more than one
+      non-duplicate ``serve.respond`` — raced or re-spooled completions
+      must land with ``duplicate=true`` (first-writer-wins);
+    - every lease-expiry marker resolves to a re-spool of the same request
+      (or the request was answered anyway, or the run drained/stalled);
+    - every routed / re-spooled request ends answered or typed-shed,
+      unless the run drained/stalled.
+    """
+    errors: List[str] = []
+    spans, points = build_spans(events)
+    sf: Dict[str, List[Dict[str, Any]]] = {}
+    responds: Dict[str, int] = {}
+    for p in points:
+        name = str(p.get("name", ""))
+        if name.startswith("serve_fleet."):
+            sf.setdefault(name, []).append(p)
+        elif name == "serve.respond":
+            attrs = p.get("attrs") or {}
+            if not attrs.get("duplicate", False):
+                req = str(attrs.get("request"))
+                responds[req] = responds.get(req, 0) + 1
+    if not sf:
+        return errors
+
+    def attr(p, key, default=None):
+        return (p.get("attrs") or {}).get(key, default)
+
+    drained = any(
+        s.attrs.get("drained") for s in spans.values() if s.kind == "run")
+    exits = sf.get("serve_fleet.exit", [])
+    status = str((exits[-1].get("attrs") or {}).get("status", "done")
+                 if exits else "done")
+    incomplete_ok = drained or status in ("drained", "stalled")
+
+    for req, n in sorted(responds.items()):
+        if n > 1:
+            errors.append(
+                f"{path}: request {req} answered {n} times without the "
+                "duplicate flag — first-writer-wins violated")
+    shed = {str(attr(p, "request")) for p in sf.get("serve_fleet.shed", [])}
+    respooled = {str(attr(p, "request"))
+                 for p in sf.get("serve_fleet.respool", [])}
+    for p in sf.get("serve_fleet.lease_expired", []):
+        req = str(attr(p, "request"))
+        if req in respooled or req in responds:
+            continue
+        if not incomplete_ok:
+            errors.append(
+                f"{path}: request {req} lease expired (holder "
+                f"{attr(p, 'holder')}) but was never re-spooled or "
+                "answered")
+    issued = {str(attr(p, "request"))
+              for name in ("serve_fleet.route", "serve_fleet.respool",
+                           "serve_fleet.reroute")
+              for p in sf.get(name, [])}
+    for req in sorted(issued):
+        if req in responds or req in shed:
+            continue
+        if not incomplete_ok:
+            errors.append(
+                f"{path}: request {req} routed but never answered or "
+                "shed")
+    return errors
+
+
 def check_timeseries(events_path: str) -> List[str]:
     """Windowed-metrics-spool invariants for ``--check`` (empty = clean;
     no-op when no ``_metrics*.jsonl`` sits next to the events file).  Every
@@ -1298,6 +1369,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # verify-block span must resolve to an accept record.
         errors += check_serve_spec(args.events,
                                    list(iter_events(args.events)))
+        # Replica-fleet serving invariants (serve/replica.py): exactly-once
+        # responses, lease expiry -> re-spool chains, routed -> resolved.
+        errors += check_serve_fleet(args.events,
+                                    list(iter_events(args.events)))
         # Windowed-metrics + flight-recorder invariants (obs.timeseries /
         # obs.flightrec): no-ops when no sibling artifacts exist.
         errors += check_timeseries(args.events)
