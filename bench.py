@@ -55,6 +55,13 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
   spec_speedup, and the per-round re-proof that the lossless scenarios'
   token streams are exact (adaptive_depth is excluded from the exactness
   bit by contract — it trades exactness for depth-k early exit).
+- "serve_tp_ab" (BENCH_SERVE_TP_AB, default-on): the TENSOR-PARALLEL
+  serving A/B (ISSUE 18) — the same seeded loadgen schedule driven sharded
+  (one pjit step program over a dp×tp mesh) vs unsharded with identical
+  config; wall ratio (tp_speedup), the per-request bit-exactness re-proof,
+  the sharded arm's zero-AOT-miss delta, and the HBM-watermark autotuner's
+  solved slot width.  Skipped with a note on 1-device runs — the CI smoke
+  forces XLA_FLAGS=--xla_force_host_platform_device_count=8.
 - "sweep.phase_roofline": each phase against ITS OWN ceiling
   (perf/roofline.py — decode vs the HBM stream bound, readout/NLL vs bf16
   matmul peak), with achieved/ceiling ratios; "sweep.readout_ab" is the
@@ -1410,6 +1417,121 @@ def _serve_spec_ab(params, cfg, sae, tap_layer: int, on_accel: bool) -> dict:
     }
 
 
+def _serve_tp_ab(on_accel: bool) -> dict:
+    """``serve_tp_ab`` stage (BENCH_SERVE_TP_AB, default-on): tensor-
+    parallel serving A/B (ISSUE 18).
+
+    Drives the SAME seeded loadgen schedule twice over one set of params —
+    sharded (``ServeEngine(mesh=serve_mesh(tp))``: one pjit step program
+    over the dp×tp mesh, params/KV/bank on tp, slots on dp) and unsharded
+    reference with identical config — and commits the rollout numbers:
+    end-to-end ``tp_speedup`` (wall_ref / wall_tp; on the CPU smoke's
+    forced-host-device mesh this is a collectives-overhead watermark, not a
+    speedup), the per-request ``all_exact`` re-proof that every token
+    stream is bit-identical across arms, the sharded arm's AOT-delta
+    zero-miss gate, and the HBM-watermark autotuner's solved width.
+    Needs >= 2 devices with ``device_count %% tp == 0``; skipped with a
+    note otherwise (plain CPU runs force the mesh via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``)."""
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime import aot
+    from taboo_brittleness_tpu.runtime.tokenizer import (
+        WordTokenizer, target_token_id)
+    from taboo_brittleness_tpu.serve import autotune, loadgen
+    from taboo_brittleness_tpu.serve.engine import (
+        EngineConfig, ServeEngine, serve_mesh)
+    from taboo_brittleness_tpu.serve.scheduler import default_scenarios
+
+    tp = int(os.environ.get("BENCH_SERVE_TP", "2"))
+    ndev = jax.local_device_count()
+    if tp < 2 or ndev < 2 or ndev % tp:
+        return {"stage": "serve_tp_ab",
+                "skipped": f"needs a multi-device mesh (tp={tp}, "
+                           f"devices={ndev}); the CPU smoke forces one via "
+                           "XLA_FLAGS=--xla_force_host_platform_"
+                           "device_count=8"}
+    dp = ndev // tp
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8" if on_accel else "4"))
+    slots = max(dp, (slots // dp) * dp)    # engine needs slots % dp == 0
+    n_requests = int(os.environ.get("BENCH_SERVE_TP_REQUESTS",
+                                    "48" if on_accel else "18"))
+    max_new = 16 if on_accel else 8
+    # Self-built tiny stack (not main()'s params): the mesh needs
+    # vocab % tp == 0 and BOTH arms must share the rounded config for the
+    # exactness bit to be meaningful.
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    cfg = cfg.replace(vocab_size=((cfg.vocab_size + tp - 1) // tp) * tp)
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    words = ["ship", "moon", "hint", "clue", "secret", "word", "is", "My",
+             "Give", "me", "a", "the", "about"]
+    tok = WordTokenizer(words, vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(8), cfg.hidden_size, 64)
+    tap = min(2, cfg.num_layers - 1)
+    ec = EngineConfig(
+        slots=slots, max_context=48, prompt_cols=24,
+        latent_slots=4, proj_rank=2,
+        sae_layer=tap, proj_layer=tap, tap_layer=tap,
+        stop_ids=(-1,))
+    scenarios = default_scenarios(max_new_tokens=max_new,
+                                  ablate_latents=(0, 1, 2, 3), proj_rank=2)
+    lens_tgt = target_token_id(tok, "ship")
+
+    def _arm(mesh):
+        engine = ServeEngine(params, cfg, tok, engine_config=ec, sae=sae,
+                             mesh=mesh)
+        engine.warm_start()
+        before = dict(aot.stats().get(engine.aot_name, {}))
+        streams = {}
+        report = loadgen.run_inprocess(
+            engine, n_requests=n_requests, seed=17,
+            rate=float(os.environ.get("BENCH_SERVE_RATE", "200")),
+            concurrency=2 * slots, scenarios=scenarios,
+            lens_target_id=lens_tgt,
+            prompts=("Give me a hint", "Give me a clue about the word"),
+            on_complete=lambda r: streams.__setitem__(
+                r.id, (r.scenario, tuple(r.tokens))))
+        after = dict(aot.stats().get(engine.aot_name, {}))
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in ("hits", "misses", "fallbacks")}
+        return engine, report, streams, delta
+
+    _, rep_ref, streams_ref, _ = _arm(None)
+    eng_tp, rep_tp, streams_tp, aot_delta = _arm(serve_mesh(tp))
+    mismatched = sorted(k for k, v in streams_ref.items()
+                        if streams_tp.get(k) != v)
+    tuned = autotune.solve(eng_tp)
+    wall_ref = rep_ref["wall_seconds"]
+    wall_tp = rep_tp["wall_seconds"]
+
+    def _slim(rep):
+        return {"wall_seconds": rep["wall_seconds"],
+                "p50_s": rep["overall"]["p50_s"],
+                "p99_s": rep["overall"]["p99_s"],
+                "goodput": rep["goodput"]}
+
+    return {
+        "stage": "serve_tp_ab",
+        "all_exact": not mismatched,
+        "mismatched_requests": mismatched,
+        "tp_speedup": (round(wall_ref / wall_tp, 4) if wall_tp > 0
+                       else None),
+        "aot": aot_delta,
+        "autotune": {"width": tuned.width, "verdict": tuned.verdict,
+                     "source": tuned.source,
+                     "per_slot_bytes": tuned.per_slot_bytes,
+                     "fixed_bytes": tuned.fixed_bytes},
+        "mesh": {"tp": tp, "dp": dp, "devices": ndev},
+        "ref": _slim(rep_ref),
+        "tp": _slim(rep_tp),
+        "config": {"slots": slots, "n_requests": n_requests,
+                   "max_new_tokens": max_new, "seed": 17,
+                   "vocab_size": cfg.vocab_size},
+    }
+
+
 def _fleet_recovery_bench(on_accel: bool) -> dict:
     """``fleet_recovery`` stage (BENCH_FLEET=1, CPU-smoke default-on): how
     fast the elastic fleet heals a worker death (ISSUE 10).
@@ -1836,6 +1958,13 @@ def main() -> int:
         serve_spec_stage = _serve_spec_ab(params, cfg, sae, tap_layer,
                                           on_accel)
 
+    serve_tp_stage = None
+    # Default-ON everywhere: on a multi-device round it measures the real
+    # sharded-vs-unsharded wall; on a 1-device CPU run it records a skip
+    # note (the CI smoke forces an 8-host-device mesh instead).
+    if os.environ.get("BENCH_SERVE_TP_AB", "1") == "1":
+        serve_tp_stage = _serve_tp_ab(on_accel)
+
     fleet_stage = None
     if os.environ.get("BENCH_FLEET", "1") == "1":
         fleet_stage = _fleet_recovery_bench(on_accel)
@@ -2003,6 +2132,19 @@ def main() -> int:
             "accept_rate": serve_spec_stage.get("accept_rate"),
             "tokens_per_verify": serve_spec_stage.get("tokens_per_verify"),
             "all_exact": serve_spec_stage.get("all_exact")}),
+        # Tensor-parallel serving A/B (serve/engine.py mesh mode, stage
+        # serve_tp_ab): same loadgen schedule sharded vs unsharded —
+        # wall ratio, the bit-exactness re-proof, the sharded arm's
+        # zero-AOT-miss delta, and the HBM-watermark autotuner's width.
+        "serve_tp_ab": (serve_tp_stage and (
+            {"skipped": serve_tp_stage["skipped"]}
+            if "skipped" in serve_tp_stage else {
+                "tp_speedup": serve_tp_stage.get("tp_speedup"),
+                "all_exact": serve_tp_stage.get("all_exact"),
+                "aot_misses": (serve_tp_stage.get("aot") or {}).get(
+                    "misses"),
+                "autotuned_width": (serve_tp_stage.get("autotune")
+                                    or {}).get("width")})),
         "detail": detail_path,
     }
 
@@ -2024,6 +2166,7 @@ def main() -> int:
              "obs_overhead": obs_ab, "obs_live": obs_live_ab,
              "serve_latency": serve_stage,
              "serve_spec_ab": serve_spec_stage,
+             "serve_tp_ab": serve_tp_stage,
              "fleet_recovery": fleet_stage,
              "serve_fleet_recovery": serve_fleet_stage,
              "delta_switch": delta_stage,

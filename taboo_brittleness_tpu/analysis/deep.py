@@ -217,9 +217,37 @@ def _entry_nll_cached():
     return fn, (params, kv, kv, cache_valid, seqs, valid, pos, nmask)
 
 
-def _serve_abstract():
+def _serve_tp_mesh():
+    """The dp×tp serve mesh for the mesh-mode entries (ISSUE 18); None when
+    this process has fewer than two (or an odd number of) devices — the
+    builders then fall back to the unsharded trace so the registry stays
+    traceable everywhere, while the check.sh gate forces 8 host devices so
+    the tp programs ARE audited there."""
+    import jax
+
+    try:
+        if jax.device_count() < 2 or jax.device_count() % 2:
+            return None
+        from taboo_brittleness_tpu.serve.engine import serve_mesh
+
+        return serve_mesh(2)
+    except Exception:  # noqa: BLE001 — no backend: unsharded fallback
+        return None
+
+
+def _mesh_dims(mesh) -> dict:
+    """Abstract-shape overrides for a mesh-mode trace: vocab doubles to
+    2×VOCAB_MARKER so each tp shard keeps the marker dim (the audit follows
+    the LOCAL vocab tensors inside the sharded readout), and slots become a
+    dp multiple so the row sharding divides evenly."""
+    return {"vocab": 2 * VOCAB_MARKER,
+            "slots": 2 * int(mesh.shape["dp"])}
+
+
+def _serve_abstract(vocab: int = None, slots: int = None):
     """Shared abstract serving state (cfg, params, sae, cache, state) for
-    the serve-step entries."""
+    the serve-step entries.  ``vocab``/``slots`` override the defaults for
+    the mesh-mode variants (:func:`_mesh_dims`)."""
     import jax
     import jax.numpy as jnp
 
@@ -227,8 +255,10 @@ def _serve_abstract():
     from taboo_brittleness_tpu.serve import engine as serve_engine
 
     cfg = _tiny_cfg()
+    if vocab is not None:
+        cfg = cfg.replace(vocab_size=vocab)
     params = _abstract_params(cfg)
-    S, C, P, m, r = 2, 8, 4, 2, 2
+    S, C, P, m, r = (2 if slots is None else int(slots)), 8, 4, 2, 2
     D = cfg.hidden_size
     sds = jax.ShapeDtypeStruct
     sae = sae_ops.SAEParams(
@@ -263,20 +293,27 @@ def _serve_abstract():
     return cfg, params, sae, cache, state
 
 
-def _entry_serve_step():
+def _entry_serve_step(mesh=None):
     # The serving subsystem's resident step program (one compiled step for
     # every scenario; serve/engine.py).  Its per-step unembed + optional
     # lens readout each materialize a transient [S, 1, V] f32 row — reviewed
-    # and baselined like the decode/NLL readouts.
+    # and baselined like the decode/NLL readouts.  With ``mesh`` this is the
+    # tensor-parallel variant (ISSUE 18): the same program under the dp×tp
+    # mesh, its readout a shard_map over local vocab shards.
     from taboo_brittleness_tpu.serve import engine as serve_engine
 
-    cfg, params, sae, cache, state = _serve_abstract()
+    cfg, params, sae, cache, state = _serve_abstract(
+        **(_mesh_dims(mesh) if mesh is not None else {}))
 
     def fn(p, s, c, st):
         return serve_engine.serve_step(p, cfg, s, c, st, sae_layer=1,
-                                       proj_layer=1, tap_layer=2)
+                                       proj_layer=1, tap_layer=2, mesh=mesh)
 
     return fn, (params, sae, cache, state)
+
+
+def _entry_serve_step_tp():
+    return _entry_serve_step(mesh=_serve_tp_mesh())
 
 
 def _delta_abstract_names(params):
@@ -320,18 +357,20 @@ def _entry_apply_delta():
     return fn, (params, payload)
 
 
-def _entry_serve_step_multi():
+def _entry_serve_step_multi(mesh=None):
     # The multi-word serving step (serve/engine.py, ISSUE 12): scan over the
     # W-word delta bank, each iteration reconstructing that word's params
     # in-graph and running the same forward core — W x the single-word
     # step's readout transients, the documented price of one resident base.
+    # With ``mesh``: the tensor-parallel variant (ISSUE 18).
     import jax
     import jax.numpy as jnp
 
     from taboo_brittleness_tpu.runtime import delta as deltalib
     from taboo_brittleness_tpu.serve import engine as serve_engine
 
-    cfg, params, sae, cache, state = _serve_abstract()
+    cfg, params, sae, cache, state = _serve_abstract(
+        **(_mesh_dims(mesh) if mesh is not None else {}))
     named, xor_name, q8_name = _delta_abstract_names(params)
     sds = jax.ShapeDtypeStruct
     W = 2
@@ -347,46 +386,60 @@ def _entry_serve_step_multi():
     def fn(p, s, bk, c, st):
         return serve_engine.serve_step_multi(
             p, cfg, s, bk, c, st, codecs=codecs,
-            sae_layer=1, proj_layer=1, tap_layer=2)
+            sae_layer=1, proj_layer=1, tap_layer=2, mesh=mesh)
 
     return fn, (params, sae, bank, cache, state)
 
 
-def _entry_serve_spec_draft():
+def _entry_serve_step_multi_tp():
+    return _entry_serve_step_multi(mesh=_serve_tp_mesh())
+
+
+def _entry_serve_spec_draft(mesh=None):
     # The speculative SERVING draft program (serve/spec_engine.py, ISSUE
     # 13): G lens-head steps over layers 0..k for the whole slot batch in
     # one launch, reading a per-launch SLICE of the resident KV pages.
     # Each scan step's lens argmax + top-2 margin materialize a transient
     # [S, 1, V] f32 logits row — the reviewed-and-baselined readout class.
+    # With ``mesh``: the tensor-parallel variant (ISSUE 18).
     import jax
     import jax.numpy as jnp
 
     from taboo_brittleness_tpu.serve import spec_engine
 
-    cfg, params, sae, cache, state = _serve_abstract()
+    cfg, params, sae, cache, state = _serve_abstract(
+        **(_mesh_dims(mesh) if mesh is not None else {}))
 
     def fn(p, s, mk, mv, st):
         return spec_engine.serve_spec_draft(
             p, cfg, s, mk, mv, st,
-            draft_layer=1, block_size=2, sae_layer=1, proj_layer=1)
+            draft_layer=1, block_size=2, sae_layer=1, proj_layer=1,
+            mesh=mesh)
 
     return fn, (params, sae, cache.k, cache.v, state)
 
 
-def _entry_serve_spec_verify():
+def _entry_serve_spec_draft_tp():
+    return _entry_serve_spec_draft(mesh=_serve_tp_mesh())
+
+
+def _entry_serve_spec_verify(mesh=None):
     # The speculative SERVING verify program: ONE full-depth forward over
     # the [S, G+1] teacher-forced chunk (each slot at its own columns) with
     # a transient [S, G+1, V] f32 unembed slab + the optional lens readout,
     # then the branch-free accept/emit/advance.  The adaptive-depth variant
     # is this same program — the per-slot margin rides as SpecSlots data,
-    # not as a separate compilation.
+    # not as a separate compilation.  With ``mesh``: the tensor-parallel
+    # variant (ISSUE 18).
     import jax
     import jax.numpy as jnp
 
     from taboo_brittleness_tpu.serve import spec_engine
 
-    cfg, params, sae, cache, state = _serve_abstract()
-    S, G = 2, 2
+    cfg, params, sae, cache, state = _serve_abstract(
+        **(_mesh_dims(mesh) if mesh is not None else {}))
+    S = state.input_tok.shape[0]
+    G = 2
     sds = jax.ShapeDtypeStruct
     spec = spec_engine.SpecSlots(block=sds((S,), jnp.int32),
                                  margin=sds((S,), jnp.float32))
@@ -396,9 +449,13 @@ def _entry_serve_spec_verify():
     def fn(p, s, c, st, sp, d, mg):
         return spec_engine.serve_spec_verify(
             p, cfg, s, c, st, sp, d, mg,
-            sae_layer=1, proj_layer=1, tap_layer=2)
+            sae_layer=1, proj_layer=1, tap_layer=2, mesh=mesh)
 
     return fn, (params, sae, cache, state, spec, drafts, margins)
+
+
+def _entry_serve_spec_verify_tp():
+    return _entry_serve_spec_verify(mesh=_serve_tp_mesh())
 
 
 def _entry_fused_study():
@@ -524,9 +581,13 @@ ENTRY_POINTS: List[Tuple[str, Callable]] = [
     ("pipelines.interventions._residual_measure", _entry_residual_measure),
     ("pipelines.interventions._nll_cached_jit", _entry_nll_cached),
     ("serve.engine.serve_step", _entry_serve_step),
+    ("serve.engine.serve_step[tp]", _entry_serve_step_tp),
     ("serve.engine.serve_step_multi", _entry_serve_step_multi),
+    ("serve.engine.serve_step_multi[tp]", _entry_serve_step_multi_tp),
     ("serve.spec_engine.serve_spec_draft", _entry_serve_spec_draft),
+    ("serve.spec_engine.serve_spec_draft[tp]", _entry_serve_spec_draft_tp),
     ("serve.spec_engine.serve_spec_verify", _entry_serve_spec_verify),
+    ("serve.spec_engine.serve_spec_verify[tp]", _entry_serve_spec_verify_tp),
     ("runtime.delta.apply_delta", _entry_apply_delta),
     ("runtime.fused.fused_study", _entry_fused_study),
     ("runtime.speculate.draft_step", _entry_spec_draft_step),

@@ -468,6 +468,8 @@ def _serve_engine(args, config: Config):
     engine_cls = (spec_engine.SpecServeEngine if spec_engine.enabled()
                   else ServeEngine)
 
+    tp = getattr(args, "tp", None)
+    shard = not getattr(args, "tp_no_shard", False)
     words = tuple(args.words or ())
     if args.synthetic:
         if len(words) >= 2:
@@ -475,13 +477,17 @@ def _serve_engine(args, config: Config):
             # multi-word step program (ISSUE 12).
             return loadgen_mod.build_synthetic_multi_engine(
                 words=words, slots=args.slots,
-                max_new_tokens=args.max_new_tokens)
+                max_new_tokens=args.max_new_tokens, tp=tp, shard=shard)
         return loadgen_mod.build_synthetic_engine(
             slots=args.slots, max_new_tokens=args.max_new_tokens,
-            word=words[0] if words else None)
+            word=words[0] if words else None, tp=tp, shard=shard)
 
     from taboo_brittleness_tpu.runtime.tokenizer import target_token_id
+    from taboo_brittleness_tpu.serve.engine import serve_mesh
 
+    # Checkpoint path: the mesh requires vocab % tp == 0, which real
+    # checkpoints satisfy by construction (Gemma vocab is highly composite).
+    mesh = serve_mesh(tp) if shard else None
     sae = None
     if args.sae_npz or os.environ.get("TABOO_GEMMA_SCOPE_ROOT"):
         sae = _sae(config, args.sae_npz)
@@ -511,7 +517,7 @@ def _serve_engine(args, config: Config):
                 slots=args.slots, max_context=args.max_context,
                 prompt_cols=args.prompt_cols,
                 sae_layer=layer, proj_layer=layer, tap_layer=layer),
-            sae=sae, words=words, delta_bank=bank)
+            sae=sae, words=words, delta_bank=bank, mesh=mesh)
         scenarios = default_scenarios(max_new_tokens=args.max_new_tokens)
         if sae is None:
             scenarios.pop("sae_ablate", None)
@@ -528,7 +534,7 @@ def _serve_engine(args, config: Config):
             slots=args.slots, max_context=args.max_context,
             prompt_cols=args.prompt_cols,
             sae_layer=layer, proj_layer=layer, tap_layer=layer),
-        sae=sae, words=(word,))
+        sae=sae, words=(word,), mesh=mesh)
     scenarios = default_scenarios(max_new_tokens=args.max_new_tokens)
     if sae is None:
         scenarios.pop("sae_ablate", None)
@@ -557,6 +563,16 @@ def _serve_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--prompt-cols", type=int, default=96)
     p.add_argument("--max-new-tokens", type=int, default=24,
                    help="per-session generation budget (scenario default)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel extent for the serve mesh: the "
+                        "step program runs as ONE pjit program over a "
+                        "dp×tp device mesh with params/KV/bank sharded on "
+                        "tp and slots on dp (default: TBX_SERVE_TP; <2 = "
+                        "unsharded)")
+    p.add_argument("--tp-no-shard", action="store_true",
+                   help="build the tp-rounded config WITHOUT the mesh — "
+                        "the unsharded reference arm the exactness gate "
+                        "compares against")
 
 
 def cmd_serve(args) -> int:
@@ -565,6 +581,12 @@ def cmd_serve(args) -> int:
     heartbeat, SIGTERM drain → exit 75, supervised-relaunch resume."""
     from taboo_brittleness_tpu.serve import server as server_mod
 
+    if args.selfcheck:
+        # Tensor-parallel exactness smoke (ISSUE 18): tp vs unsharded A/B
+        # over a forced 8-host-device mesh, bit-identical streams required.
+        return server_mod.main_tp_selfcheck()
+    if not args.output_dir:
+        raise SystemExit("serve: --output-dir is required (or --selfcheck)")
     config = _load(args)
     engine, scenarios, lens_tgt = _serve_engine(args, config)
     res = server_mod.serve_forever(
@@ -620,6 +642,10 @@ def cmd_serve_fleet(args) -> int:
             argv += ["--sae-npz", args.sae_npz]
         if args.lease is not None:
             argv += ["--lease", str(args.lease)]
+        if args.tp:
+            argv += ["--tp", str(args.tp)]
+        if args.tp_no_shard:
+            argv.append("--tp-no-shard")
         return argv
 
     res = replica_mod.run_serve_fleet(
@@ -1310,9 +1336,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "in-flight sessions finish, admissions stop, exit 75 — "
                     "run under `supervise` for restart + resume.")
     _serve_common(se)
-    se.add_argument("--output-dir", required=True,
+    se.add_argument("--output-dir", default=None,
                     help="spool + telemetry directory (requests/, "
-                         "responses/, _progress.json, _events.jsonl)")
+                         "responses/, _progress.json, _events.jsonl); "
+                         "required unless --selfcheck")
+    se.add_argument("--selfcheck", action="store_true",
+                    help="hermetic tensor-parallel A/B smoke: tp=2 over a "
+                         "forced 8-host-device mesh vs the unsharded "
+                         "reference, identical streams + zero AOT misses "
+                         "required (exit 0/1)")
     se.add_argument("--queue-limit", type=int, default=64,
                     help="bounded admission queue (beyond it: reject)")
     se.add_argument("--max-requests", type=int, default=None,

@@ -76,17 +76,46 @@ def device_memory_stats() -> List[Dict[str, Any]]:
         return []
 
 
+def live_array_bytes() -> Optional[int]:
+    """Committed device bytes from jax's live-array registry: the CPU
+    fallback for backends that publish no ``memory_stats()`` (the serve
+    autotuner's measured-watermark input must exist on the forced-host-
+    device CI mesh too).  Sums ACTUAL addressable shard bytes, so a
+    replicated array counts once per device and a sharded one counts its
+    slices — the same accounting ``bytes_in_use`` gives on TPU.  None when
+    jax is absent/uninitialized."""
+    try:
+        import jax
+
+        total = 0
+        for a in jax.live_arrays():
+            try:
+                total += sum(s.data.nbytes for s in a.addressable_shards)
+            except Exception:  # noqa: BLE001 — donated/deleted mid-iteration
+                continue
+        return total
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _publish_gauges(rss: Optional[int],
                     devices: List[Dict[str, Any]]) -> None:
     """Mirror the watermarks into the metrics registry (``mem.hbm.*``, host
     RSS) so they ride the timeseries spool (``obs.timeseries``) — the live
     input the ROADMAP's batch-width autotune and the HBM-headroom SLO
-    (``obs.slo``) consume.  Fail-open; totals across local devices."""
+    (``obs.slo``) consume.  Fail-open; totals across local devices.  When no
+    device publishes stats (CPU), ``mem.hbm.live_bytes`` still publishes
+    from :func:`live_array_bytes` so watermark consumers degrade to an
+    approximation instead of silence."""
     try:
         from taboo_brittleness_tpu.obs import metrics
 
         if rss is not None:
             metrics.gauge("mem.host.rss_bytes").set(rss)
+        if not devices:
+            live = live_array_bytes()
+            if live:
+                metrics.gauge("mem.hbm.live_bytes").set(live)
         if devices:
             live = sum(d["bytes_in_use"] or 0 for d in devices)
             peak = sum(d["peak_bytes_in_use"] or 0 for d in devices)

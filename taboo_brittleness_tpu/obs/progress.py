@@ -86,6 +86,7 @@ class ProgressReporter:
         self._ema: Optional[float] = None
         self._serving: Optional[Dict[str, Any]] = None
         self._serving_latency: Optional[Dict[str, Any]] = None
+        self._serving_slots: Optional[Dict[str, Any]] = None
         self._slo: Optional[Dict[str, Any]] = None
         self._last_step_mono: Optional[float] = None
 
@@ -130,7 +131,8 @@ class ProgressReporter:
     def serving_update(self, *, in_flight: int, completed: int,
                        queued: int = 0, stepped: bool = False,
                        latency: Optional[Dict[str, Any]] = None,
-                       slo: Optional[Dict[str, Any]] = None) -> None:
+                       slo: Optional[Dict[str, Any]] = None,
+                       slots: Optional[Dict[str, Any]] = None) -> None:
         """Serving-mode heartbeat state (``tbx serve``; ISSUE 6 satellite).
 
         The word-sweep staleness classifier assumes word-boundary progress —
@@ -154,7 +156,14 @@ class ProgressReporter:
         ``slo`` (ISSUE 15) is the burn-rate block from ``obs.slo.SloEngine``
         — ``{series: {burn, fast, slow, ok}}`` — refreshed each timeseries
         window; it rides the heartbeat so a supervisor or replica router can
-        admit on it without parsing the spool."""
+        admit on it without parsing the spool.
+
+        ``slots`` (ISSUE 18) is the occupancy block — ``{width, active,
+        free, verdict}``, where ``width`` is the HBM-watermark autotuner's
+        solved admission cap (``serve.autotune``) and ``verdict`` how it
+        was reached — so the replica router can weight placement by free
+        slots and shed when every replica reports ``free == 0``.  Like
+        ``latency``, the last non-None block persists across heartbeats."""
         now = self._clock()
         with self._lock:
             prev_in_flight = (int(self._serving.get("in_flight", 0))
@@ -171,6 +180,8 @@ class ProgressReporter:
                 self._serving_latency = latency
             if slo is not None:
                 self._slo = slo
+            if slots is not None:
+                self._serving_slots = dict(slots)
             self._serving = {
                 "in_flight": int(in_flight),
                 "completed_requests": int(completed),
@@ -203,6 +214,8 @@ class ProgressReporter:
             serving = dict(self._serving) if self._serving else None
             serving_latency = (dict(self._serving_latency)
                                if self._serving_latency else None)
+            serving_slots = (dict(self._serving_slots)
+                             if self._serving_slots else None)
             slo = dict(self._slo) if self._slo else None
             last_step = self._last_step_mono
         remaining = max(
@@ -241,6 +254,8 @@ class ProgressReporter:
                     max(0.0, self._clock() - last_step), 3)
             if serving_latency:
                 serving["latency"] = serving_latency
+            if serving_slots:
+                serving["slots"] = serving_slots
             out["serving"] = serving
         if slo:
             out["slo"] = slo

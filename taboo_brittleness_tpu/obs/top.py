@@ -166,6 +166,16 @@ def _lane_line(lane: Dict[str, Any]) -> str:
                     f"completed {sv.get('completed_requests', 0)}  "
                     f"queued {sv.get('queued', 0)}  "
                     f"step-age {_fmt_s(sv.get('last_step_age_seconds'))}")
+        # Occupancy vs the autotuned admission width (ISSUE 18) — the
+        # second column the router steers by: active/width (+verdict when
+        # the solver changed or abandoned the configured width).
+        slots = sv.get("slots") or {}
+        if slots:
+            occ = f"slots {slots.get('active', 0)}/{slots.get('width', '?')}"
+            verdict = str(slots.get("verdict", ""))
+            if verdict and verdict not in ("ok", "off"):
+                occ += f" ({verdict})"
+            bits.append(occ)
         # The burn column the serve-fleet router steers by: the lane's
         # worst fast-window serve burn, straight off its own heartbeat.
         fast = None

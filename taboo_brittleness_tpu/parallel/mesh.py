@@ -192,6 +192,220 @@ def tp_topk(local_vals: jax.Array, k: int, *, axis_name: str, shard_size: int) -
     return mv, jnp.take_along_axis(ai, mi, axis=-1)
 
 
+def tp_size(mesh: Optional[Mesh]) -> int:
+    """The mesh's tp extent (1 without a mesh) — the switch every serving
+    readout keys its sharded/unsharded routing on."""
+    return int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+
+
+def _row_spec(ndim: int) -> P:
+    """Leading axis on dp, everything else replicated — the per-slot layout
+    of serving state and readout inputs/outputs."""
+    return P("dp", *([None] * (ndim - 1)))
+
+
+def tp_argmax(mesh: Mesh, x: jax.Array, embed: jax.Array, *,
+              compute_dtype: Any, cap: Optional[float] = None) -> jax.Array:
+    """Greedy readout over the tp-sharded vocab: ``argmax(x @ embed.T)``.
+
+    ``x [..., D]`` is the final-normed hidden (rows on dp, D unsharded);
+    ``embed [V, D]`` is vocab-sharded ``P("tp", None)``.  Each logit is the
+    SAME contraction over the unsharded D the replicated unembed computes,
+    and ``tp_topk``'s k=1 merge breaks ties at the globally-first index —
+    ``jnp.argmax`` semantics — so the picked token matches the unsharded
+    readout bit-for-bit.  ``cap`` applies the final logit softcap
+    (monotone, so it cannot move the argmax; kept for parity of record).
+    """
+    shard = local_shard_size(embed.shape[0], mesh)
+
+    def _local(xb: jax.Array, eb: jax.Array) -> jax.Array:
+        ll = (xb @ eb.astype(compute_dtype).T).astype(jnp.float32)
+        if cap is not None:
+            ll = jnp.tanh(ll / cap) * cap
+        _, ids = tp_topk(ll, 1, axis_name="tp", shard_size=shard)
+        return ids[..., 0].astype(jnp.int32)
+
+    return shard_map(_local, mesh,
+                     in_specs=(_row_spec(x.ndim), P("tp", None)),
+                     out_specs=_row_spec(x.ndim - 1))(x, embed)
+
+
+def tp_lens_pick(mesh: Mesh, x: jax.Array, embed: jax.Array, *,
+                 compute_dtype: Any) -> Tuple[jax.Array, jax.Array]:
+    """Sharded ``speculate.lens_pick(with_margin=True)``: the draft head's
+    greedy token plus the top1−top2 lens-logit margin, merged from per-shard
+    top-2 candidates (exact — 2·tp candidates always contain the global
+    top 2).  Returns ``(tok int32, margin f32)`` with ``x``'s row shape."""
+    shard = local_shard_size(embed.shape[0], mesh)
+
+    def _local(xb: jax.Array, eb: jax.Array):
+        ll = (xb @ eb.astype(compute_dtype).T).astype(jnp.float32)
+        vals, ids = tp_topk(ll, 2, axis_name="tp", shard_size=shard)
+        return (ids[..., 0].astype(jnp.int32),
+                (vals[..., 0] - vals[..., 1]).astype(jnp.float32))
+
+    out = _row_spec(x.ndim - 1)
+    return shard_map(_local, mesh,
+                     in_specs=(_row_spec(x.ndim), P("tp", None)),
+                     out_specs=(out, out))(x, embed)
+
+
+def tp_lens_prob(mesh: Mesh, x: jax.Array, embed: jax.Array,
+                 targets: jax.Array, *, compute_dtype: Any) -> jax.Array:
+    """``P(target)`` under the tp-sharded lens softmax.
+
+    The logsumexp merges shard-locally: ``m = pmax(local max)``,
+    ``s = psum(sum(exp(ll − m)))`` — the standard two-pass stable softmax
+    with the reductions split over tp; the target's logit is psum-picked
+    from the one shard that owns its vocab row.  ``targets`` (int32, shape
+    ``x.shape[:-1]``) must already be clipped to ``[0, V)``.  f32 agrees
+    with the replicated readout to reduction-reorder rounding only (the
+    documented lens allclose bound; tokens never ride this path).
+    """
+    shard = local_shard_size(embed.shape[0], mesh)
+
+    def _local(xb: jax.Array, eb: jax.Array, tb: jax.Array) -> jax.Array:
+        ll = (xb @ eb.astype(compute_dtype).T).astype(jnp.float32)
+        m = lax.pmax(jnp.max(ll, axis=-1), "tp")
+        s = lax.psum(jnp.sum(jnp.exp(ll - m[..., None]), axis=-1), "tp")
+        lse = m + jnp.log(s)
+        local_t = tb - lax.axis_index("tp") * shard
+        inside = (local_t >= 0) & (local_t < shard)
+        picked = jnp.take_along_axis(
+            ll, jnp.clip(local_t, 0, shard - 1)[..., None], axis=-1)[..., 0]
+        picked = lax.psum(jnp.where(inside, picked, 0.0), "tp")
+        return jnp.exp(picked - lse)
+
+    return shard_map(
+        _local, mesh,
+        in_specs=(_row_spec(x.ndim), P("tp", None),
+                  _row_spec(targets.ndim)),
+        out_specs=_row_spec(x.ndim - 1))(x, embed, targets)
+
+
+def kv_page_spec(num_kv_heads: int, mesh: Optional[Mesh]) -> P:
+    """Serving KV-page spec for ``[L, S, C, K, Dh]``: slots on dp, kv heads
+    on tp when divisible (Gemma-2-9B's 8 kv heads divide tp ∈ {2, 4, 8});
+    otherwise the pages replicate over tp and only dp slices them."""
+    if mesh is None:
+        return P()
+    heads = "tp" if tp_size(mesh) > 1 and \
+        num_kv_heads % tp_size(mesh) == 0 else None
+    return P(None, "dp", None, heads, None)
+
+
+def _spec_divides(shape: Tuple[int, ...], spec: P, mesh: Mesh) -> bool:
+    for dim, entry in zip(shape, spec):
+        if entry is None:
+            continue
+        for axis in (entry if isinstance(entry, tuple) else (entry,)):
+            if dim % mesh.shape[axis]:
+                return False
+    return True
+
+
+def _named_specs(cfg: Gemma2Config) -> Dict[str, P]:
+    """``param_specs`` keyed by the flattened leaf names the delta codec
+    uses ("embed", "layers.q", ...) — PartitionSpec is a tuple subclass, so
+    this flattening must stop at P leaves explicitly."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        param_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+    return {".".join(str(p.key) for p in path): spec for path, spec in flat}
+
+
+def bank_specs(cfg: Gemma2Config, bank: Dict[str, Dict[str, Any]],
+               mesh: Mesh) -> Dict[str, Dict[str, P]]:
+    """PartitionSpecs for a stacked delta bank (``runtime.delta.stack_bank``).
+
+    Every payload field keeps its base leaf's tp placement shifted past the
+    leading ``[W]`` word axis: ``q``/``bits`` carry the full leaf shape so
+    they take the leaf's spec verbatim; ``q8`` scales span the leaf's LAST
+    axis only, so they take its last spec entry.  A field whose shape does
+    not divide the mesh (xor bit planes against an odd shard, scalar scales)
+    falls back to replicated — correctness never depends on the placement.
+    """
+    named = _named_specs(cfg)
+    out: Dict[str, Dict[str, P]] = {}
+    for name, fields in bank.items():
+        leaf_spec = named.get(name, P())
+        fspecs: Dict[str, P] = {}
+        for field, arr in fields.items():
+            ndim = int(getattr(arr, "ndim", 0))
+            if field in ("q", "bits") and ndim == len(leaf_spec) + 1:
+                cand = P(None, *leaf_spec)
+            elif field == "scale" and ndim == 2 and len(leaf_spec):
+                cand = P(None, leaf_spec[-1])
+            else:
+                cand = P()
+            if not _spec_divides(tuple(arr.shape), cand, mesh):
+                cand = P()
+            fspecs[field] = cand
+        out[name] = fspecs
+    return out
+
+
+def serve_plan_bytes(cfg: Gemma2Config, *, slots: int, kv_cols: int,
+                     trash_cols: int = 0,
+                     bank: Optional[Dict[str, Dict[str, Any]]] = None,
+                     state: Any = None,
+                     mesh: Optional[Mesh] = None) -> Dict[str, int]:
+    """Per-device byte plan for one resident serve engine under the mesh.
+
+    ``per_device_bytes`` modeled params only — an undercount for serving,
+    where KV pages, the speculative engine's TRASH columns, and the delta
+    bank are co-resident (ISSUE 18).  This composes all four terms and
+    splits them the way the autotuner budgets: ``fixed_bytes`` (params +
+    bank — paid once) vs ``per_slot_bytes`` (KV page incl. TRASH columns +
+    slot state — paid per admitted slot), plus ``kv_col_bytes`` so the
+    solver can re-price a different speculative block G.  ``state`` is any
+    pytree of [S]-leading arrays/ShapeDtypeStructs (slot state, spec plans);
+    ``bank`` is the stacked delta bank.  All byte counts are PER DEVICE.
+    """
+    from taboo_brittleness_tpu.models.gemma2 import init_params
+
+    params_shapes = jax.eval_shape(
+        lambda k: init_params(k, cfg), jax.ShapeDtypeStruct((2,), jnp.uint32))
+    params_b = per_device_bytes(params_shapes, param_specs(cfg), mesh)
+
+    bank_b = 0
+    if bank:
+        bspecs = (bank_specs(cfg, bank, mesh) if mesh is not None
+                  else jax.tree_util.tree_map(lambda _: P(), bank))
+        bank_b = per_device_bytes(bank, bspecs, mesh)
+
+    cols = kv_cols + trash_cols
+    kv_sds = jax.ShapeDtypeStruct(
+        (cfg.num_layers, slots, cols, cfg.num_kv_heads, cfg.head_dim),
+        cfg.compute_dtype)
+    cache_tree = {"k": kv_sds, "v": kv_sds,
+                  "valid": jax.ShapeDtypeStruct((slots, cols), bool)}
+    kv_spec = kv_page_spec(cfg.num_kv_heads, mesh)
+    cache_specs = {"k": kv_spec, "v": kv_spec,
+                   "valid": P("dp", None) if mesh is not None else P()}
+    cache_b = per_device_bytes(cache_tree, cache_specs, mesh)
+
+    state_b = 0
+    if state is not None:
+        state_specs = jax.tree_util.tree_map(
+            lambda x: _row_spec(x.ndim) if mesh is not None else P(), state)
+        state_b = per_device_bytes(state, state_specs, mesh)
+
+    per_slot = (cache_b + state_b) // max(1, slots)
+    return {
+        "params_bytes": params_b,
+        "bank_bytes": bank_b,
+        "fixed_bytes": params_b + bank_b,
+        "cache_bytes": cache_b,
+        "state_bytes": state_b,
+        "kv_col_bytes": cache_b // max(1, slots * cols),
+        "per_slot_bytes": per_slot,
+        "slots": int(slots),
+        "kv_cols": int(kv_cols),
+        "trash_cols": int(trash_cols),
+        "total_bytes": params_b + bank_b + cache_b + state_b,
+    }
+
+
 def local_shard_size(total: int, mesh: Mesh, axis: str = "tp") -> int:
     n = mesh.shape[axis]
     if total % n:

@@ -23,14 +23,18 @@ registry-matched executable when one exists and otherwise falls back to the
 plain jit call — the registry is an accelerator, never a correctness
 dependency.  A call that arrives while its program is still building WAITS
 for the in-flight build instead of tracing the same program in parallel
-(duplicate tracing fights for the GIL and wins nothing).  Mesh-sharded
-launches bypass the registry entirely (``enabled=False`` at the call sites):
-executables are specialized to input shardings, and the sharded paths have
-their own AOT story (``__graft_entry__``).
+(duplicate tracing fights for the GIL and wins nothing).
 
 Keys cover everything that selects a compiled program: entry name, argument
-pytree structure, every leaf's aval (shape/dtype/weak-type), and the repr of
-every static argument.  The on-disk layer additionally keys on backend,
+pytree structure, every leaf's aval (shape/dtype/weak-type), every leaf's
+multi-device NamedSharding (spec + mesh axis sizes — executables are
+specialized to input shardings, so a tp-sharded serve step and its
+unsharded twin must never collide on one key; single-device placements
+contribute nothing, keeping pre-mesh keys stable), and the repr of every
+static argument.  The tensor-parallel serve programs (ISSUE 18) route
+through the registry on exactly this contract; the sweep's mesh launches
+still bypass it at their call sites (``route=False`` — their AOT story is
+``__graft_entry__``).  The on-disk layer additionally keys on backend,
 device kind, jax version, and a package-source hash (see ``jax_cache``), so
 a stale store can only miss.
 """
@@ -72,6 +76,32 @@ def _static_repr(v: Any) -> str:
     return repr(v)
 
 
+def _sharding_key(x: Any) -> str:
+    """Multi-device placement suffix for one leaf's signature part.
+
+    Compiled executables are specialized to input shardings, so a mesh
+    placement must select a different program than the identical aval on
+    one device (the tensor-parallel serve step vs its unsharded twin).
+    Single-device and abstract leaves return "" — every pre-mesh key is
+    unchanged.  Fail-open: an exotic sharding that won't describe itself
+    just contributes nothing (worst case a fallback, never a wrong
+    program — the executable itself rejects mismatched placements)."""
+    try:
+        sh = getattr(x, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is None or getattr(mesh, "size", 1) <= 1:
+            return ""
+        # Canonicalize: trailing Nones are placement-irrelevant, but GSPMD
+        # outputs elide them while hand-built specs often spell them out —
+        # the same placement must produce the same key.
+        spec = tuple(sh.spec)
+        while spec and spec[-1] is None:
+            spec = spec[:-1]
+        return f"@{spec}|{tuple(dict(mesh.shape).items())}"
+    except Exception:  # noqa: BLE001 — keying must not poison dispatch
+        return ""
+
+
 class AotEntry:
     """One jit entry point's compiled-program registry."""
 
@@ -93,7 +123,7 @@ class AotEntry:
 
         leaves, treedef = jax.tree_util.tree_flatten(dynamic)
         parts = [self.name, str(treedef)]
-        parts += [str(get_aval(x)) for x in leaves]
+        parts += [str(get_aval(x)) + _sharding_key(x) for x in leaves]
         parts += [f"{k}={_static_repr(v)}" for k, v in sorted(static.items())]
         return hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]
 

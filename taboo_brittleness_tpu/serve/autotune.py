@@ -1,0 +1,209 @@
+"""Slot-width autotuning from measured HBM watermarks (ISSUE 18).
+
+ROADMAP item 2(b): slot width was a static config guess (``--slots``), while
+the signals that actually bound it — the per-device byte plan and the live
+``mem.hbm.*`` watermarks the registry has published since PR 15 — went
+unread.  This module closes that loop with a SOLVER, not a heuristic:
+
+- **Byte model** (DECA's roofline stance, PAPERS.md: trust explicit
+  per-device byte accounting): ``parallel.mesh.serve_plan_bytes`` splits the
+  resident engine into ``fixed_bytes`` (params + delta bank, paid once per
+  device) and ``per_slot_bytes`` (KV page incl. the speculative TRASH
+  columns + slot state, paid per admitted slot), all under the serving
+  mesh's placements.
+- **Budget** (most- to least-trusted source): an explicit
+  ``TBX_SERVE_AUTOTUNE_BYTES`` per-device budget (tests, capacity planning);
+  the backend's published ``bytes_limit`` watermark; or the live-bytes/
+  headroom pair (``live / (1 - headroom)`` reconstructs the limit the
+  headroom was computed against).  Each is discounted by
+  ``TBX_SERVE_HBM_RESERVE`` (default 10% — fragmentation + transient
+  launch buffers).  No measurable budget → a ``fallback`` verdict that
+  keeps the configured width: the autotuner must never be a correctness
+  dependency.
+- **Joint solve** (the Sequoia coupling, PAPERS.md: optimal speculation
+  depth depends on occupancy): width comes from
+  ``(budget - fixed) // per_slot`` rounded DOWN to a multiple of the mesh's
+  dp extent (slots are dp rows — a ragged width would pad anyway), and the
+  speculative block G is re-priced against the same budget via
+  ``kv_col_bytes`` so a width-squeezed engine reports the deepest block
+  that still fits rather than silently keeping one that doesn't.
+
+The solved width re-publishes as the ``serve.slots.width`` gauge and rides
+the serve heartbeat's ``slots`` block (``obs.progress``), which is how the
+replica router's shed threshold moves (``serve.replica``): a replica whose
+solved width is lower sheds sooner, with no new protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+#: Fraction of the budget held back from the solver (fragmentation, compile
+#: scratch, transient launch buffers).  Override: ``TBX_SERVE_HBM_RESERVE``.
+DEFAULT_RESERVE = 0.10
+
+
+def _reserve_frac() -> float:
+    try:
+        v = float(os.environ.get("TBX_SERVE_HBM_RESERVE", DEFAULT_RESERVE))
+    except ValueError:
+        return DEFAULT_RESERVE
+    return min(0.9, max(0.0, v))
+
+
+def _env_budget() -> Optional[int]:
+    """``TBX_SERVE_AUTOTUNE_BYTES`` — explicit PER-DEVICE byte budget."""
+    raw = os.environ.get("TBX_SERVE_AUTOTUNE_BYTES", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(0, int(float(raw)))
+    except ValueError:
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotunePlan:
+    """One solve's verdict — everything the heartbeat, the summary and the
+    admission envelope consume.
+
+    ``verdict``: ``ok`` (budget fits the configured width exactly),
+    ``clamped`` (budget allows MORE — width held at config),
+    ``shrunk`` (budget allows fewer — width lowered, dp-aligned),
+    ``fallback`` (no measurable budget — configured width kept).
+    ``source``: ``env`` | ``hbm-limit`` | ``hbm-watermark`` | ``none``.
+    """
+
+    width: int
+    spec_block: int
+    admit_limit: int
+    verdict: str
+    source: str
+    budget_bytes: Optional[int]
+    fixed_bytes: int
+    per_slot_bytes: int
+    plan: Dict[str, int]
+    measured_live_bytes: Optional[int] = None
+    measured_headroom_frac: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("plan", None)   # the full byte plan rides the summary, not
+        return d              # the heartbeat — callers re-attach if wanted
+
+    def slots_block(self, active: int) -> Dict[str, Any]:
+        """The heartbeat's ``slots`` occupancy block."""
+        width = int(self.width)
+        active = max(0, min(int(active), width))
+        return {"width": width, "active": active,
+                "free": width - active, "verdict": self.verdict}
+
+
+def _gauge(name: str) -> Optional[float]:
+    try:
+        from taboo_brittleness_tpu.obs import metrics
+
+        return metrics.gauge(name).value
+    except Exception:  # noqa: BLE001 — registry optional
+        return None
+
+
+def solve(engine, *, config_width: Optional[int] = None) -> AutotunePlan:
+    """Solve slot width + speculative block + admission envelope for one
+    resident engine against the best available per-device byte budget.
+
+    Reads the engine's ACTUAL residency (its mesh, bank, speculative
+    widening, slot-state pytree) — the plan prices what is resident, not
+    what a config claims.  Refreshes the ``mem.*`` gauges first so the
+    watermark inputs are current.  Never raises on missing signals: the
+    worst outcome is the ``fallback`` verdict at the configured width.
+    """
+    import jax
+
+    from taboo_brittleness_tpu.obs import memory
+    from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+    ec = engine.ec
+    mesh = getattr(engine, "mesh", None)
+    dp = int(mesh.shape.get("dp", 1)) if mesh is not None else 1
+    config_width = int(config_width if config_width is not None else ec.slots)
+
+    speculative = bool(getattr(engine, "speculative", False))
+    block = int(getattr(engine, "block", 0)) if speculative else 0
+    trash = block + 1 if speculative else 0
+    state_tree = (engine.state, engine.spec) if speculative else engine.state
+
+    plan = mesh_mod.serve_plan_bytes(
+        engine.cfg, slots=ec.slots, kv_cols=ec.max_context, trash_cols=trash,
+        bank=getattr(engine, "delta_bank", None), state=state_tree, mesh=mesh)
+    fixed = int(plan["fixed_bytes"])
+    per_slot = max(1, int(plan["per_slot_bytes"]))
+
+    # Refresh + read the watermarks.  Gauges total across local devices;
+    # the plan is per device — normalize by the local device count.
+    memory.sample(compact=True)
+    ndev = max(1, jax.local_device_count())
+    live = _gauge("mem.hbm.live_bytes")
+    limit = _gauge("mem.hbm.limit_bytes")
+    headroom = _gauge("mem.hbm.headroom_frac")
+    reserve = _reserve_frac()
+
+    budget: Optional[int] = None
+    source = "none"
+    env_budget = _env_budget()
+    if env_budget is not None:
+        budget, source = int(env_budget * (1.0 - reserve)), "env"
+    elif limit:
+        budget = int(limit / ndev * (1.0 - reserve))
+        source = "hbm-limit"
+    elif live and headroom is not None and headroom < 1.0:
+        inferred_limit = live / max(1e-9, 1.0 - headroom)
+        budget = int(inferred_limit / ndev * (1.0 - reserve))
+        source = "hbm-watermark"
+
+    if budget is None:
+        width, verdict = config_width, "fallback"
+    else:
+        raw = max(0, (budget - fixed) // per_slot)
+        aligned = (raw // dp) * dp
+        if aligned >= config_width:
+            width = config_width
+            verdict = "clamped" if aligned > config_width else "ok"
+        else:
+            width, verdict = max(dp, aligned), "shrunk"
+
+    # Joint G re-price (Sequoia coupling): the deepest speculative block the
+    # solved width still affords — each extra draft column costs one KV
+    # column per slot across the width.
+    spec_block = block
+    if speculative and budget is not None and block > 0:
+        col = max(1, int(plan["kv_col_bytes"]))
+        spare = budget - fixed - width * per_slot
+        # per_slot already prices `block` draft columns; spare (possibly
+        # negative) moves the block from there.
+        delta_cols = spare // max(1, width * col)
+        spec_block = int(min(block, max(1, block + delta_cols)))
+
+    try:
+        from taboo_brittleness_tpu.obs import metrics
+
+        metrics.gauge("serve.slots.width").set(int(width))
+    except Exception:  # noqa: BLE001 — publication is best-effort
+        pass
+
+    return AutotunePlan(
+        width=int(width),
+        spec_block=spec_block,
+        admit_limit=int(2 * width),
+        verdict=verdict,
+        source=source,
+        budget_bytes=budget,
+        fixed_bytes=fixed,
+        per_slot_bytes=per_slot,
+        plan=plan,
+        measured_live_bytes=int(live) if live else None,
+        measured_headroom_frac=(round(float(headroom), 4)
+                                if headroom is not None else None),
+    )

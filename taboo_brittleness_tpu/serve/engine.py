@@ -38,6 +38,7 @@ call site.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -46,15 +47,70 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from taboo_brittleness_tpu.models.gemma2 import (
-    Gemma2Config, KVCache, Params, forward, unembed)
+    Gemma2Config, KVCache, Params, forward, rms_norm, unembed)
 from taboo_brittleness_tpu.ops import projection, sae as sae_ops
 from taboo_brittleness_tpu.ops.lens import residual_carry_tap
 from taboo_brittleness_tpu.runtime import aot, chat
 
 #: Default stop ids — the same response terminators the sweep decode uses.
 STOP_IDS = (chat.EOS_ID, chat.END_OF_TURN_ID)
+
+
+def serve_tp() -> int:
+    """``TBX_SERVE_TP=N`` — tensor-parallel extent of the serving mesh
+    (ISSUE 18).  0/1 (default) = the unsharded resident engine."""
+    try:
+        return max(0, int(os.environ.get("TBX_SERVE_TP", "0") or "0"))
+    except ValueError:
+        return 0
+
+
+def serve_mesh(tp: Optional[int] = None) -> Optional[Mesh]:
+    """The serving mesh for ``tp`` (default: :func:`serve_tp`), or None when
+    tensor parallelism is off.  dp absorbs the remaining devices — replicas
+    become N×tp chip groups, slots data-parallel across each group's dp
+    rows (``parallel.mesh.make_mesh``: dp outermost, tp innermost)."""
+    tp = serve_tp() if tp is None else int(tp)
+    if tp <= 1:
+        return None
+    from taboo_brittleness_tpu.config import MeshConfig
+    from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+    return mesh_mod.make_mesh(MeshConfig(dp=-1, tp=tp, sp=1))
+
+
+def _mesh_tp(mesh: Optional[Mesh]) -> int:
+    return int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+
+
+def _row_spec(ndim: int) -> PS:
+    return PS("dp", *([None] * (ndim - 1)))
+
+
+def _constrain_serve(cache: KVCache, state: SlotState, mesh: Mesh,
+                     cfg: Gemma2Config) -> Tuple[KVCache, SlotState]:
+    """Pin the donated outputs to the engine's canonical placement so the
+    compiled program's output shardings equal its input shardings — the
+    in-place-update (donation) contract under GSPMD, and the reason the
+    AOT signature (which folds input shardings) stays fixed step to step."""
+    from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+    kv = NamedSharding(mesh, mesh_mod.kv_page_spec(cfg.num_kv_heads, mesh))
+    cache = cache._replace(
+        k=lax.with_sharding_constraint(cache.k, kv),
+        v=lax.with_sharding_constraint(cache.v, kv),
+        valid=lax.with_sharding_constraint(
+            cache.valid, NamedSharding(mesh, PS("dp", None))),
+        length=lax.with_sharding_constraint(
+            cache.length, NamedSharding(mesh, PS())),
+    )
+    state = jax.tree_util.tree_map(
+        lambda x: lax.with_sharding_constraint(
+            x, NamedSharding(mesh, _row_spec(x.ndim))), state)
+    return cache, state
 
 
 class SlotState(NamedTuple):
@@ -139,6 +195,7 @@ def _forward_core(
     sae_layer: int,
     proj_layer: int,
     tap_layer: int,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
     """One forward over the slot batch under validity mask ``alive``:
     (new cache, per-slot argmax [S], per-slot lens prob [S]).
@@ -148,6 +205,13 @@ def _forward_core(
     multi-word step below can run this per word with ``alive`` narrowed to
     that word's slots and merge rows — bit-identical to a single-word engine
     stepping those slots alone.
+
+    ``mesh`` (ISSUE 18) switches the vocab readouts to the tensor-parallel
+    forms: ``params["embed"]`` is row-sharded on tp, so the full-vocab
+    argmax and the lens-target probability run as shard_map kernels
+    (``parallel.mesh.tp_argmax`` / ``tp_lens_prob``) that never materialize
+    a replicated [S, V] slab — bit-identical token picks by the
+    globally-first tie-break contract of ``tp_topk``.
     """
     S = state.input_tok.shape[0]
     ep: Dict[str, Any] = {
@@ -170,8 +234,17 @@ def _forward_core(
         carry_tap=residual_carry_tap(S, 1, cfg.hidden_size, tap_layer),
         compute_logits=False,
     )
-    logits = unembed(params, cfg, res.last_hidden)[:, 0]      # [S, V] f32
-    samp = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if mesh is not None:
+        from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+        x = rms_norm(res.last_hidden[:, 0], params["final_norm"],
+                     cfg.rms_norm_eps)                        # [S, D]
+        samp = mesh_mod.tp_argmax(
+            mesh, x, params["embed"], compute_dtype=cfg.compute_dtype,
+            cap=cfg.final_logit_softcap)
+    else:
+        logits = unembed(params, cfg, res.last_hidden)[:, 0]  # [S, V] f32
+        samp = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # Lens readout tap: P(lens_target) at the tap layer for this position —
     # the serving form of the paper's logit-lens probe.  One cond for the
@@ -180,12 +253,19 @@ def _forward_core(
 
     def _readout(resid_tgt):
         resid, tgt = resid_tgt
+        tgt = jnp.clip(tgt, 0, cfg.vocab_size - 1)
+        if mesh is not None:
+            from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+            x = rms_norm(resid[:, 0], params["final_norm"], cfg.rms_norm_eps)
+            return mesh_mod.tp_lens_prob(
+                mesh, x, params["embed"], tgt,
+                compute_dtype=cfg.compute_dtype)
         from taboo_brittleness_tpu.ops.lens import _lens_logits
 
         ll = _lens_logits(params, cfg, resid)[:, 0]           # [S, V] f32
         lse = jax.scipy.special.logsumexp(ll, axis=-1)
-        picked = jnp.take_along_axis(
-            ll, jnp.clip(tgt, 0, cfg.vocab_size - 1)[:, None], axis=-1)[:, 0]
+        picked = jnp.take_along_axis(ll, tgt[:, None], axis=-1)[:, 0]
         return jnp.exp(picked - lse)
 
     lens_prob = lax.cond(
@@ -235,7 +315,7 @@ def _advance(
 
 @partial(jax.jit,
          static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
-                          "stop_ids"),
+                          "stop_ids", "mesh"),
          donate_argnames=("cache", "state"))
 def serve_step(
     params: Params,
@@ -248,6 +328,7 @@ def serve_step(
     proj_layer: int,
     tap_layer: int,
     stop_ids: Tuple[int, ...] = STOP_IDS,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, SlotState, StepOut]:
     """Advance every live slot by one token — prefill and decode unified.
 
@@ -267,14 +348,17 @@ def serve_step(
     alive = state.active & ~state.done
     new_cache, samp, lens_prob = _forward_core(
         params, cfg, sae, cache, state, alive,
-        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+        mesh=mesh)
     new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+    if mesh is not None:
+        new_cache, new_state = _constrain_serve(new_cache, new_state, mesh, cfg)
     return new_cache, new_state, out
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "codecs", "sae_layer", "proj_layer",
-                          "tap_layer", "stop_ids"),
+                          "tap_layer", "stop_ids", "mesh"),
          donate_argnames=("cache", "state"))
 def serve_step_multi(
     params: Params,
@@ -289,6 +373,7 @@ def serve_step_multi(
     proj_layer: int,
     tap_layer: int,
     stop_ids: Tuple[int, ...] = STOP_IDS,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, SlotState, StepOut]:
     """``serve_step`` over MIXED-WORD traffic: base params + a stacked
     ``[W, ...]`` delta bank, word identity per slot as data (ISSUE 12).
@@ -314,8 +399,12 @@ def serve_step_multi(
         # Degenerate bank: every word bit-equals the base — one plain step.
         new_cache, samp, lens_prob = _forward_core(
             params, cfg, sae, cache, state, alive,
-            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+            mesh=mesh)
         new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+        if mesh is not None:
+            new_cache, new_state = _constrain_serve(
+                new_cache, new_state, mesh, cfg)
         return new_cache, new_state, out
 
     W = next(arr.shape[0] for fields in bank.values()
@@ -330,7 +419,8 @@ def serve_step_multi(
         params_w = deltalib.reconstruct_params(params, payload_w, codecs)
         new_cache, samp, lens_prob = _forward_core(
             params_w, cfg, sae, cache_c, state, sel,
-            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+            mesh=mesh)
         sel_r = sel[None, :, None, None, None]
         merged = KVCache(
             k=jnp.where(sel_r, new_cache.k, cache_c.k),
@@ -348,6 +438,8 @@ def serve_step_multi(
         (jnp.arange(W, dtype=jnp.int32), bank))
     new_cache = new_cache._replace(length=length0 + 1)
     new_state, out = _advance(state, alive, samp, lens_prob, stop_ids)
+    if mesh is not None:
+        new_cache, new_state = _constrain_serve(new_cache, new_state, mesh, cfg)
     return new_cache, new_state, out
 
 
@@ -379,7 +471,8 @@ class ServeEngine:
                  engine_config: Optional[EngineConfig] = None,
                  sae: Optional[sae_ops.SAEParams] = None,
                  words: Sequence[str] = (),
-                 delta_bank: Optional[Tuple] = None):
+                 delta_bank: Optional[Tuple] = None,
+                 mesh: Optional[Mesh] = None):
         self.params = params
         self.cfg = cfg
         self.tok = tok
@@ -389,6 +482,23 @@ class ServeEngine:
             raise ValueError("prompt_cols must leave room to generate "
                              f"(prompt_cols={self.ec.prompt_cols} >= "
                              f"max_context={self.ec.max_context})")
+        # Tensor-parallel serving (ISSUE 18): with a tp×dp mesh the resident
+        # params/bank shard on tp (Megatron layout, ``parallel.mesh.
+        # param_specs``), slots ride dp, and every step program is the SAME
+        # jit entry specialized to these shardings (one pjit program — the
+        # AOT key folds the placements, see ``runtime.aot._sharding_key``).
+        self.mesh = mesh if (mesh is not None and _mesh_tp(mesh) > 1) else None
+        if self.mesh is not None:
+            tp = _mesh_tp(self.mesh)
+            dp = int(self.mesh.shape.get("dp", 1))
+            if cfg.vocab_size % tp:
+                raise ValueError(
+                    f"vocab_size={cfg.vocab_size} not divisible by tp={tp} "
+                    "(the tp readout shards the vocab axis)")
+            if self.ec.slots % dp:
+                raise ValueError(
+                    f"slots={self.ec.slots} not divisible by dp={dp} "
+                    "(slots are data-parallel rows)")
         # Mixed-word serving (ISSUE 12): ``params`` is the resident BASE and
         # ``delta_bank`` the ``runtime.delta.stack_bank`` result — (codec
         # layout, {leaf: stacked [W, ...] payload}) for ``words`` in order.
@@ -415,7 +525,53 @@ class ServeEngine:
             self.ec.latent_slots, self.ec.proj_rank)
         self.cache = KVCache.zeros(cfg, self.ec.slots,
                                    max_len=self.ec.max_context)
+        if self.mesh is not None:
+            self._shard_resident()
         self.steps = 0
+
+    # -- mesh placement -----------------------------------------------------
+
+    def _shard_resident(self) -> None:
+        """Commit every resident buffer to its canonical mesh placement."""
+        from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+        m = self.mesh
+        self.params = mesh_mod.shard_params(self.params, self.cfg, m)
+        if self.sae is not None:
+            rep = NamedSharding(m, PS())
+            self.sae = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.asarray(x), rep), self.sae)
+        if self.delta_bank is not None:
+            specs = mesh_mod.bank_specs(self.cfg, self.delta_bank, m)
+            self.delta_bank = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(m, s)),
+                self.delta_bank, specs)
+        self._pin()
+
+    def _pin(self) -> None:
+        """Re-commit state/cache to their canonical shardings.
+
+        Host-side admission edits (``.at[slot].set`` chains in ``admit``/
+        ``release``) run as their own tiny jit programs whose outputs may
+        land on a different placement; an uncommitted or drifted leaf would
+        change the step program's AOT signature (a miss) or poison donation.
+        One ``device_put`` per leaf; a no-op when already placed."""
+        if self.mesh is None:
+            return
+        from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+        m = self.mesh
+        self.state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(m, _row_spec(x.ndim))), self.state)
+        kv = NamedSharding(m, mesh_mod.kv_page_spec(self.cfg.num_kv_heads, m))
+        self.cache = KVCache(
+            k=jax.device_put(self.cache.k, kv),
+            v=jax.device_put(self.cache.v, kv),
+            valid=jax.device_put(self.cache.valid,
+                                 NamedSharding(m, PS("dp", None))),
+            length=jax.device_put(self.cache.length, NamedSharding(m, PS())),
+        )
 
     # -- program plumbing ---------------------------------------------------
 
@@ -426,6 +582,8 @@ class ServeEngine:
                       stop_ids=self.ec.stop_ids)
         if self.multi:
             static["codecs"] = self.delta_codecs
+        if self.mesh is not None:
+            static["mesh"] = self.mesh
         return static
 
     def _dynamic(self) -> Dict[str, Any]:
@@ -543,6 +701,7 @@ class ServeEngine:
         # Recycle the KV page: the row's stale columns must never attend.
         self.cache = self.cache._replace(
             valid=self.cache.valid.at[slot, :].set(False))
+        self._pin()
 
     def release(self, slot: int) -> None:
         """Return a slot to the free pool (its KV page is invalidated on the
@@ -552,6 +711,7 @@ class ServeEngine:
             active=s.active.at[slot].set(False),
             lens_target=s.lens_target.at[slot].set(-1),
         )
+        self._pin()
 
     def any_alive(self) -> bool:
         # tbx: TBX001-ok — [S]-wide liveness check drives the serve loop

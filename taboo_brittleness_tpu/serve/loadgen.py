@@ -293,7 +293,8 @@ def synthetic_word_params(cfg, base_params, word: str, *, seed: int = 7):
 def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
                            max_new_tokens: int = 6,
                            word: Optional[str] = None,
-                           speculative: Optional[bool] = None):
+                           speculative: Optional[bool] = None,
+                           tp: Optional[int] = None, shard: bool = True):
     """Tiny-model engine for hermetic runs: gemma2_tiny + WordTokenizer +
     a small random SAE — the same stack the supervised-execution e2e uses.
     Returns (engine, scenarios, lens_target_id).  ``word`` swaps in that
@@ -301,7 +302,11 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
     reference arm the multi-word bit-for-bit tests compare against.
     ``speculative`` picks the engine class explicitly (True =
     SpecServeEngine, False = ServeEngine); None defers to
-    ``TBX_SERVE_SPECULATE`` (``spec_engine.enabled()``)."""
+    ``TBX_SERVE_SPECULATE`` (``spec_engine.enabled()``).  ``tp`` picks the
+    tensor-parallel extent (None defers to ``TBX_SERVE_TP``); when tp >= 2
+    the tiny config's vocab (199) rounds up to the nearest tp multiple —
+    for BOTH arms, so ``shard=False`` builds the UNSHARDED reference with
+    identical config/params (the A/B exactness contract)."""
     import jax
 
     from taboo_brittleness_tpu.models import gemma2
@@ -309,12 +314,18 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
     from taboo_brittleness_tpu.runtime.tokenizer import (
         WordTokenizer, target_token_id)
     from taboo_brittleness_tpu.serve import spec_engine
-    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+    from taboo_brittleness_tpu.serve.engine import (
+        EngineConfig, ServeEngine, serve_mesh, serve_tp)
 
     if speculative is None:
         speculative = spec_engine.enabled()
     cls = spec_engine.SpecServeEngine if speculative else ServeEngine
     cfg = gemma2.PRESETS["gemma2_tiny"]
+    tp = serve_tp() if tp is None else int(tp)
+    if tp > 1:
+        cfg = cfg.replace(
+            vocab_size=((cfg.vocab_size + tp - 1) // tp) * tp)
+    mesh = serve_mesh(tp) if (shard and tp > 1) else None
     params = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
     if word is not None:
         params = synthetic_word_params(cfg, params, word, seed=seed)
@@ -330,7 +341,7 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
             slots=slots, max_context=48, prompt_cols=24,
             latent_slots=4, proj_rank=2,
             sae_layer=tap, proj_layer=tap, tap_layer=tap),
-        sae=sae, words=(word,) if word is not None else ())
+        sae=sae, words=(word,) if word is not None else (), mesh=mesh)
     scenarios = default_scenarios(max_new_tokens=max_new_tokens,
                                   ablate_latents=(0, 1, 2, 3), proj_rank=2)
     return engine, scenarios, target_token_id(tok, "ship")
@@ -339,12 +350,15 @@ def build_synthetic_engine(*, slots: int = 4, seed: int = 7,
 def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
                                  slots: int = 4, seed: int = 7,
                                  max_new_tokens: int = 6,
-                                 speculative: Optional[bool] = None):
+                                 speculative: Optional[bool] = None,
+                                 tp: Optional[int] = None,
+                                 shard: bool = True):
     """The multi-word arm: ONE engine holding the synthetic base plus a
     stacked delta bank for ``words`` (each word's params =
     :func:`synthetic_word_params`, packed exactly).  Same tokenizer, SAE,
-    scenarios and envelope as :func:`build_synthetic_engine`, so per-word
-    responses are comparable bit-for-bit against the single-word arm.
+    scenarios and envelope as :func:`build_synthetic_engine` — including
+    the ``tp``/``shard`` mesh contract — so per-word responses are
+    comparable bit-for-bit against the single-word arm.
     Returns (engine, scenarios, lens_target_id)."""
     import jax
 
@@ -354,12 +368,18 @@ def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
     from taboo_brittleness_tpu.runtime.tokenizer import (
         WordTokenizer, target_token_id)
     from taboo_brittleness_tpu.serve import spec_engine
-    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+    from taboo_brittleness_tpu.serve.engine import (
+        EngineConfig, ServeEngine, serve_mesh, serve_tp)
 
     if speculative is None:
         speculative = spec_engine.enabled()
     cls = spec_engine.SpecServeEngine if speculative else ServeEngine
     cfg = gemma2.PRESETS["gemma2_tiny"]
+    tp = serve_tp() if tp is None else int(tp)
+    if tp > 1:
+        cfg = cfg.replace(
+            vocab_size=((cfg.vocab_size + tp - 1) // tp) * tp)
+    mesh = serve_mesh(tp) if (shard and tp > 1) else None
     base = gemma2.init_params(jax.random.PRNGKey(seed), cfg)
     packed = [deltalib.pack_params_delta(
         base, synthetic_word_params(cfg, base, w, seed=seed))
@@ -377,7 +397,7 @@ def build_synthetic_multi_engine(*, words: Sequence[str] = ("ship", "moon"),
             slots=slots, max_context=48, prompt_cols=24,
             latent_slots=4, proj_rank=2,
             sae_layer=tap, proj_layer=tap, tap_layer=tap),
-        sae=sae, words=tuple(words), delta_bank=bank)
+        sae=sae, words=tuple(words), delta_bank=bank, mesh=mesh)
     scenarios = default_scenarios(max_new_tokens=max_new_tokens,
                                   ablate_latents=(0, 1, 2, 3), proj_rank=2)
     return engine, scenarios, target_token_id(tok, "ship")

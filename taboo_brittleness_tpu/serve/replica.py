@@ -60,7 +60,7 @@ from taboo_brittleness_tpu.runtime import supervise
 from taboo_brittleness_tpu.runtime import fleet as fleet_mod
 from taboo_brittleness_tpu.runtime.resilience import RetryPolicy
 from taboo_brittleness_tpu.serve.scheduler import (
-    REJECT_ALL_REPLICAS_BURNING, Response)
+    REJECT_ALL_REPLICAS_BURNING, REJECT_FLEET_SATURATED, Response)
 from taboo_brittleness_tpu.serve.server import CLAIMED_SUFFIX, RequestSpool
 
 __all__ = [
@@ -105,7 +105,19 @@ class BurnRouter:
     zero at the cap.  Routing is seeded weighted-random (deterministic per
     coordinator), so a replica at a quarter of the healthy weight receives
     about a quarter of the healthy share — measurably less, never zero
-    until it actually burns past the cap."""
+    until it actually burns past the cap.
+
+    When the heartbeat carries the ``slots`` occupancy block (ISSUE 18 —
+    the HBM-watermark autotuner's solved admission width), the burn weight
+    is further scaled by ``free / width``: a replica whose autotuner shrank
+    it to 4 slots receives proportionally less than a 16-slot peer at the
+    same burn, and a FULL replica (free == 0) receives nothing — that is
+    how the solved width moves the router's shed threshold.  A replica that
+    is both full and backlogged (``queued > 0``) counts as SATURATED; when
+    every live replica is saturated, intake is shed with the typed
+    ``fleet-saturated`` rejection.  Heartbeats without a slots block (older
+    replicas, sweep fixtures) keep the pure-burn weights — occupancy
+    steering is strictly additive."""
 
     def __init__(self, output_dir: str, replica_ids: Sequence[str], *,
                  burn_cap: Optional[float] = None, seed: int = 0):
@@ -138,14 +150,35 @@ class BurnRouter:
             weight = 0.0 if not alive else max(
                 0.0, 1.0 - fast / self.burn_cap)
             serving = p.get("serving") or {}
+            queued = int(serving.get("queued", 0) or 0)
+            # Occupancy steering (ISSUE 18): scale the burn weight by the
+            # fraction of autotuned admission width still free.  full +
+            # backlogged = saturated (the typed-shed condition); no slots
+            # block = no scaling (pre-autotune heartbeats stay unbounded).
+            slots = serving.get("slots") or {}
+            saturated = False
+            free = width = None
+            if slots:
+                try:
+                    width = max(0, int(slots.get("width", 0) or 0))
+                    free = max(0, int(slots.get("free", 0) or 0))
+                except (TypeError, ValueError):
+                    free = width = None
+            if width:
+                weight *= min(1.0, free / width)
+                saturated = bool(alive and free == 0 and queued > 0)
             out[wid] = {
                 "alive": alive,
                 "burning": burning,
+                "saturated": saturated,
                 "fast_burn": round(fast, 4),
                 "weight": round(weight, 4),
                 "heartbeat_age": p.get("age_seconds"),
                 "in_flight": int(serving.get("in_flight", 0) or 0),
+                "queued": queued,
                 "completed": int(serving.get("completed_requests", 0) or 0),
+                **({"slots_width": width, "slots_free": free}
+                   if width is not None else {}),
             }
         return out
 
@@ -160,6 +193,16 @@ class BurnRouter:
         is startup or a rolling restart, and intake should wait."""
         live = [v for v in view.values() if v["alive"]]
         return bool(live) and all(v["burning"] for v in live)
+
+    @staticmethod
+    def all_saturated(view: Dict[str, Dict[str, Any]]) -> bool:
+        """True when there ARE live replicas and every one reports its
+        autotuned admission width full WITH a backlog (ISSUE 18) — the
+        occupancy twin of :meth:`all_burning`.  Replicas without a slots
+        block never saturate, so mixed fleets fall back to burn-only
+        shedding."""
+        live = [v for v in view.values() if v["alive"]]
+        return bool(live) and all(v.get("saturated") for v in live)
 
     def pick(self, view: Optional[Dict[str, Dict[str, Any]]] = None, *,
              exclude: Sequence[str] = ()) -> Optional[str]:
@@ -269,17 +312,18 @@ def _tombstone_payloads(spool: RequestSpool) -> Dict[str, Dict[str, Any]]:
     return out
 
 
-def _shed(spool: RequestSpool, rid: str,
-          payload: Dict[str, Any]) -> None:
+def _shed(spool: RequestSpool, rid: str, payload: Dict[str, Any],
+          reason: str = REJECT_ALL_REPLICAS_BURNING) -> None:
     """Typed load-shed response: the client sees WHY (every live replica
-    past the burn cap), committed first-writer-wins like any response so a
-    racing late replica completion stays benign."""
+    past the burn cap, or every admission width full with a backlog),
+    committed first-writer-wins like any response so a racing late replica
+    completion stays benign."""
     spool.respond_exclusive(
         Response(id=rid, ok=False,
                  scenario=str(payload.get("scenario", "chat")),
                  finish="rejected",
-                 reject_reason=REJECT_ALL_REPLICAS_BURNING,
-                 error=f"admission rejected ({REJECT_ALL_REPLICAS_BURNING})"),
+                 reject_reason=reason,
+                 error=f"admission rejected ({reason})"),
         holder=ROUTER_HOLDER)
 
 
@@ -388,17 +432,22 @@ def run_serve_fleet(
             # shed typed when every live replica is burning; wait when none
             # is live yet (startup / rolling restart).
             if BurnRouter.any_alive(view):
-                if BurnRouter.all_burning(view):
+                shed_reason = (
+                    REJECT_ALL_REPLICAS_BURNING
+                    if BurnRouter.all_burning(view)
+                    else REJECT_FLEET_SATURATED
+                    if BurnRouter.all_saturated(view) else None)
+                if shed_reason is not None:
                     for rid in spool.intake_ids():
                         payload = spool.route_intake(rid)
                         if payload is None:
                             continue
-                        _shed(spool, rid, payload)
+                        _shed(spool, rid, payload, shed_reason)
                         shed += 1
                         router.sheds += 1
                         issued.setdefault(rid, 0)
                         ob.event("serve_fleet.shed", request=rid,
-                                 reason=REJECT_ALL_REPLICAS_BURNING)
+                                 reason=shed_reason)
                 else:
                     for rid, payload in list(reroute_queue.items()):
                         target = router.pick(view)

@@ -62,6 +62,7 @@ REJECT_UNKNOWN_WORD = "unknown-word"
 REJECT_PROMPT_TOO_LONG = "prompt-too-long"
 REJECT_UNKNOWN_SCENARIO = "unknown-scenario"   # server-side (pre-submit)
 REJECT_ALL_REPLICAS_BURNING = "all-replicas-burning"  # router shed
+REJECT_FLEET_SATURATED = "fleet-saturated"     # router shed: no free slots
 
 
 @dataclasses.dataclass(frozen=True)
@@ -188,6 +189,11 @@ class SlotScheduler:
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.queue_limit = int(queue_limit)
+        # Autotuned admission width (ISSUE 18): slots at index >= slot_limit
+        # never admit — the engine keeps its compiled shape (the FULL slot
+        # batch steps; surplus rows just stay frozen) while the HBM-watermark
+        # solver caps how many sessions are concurrently resident.
+        self.slot_limit = int(engine.ec.slots)
         self.lens_target_id = int(lens_target_id)
         self.on_complete = on_complete
         self._clock = clock
@@ -219,6 +225,20 @@ class SlotScheduler:
     @property
     def idle(self) -> bool:
         return not self._sessions and not self._queue
+
+    def set_slot_limit(self, width: int) -> int:
+        """Install the autotuner's solved width as the admission cap,
+        clamped to the engine's compiled envelope.  Lowering the cap never
+        evicts an in-flight session — slots above the cap drain naturally
+        and then stop readmitting.  Returns the installed cap."""
+        self.slot_limit = max(1, min(int(width), self.engine.ec.slots))
+        return self.slot_limit
+
+    def occupancy(self) -> Dict[str, int]:
+        """The heartbeat's ``slots`` view: autotuned width, sessions
+        resident, and how many admissions remain before saturation."""
+        return {"width": self.slot_limit, "active": self.in_flight,
+                "free": max(0, self.slot_limit - self.in_flight)}
 
     # -- admission -----------------------------------------------------------
 
@@ -296,6 +316,8 @@ class SlotScheduler:
         for slot in self.engine.free_slots():
             if not self._queue:
                 break
+            if slot >= self.slot_limit:
+                continue   # above the autotuned width: never admits
             req = self._queue.popleft()
             now = self._clock()
             sc = req.scenario

@@ -86,6 +86,7 @@ from taboo_brittleness_tpu.runtime.fleet import (
     LeaseStore, exclusive_commit, holder_token, lease_seconds)
 from taboo_brittleness_tpu.runtime.resilience import (
     atomic_json_dump, current_worker_id)
+from taboo_brittleness_tpu.serve import autotune
 from taboo_brittleness_tpu.serve.engine import ServeEngine
 from taboo_brittleness_tpu.serve.scheduler import (
     REJECT_UNKNOWN_SCENARIO, Request, Response, Scenario, SlotScheduler)
@@ -674,6 +675,27 @@ def serve_forever(
                                      if k in ("source", "trace_seconds",
                                               "compile_seconds", "error")})
 
+    # HBM-watermark slot autotune (ISSUE 18): solve AFTER warm start, when
+    # the resident footprint (params, bank, cache, spec TRASH columns) and
+    # the compiled programs both exist — the live-bytes watermark now prices
+    # the steady state.  The solved width caps ADMISSION only (the compiled
+    # batch keeps its shape); fail-open, so a solver fault keeps the
+    # configured width.
+    tuned: Optional[autotune.AutotunePlan] = None
+    try:
+        tuned = autotune.solve(engine)
+        sched.set_slot_limit(tuned.width)
+        obs.event("serve.autotune", **tuned.to_dict())
+    except Exception as exc:  # noqa: BLE001 — never a correctness dependency
+        obs.event("serve.autotune",
+                  verdict="error", error=f"{type(exc).__name__}: {exc}"[:200])
+
+    def _slots_block() -> Dict[str, Any]:
+        """Heartbeat occupancy: solved width vs live admission state."""
+        block = dict(sched.occupancy())
+        block["verdict"] = tuned.verdict if tuned is not None else "off"
+        return block
+
     def _take(payload: Dict[str, Any]) -> None:
         """Claimed requests ALWAYS get a response: parse+submit, and answer
         a rejection (unknown scenario, over-capacity prompt/budget) with an
@@ -758,7 +780,8 @@ def serve_forever(
                     reporter.serving_update(
                         in_flight=sched.in_flight,
                         completed=spool.completed_count(),
-                        queued=sched.queue_depth)
+                        queued=sched.queue_depth,
+                        slots=_slots_block())
                 resolved = len(sched.step())
                 stepped = True
             completed = spool.completed_count()
@@ -774,7 +797,8 @@ def serve_forever(
                     latency=(sched.latency_percentiles() if resolved
                              else None),
                     slo=(slo_engine.last_block() if slo_engine is not None
-                         else None))
+                         else None),
+                    slots=_slots_block())
             if sched.draining and sched.idle:
                 status, exit_code = "drained", supervise.EXIT_DRAINED
                 break
@@ -799,6 +823,11 @@ def serve_forever(
             "quarantined": sched.quarantined,
             "aot": _step_program_stats(engine),
         }
+        if tuned is not None:
+            summary["autotune"] = {**tuned.to_dict(), "plan": tuned.plan}
+        if getattr(engine, "mesh", None) is not None:
+            summary["mesh"] = {k: int(v)
+                               for k, v in dict(engine.mesh.shape).items()}
         if getattr(engine, "speculative", False):
             # Speculative serving (ISSUE 13): per-scenario accept_rate next
             # to the SLO histograms, plus the engine-wide accept stats.
@@ -830,7 +859,8 @@ def serve_forever(
                 completed=spool.completed_count(),
                 latency=sched.latency_percentiles(),
                 slo=(slo_engine.last_block() if slo_engine is not None
-                     else None))
+                     else None),
+                slots=_slots_block())
             reporter.stop(status="preempted" if status == "drained"
                           else "done")
         if run_span is not None:
@@ -852,3 +882,130 @@ def _step_program_stats(engine: ServeEngine) -> Dict[str, Any]:
     # the zero-recompile gate follows the program it actually dispatched.
     return dict(aot.stats().get(getattr(engine, "aot_name", "serve.step"),
                                 {}))
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel A/B selfcheck (the `tbx serve --selfcheck` CI gate).
+# ---------------------------------------------------------------------------
+
+_TP_MIX_SCENARIOS = ("chat", "sae_ablate", "forcing")
+
+
+def tp_selfcheck(output_dir: str, *, tp: int = 2, n_requests: int = 9,
+                 max_wall_s: float = 600.0) -> Dict[str, Any]:
+    """The mesh-mode exactness gate (ISSUE 18): spool the SAME mixed-
+    scenario request batch into two ``tbx serve --synthetic`` servers — one
+    tensor-parallel over a forced 8-host-device dp×tp mesh, one unsharded
+    with identical config/params (``--tp-no-shard``) — run both to
+    completion, and assert the response streams are equal (tokens, text,
+    finish, lens probs within f32-reduction tolerance) with ZERO AOT misses
+    on the sharded arm.  Pure subprocess orchestration: the parent never
+    imports jax, so the forced device count only shapes the children."""
+    import subprocess
+    import sys as _sys
+
+    arms = {"tp": ["--tp", str(int(tp))],
+            "ref": ["--tp", str(int(tp)), "--tp-no-shard"]}
+    spools: Dict[str, RequestSpool] = {}
+    procs: Dict[str, subprocess.Popen] = {}
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "TBX_OBS_PROGRESS_S": "0.2"}
+    env.pop("TBX_SERVE_TP", None)          # the --tp flag is the contract
+    for arm, flags in arms.items():
+        arm_dir = os.path.join(output_dir, arm)
+        spool = RequestSpool(arm_dir)
+        for i in range(int(n_requests)):
+            spool.put({
+                "id": f"r{i:03d}",
+                "prompt": ("Give me a hint" if i % 2
+                           else "Give me a clue about the word"),
+                "scenario": _TP_MIX_SCENARIOS[i % len(_TP_MIX_SCENARIOS)],
+                "seed": i})
+        spools[arm] = spool
+        procs[arm] = subprocess.Popen(
+            [_sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+             "--synthetic", "--output-dir", arm_dir,
+             "--slots", "4", "--max-new-tokens", "6",
+             "--max-requests", str(int(n_requests)),
+             "--poll", "0.05", *flags],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    problems: List[str] = []
+    for arm, proc in procs.items():
+        try:
+            rc = proc.wait(timeout=max_wall_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            problems.append(f"{arm} arm timed out after {max_wall_s:.0f}s")
+            continue
+        if rc != 0:
+            problems.append(f"{arm} arm exited {rc}")
+
+    compared = 0
+    if not problems:
+        for i in range(int(n_requests)):
+            rid = f"r{i:03d}"
+            a = spools["tp"].get_response(rid)
+            b = spools["ref"].get_response(rid)
+            if a is None or b is None:
+                problems.append(f"{rid}: missing response "
+                                f"(tp={a is not None} ref={b is not None})")
+                continue
+            for field in ("ok", "finish", "tokens", "text", "scenario"):
+                if a.get(field) != b.get(field):
+                    problems.append(
+                        f"{rid}.{field}: tp={a.get(field)!r} "
+                        f"ref={b.get(field)!r}")
+            pa = a.get("lens_probs") or []
+            pb = b.get("lens_probs") or []
+            if len(pa) != len(pb) or any(
+                    abs(x - y) > 1e-6 for x, y in zip(pa, pb)):
+                problems.append(f"{rid}.lens_probs diverged: {pa} vs {pb}")
+            compared += 1
+
+    summary: Dict[str, Any] = {}
+    try:
+        with open(os.path.join(output_dir, "tp",
+                               SERVE_SUMMARY_FILENAME)) as f:
+            summary = json.load(f)
+    except (OSError, ValueError):
+        problems.append("tp arm wrote no serve summary")
+    aot_stats = summary.get("aot") or {}
+    if int(aot_stats.get("misses", -1)) != 0:
+        problems.append(f"tp arm AOT misses != 0: {aot_stats}")
+    mesh = summary.get("mesh") or {}
+    if int(mesh.get("tp", 0)) != int(tp):
+        problems.append(f"tp arm summary mesh block wrong: {mesh}")
+    autotuned = summary.get("autotune") or {}
+    if not autotuned.get("verdict"):
+        problems.append("tp arm summary has no autotune verdict")
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "compared": compared,
+        "tp": int(tp),
+        "mesh": mesh,
+        "aot": aot_stats,
+        "autotune": {k: autotuned.get(k) for k in
+                     ("verdict", "source", "width", "spec_block")},
+    }
+
+
+def main_tp_selfcheck(*, tp: int = 2, n_requests: int = 9) -> int:
+    """``tbx serve --selfcheck``: run the tensor-parallel A/B exactness
+    smoke in a temp dir and print the verdict."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="tbx-serve-tp-selfcheck-")
+    try:
+        verdict = tp_selfcheck(os.path.join(tmp, "ab"), tp=tp,
+                               n_requests=n_requests)
+        # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["ok"] else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

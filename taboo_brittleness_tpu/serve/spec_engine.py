@@ -71,15 +71,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from taboo_brittleness_tpu.models.gemma2 import (
-    Gemma2Config, KVCache, Params, forward, unembed)
+    Gemma2Config, KVCache, Params, forward, rms_norm, unembed)
 from taboo_brittleness_tpu.ops import sae as sae_ops
 from taboo_brittleness_tpu.ops.lens import residual_carry_tap
 from taboo_brittleness_tpu.runtime import aot, chat
 from taboo_brittleness_tpu.runtime import speculate
 from taboo_brittleness_tpu.serve.engine import (
-    STOP_IDS, EngineConfig, ServeEngine, SlotState, _serve_edit)
+    STOP_IDS, EngineConfig, ServeEngine, SlotState, _constrain_serve,
+    _serve_edit)
 
 import os
 
@@ -148,13 +150,16 @@ def _draft_core(
     block_size: int,
     sae_layer: int,
     proj_layer: int,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """G autoregressive lens-head steps over layers 0..k for rows ``active``
     → ``(drafts [S, G], margins [S, G])``.  The draft cache is a per-launch
     SLICE of the main cache (see module docstring); its in-scan writes land
     at columns ≥ each row's ``pos`` — invalid by the counters until a
     verify feed re-writes them at full depth — and the slice is dropped at
-    launch end, so nothing here persists."""
+    launch end, so nothing here persists.  ``mesh`` (ISSUE 18) routes the
+    lens pick through ``parallel.mesh.tp_lens_pick`` — same token by the
+    globally-first tie-break, margin to f32 rounding."""
     dcfg = cfg.replace(num_layers=draft_layer + 1)
     dparams = speculate._draft_view(params, draft_layer)
     dk = main_k[:draft_layer + 1]
@@ -175,11 +180,20 @@ def _draft_core(
             edit_fn=bound,
             cache_positions=c,
         )
-        nxt, margin = speculate.lens_pick(params, cfg, res.last_hidden,
-                                          with_margin=True)
-        nxt = jnp.where(active, nxt[:, 0], jnp.int32(chat.PAD_ID))
+        if mesh is not None:
+            from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+            x = rms_norm(res.last_hidden[:, 0], params["final_norm"],
+                         cfg.rms_norm_eps)                    # [S, D]
+            nxt, margin = mesh_mod.tp_lens_pick(
+                mesh, x, params["embed"], compute_dtype=cfg.compute_dtype)
+        else:
+            t2, m2 = speculate.lens_pick(params, cfg, res.last_hidden,
+                                         with_margin=True)
+            nxt, margin = t2[:, 0], m2[:, 0]
+        nxt = jnp.where(active, nxt, jnp.int32(chat.PAD_ID))
         return ((res.cache.k, res.cache.v, res.cache.valid, nxt, c + 1),
-                (nxt, margin[:, 0]))
+                (nxt, margin))
 
     _, (drafts, margins) = lax.scan(
         step, (dk, dv, valid0, state.input_tok, state.pos),
@@ -194,9 +208,19 @@ def _draft_active(state: SlotState) -> jax.Array:
     return state.active & ~state.done & ~in_prompt
 
 
+def _constrain_draft(drafts: jax.Array, margins: jax.Array,
+                     mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
+    """Pin draft outputs to the slot-row placement: the verify program was
+    warm-built against ``P('dp', None)`` drafts/margins, and the AOT key
+    folds placements — a drifted draft output would be a verify miss."""
+    row = NamedSharding(mesh, PS("dp", None))
+    return (lax.with_sharding_constraint(drafts, row),
+            lax.with_sharding_constraint(margins, row))
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "draft_layer", "block_size", "sae_layer",
-                          "proj_layer"))
+                          "proj_layer", "mesh"))
 def serve_spec_draft(
     params: Params,
     cfg: Gemma2Config,
@@ -209,18 +233,22 @@ def serve_spec_draft(
     block_size: int,
     sae_layer: int,
     proj_layer: int,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """The single-word draft program (``serve.spec.draft``).  The main cache
     is NOT donated — the verify launch consumes it next."""
-    return _draft_core(
+    drafts, margins = _draft_core(
         params, cfg, sae, main_k, main_v, state, _draft_active(state),
         draft_layer=draft_layer, block_size=block_size,
-        sae_layer=sae_layer, proj_layer=proj_layer)
+        sae_layer=sae_layer, proj_layer=proj_layer, mesh=mesh)
+    if mesh is not None:
+        drafts, margins = _constrain_draft(drafts, margins, mesh)
+    return drafts, margins
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "codecs", "draft_layer", "block_size",
-                          "sae_layer", "proj_layer"))
+                          "sae_layer", "proj_layer", "mesh"))
 def serve_spec_draft_multi(
     params: Params,
     cfg: Gemma2Config,
@@ -235,6 +263,7 @@ def serve_spec_draft_multi(
     block_size: int,
     sae_layer: int,
     proj_layer: int,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Mixed-word drafting: a ``lax.scan`` over the delta bank reconstructs
     word ``w``'s params (``runtime.delta``) and drafts for that word's slots
@@ -245,10 +274,13 @@ def serve_spec_draft_multi(
     base_active = _draft_active(state)
 
     if not any(codec != "zero" for _, codec in codecs):
-        return _draft_core(
+        drafts, margins = _draft_core(
             params, cfg, sae, main_k, main_v, state, base_active,
             draft_layer=draft_layer, block_size=block_size,
-            sae_layer=sae_layer, proj_layer=proj_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, mesh=mesh)
+        if mesh is not None:
+            drafts, margins = _constrain_draft(drafts, margins, mesh)
+        return drafts, margins
 
     W = next(arr.shape[0] for fields in bank.values()
              for arr in fields.values())
@@ -262,7 +294,7 @@ def serve_spec_draft_multi(
         d, mg = _draft_core(
             params_w, cfg, sae, main_k, main_v, state, sel,
             draft_layer=draft_layer, block_size=block_size,
-            sae_layer=sae_layer, proj_layer=proj_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, mesh=mesh)
         return (jnp.where(sel[:, None], d, drafts_acc),
                 jnp.where(sel[:, None], mg, margins_acc)), None
 
@@ -271,6 +303,8 @@ def serve_spec_draft_multi(
         (jnp.full((S, block_size), chat.PAD_ID, jnp.int32),
          jnp.zeros((S, block_size), jnp.float32)),
         (jnp.arange(W, dtype=jnp.int32), bank))
+    if mesh is not None:
+        drafts, margins = _constrain_draft(drafts, margins, mesh)
     return drafts, margins
 
 
@@ -311,6 +345,7 @@ def _verify_forward(
     sae_layer: int,
     proj_layer: int,
     tap_layer: int,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
     """The chunk-shaped ``_forward_core``: one full-depth forward over
     ``[S, G+1]`` positions (each row at its own columns), returning the new
@@ -337,20 +372,37 @@ def _verify_forward(
         carry_tap=residual_carry_tap(S, G1, cfg.hidden_size, tap_layer),
         compute_logits=False,
     )
-    logits = unembed(params, cfg, res.last_hidden)            # [S, G+1, V]
-    y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if mesh is not None:
+        from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+        x = rms_norm(res.last_hidden, params["final_norm"],
+                     cfg.rms_norm_eps)                        # [S, G+1, D]
+        y = mesh_mod.tp_argmax(
+            mesh, x, params["embed"], compute_dtype=cfg.compute_dtype,
+            cap=cfg.final_logit_softcap)
+    else:
+        logits = unembed(params, cfg, res.last_hidden)        # [S, G+1, V]
+        y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     lens_on = (state.lens_target >= 0) & sel
 
     def _readout(resid_tgt):
         resid, tgt = resid_tgt
+        tgt = jnp.clip(tgt, 0, cfg.vocab_size - 1)
+        if mesh is not None:
+            from taboo_brittleness_tpu.parallel import mesh as mesh_mod
+
+            x = rms_norm(resid, params["final_norm"], cfg.rms_norm_eps)
+            return mesh_mod.tp_lens_prob(
+                mesh, x, params["embed"],
+                jnp.broadcast_to(tgt[:, None], resid.shape[:2]),
+                compute_dtype=cfg.compute_dtype)
         from taboo_brittleness_tpu.ops.lens import _lens_logits
 
         ll = _lens_logits(params, cfg, resid)                 # [S, G+1, V]
         lse = jax.scipy.special.logsumexp(ll, axis=-1)
         picked = jnp.take_along_axis(
-            ll, jnp.clip(tgt, 0, cfg.vocab_size - 1)[:, None, None],
-            axis=-1)[..., 0]
+            ll, tgt[:, None, None], axis=-1)[..., 0]
         return jnp.exp(picked - lse)
 
     lens_prob = lax.cond(
@@ -442,7 +494,7 @@ def _spec_advance(
 
 @partial(jax.jit,
          static_argnames=("cfg", "sae_layer", "proj_layer", "tap_layer",
-                          "stop_ids"),
+                          "stop_ids", "mesh"),
          donate_argnames=("cache", "state"))
 def serve_spec_verify(
     params: Params,
@@ -458,6 +510,7 @@ def serve_spec_verify(
     proj_layer: int,
     tap_layer: int,
     stop_ids: Tuple[int, ...] = STOP_IDS,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, SlotState, SpecStepOut]:
     """The single-word verify program (``serve.spec.verify``): chunk forward
     + accept bookkeeping, cache/state donated like ``serve_step``."""
@@ -465,15 +518,18 @@ def serve_spec_verify(
     feed_valid, chunk, cols = _chunk_inputs(state, spec, drafts, alive)
     new_cache, y, lens_prob = _verify_forward(
         params, cfg, sae, cache, state, chunk, feed_valid, cols, alive,
-        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+        sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+        mesh=mesh)
     new_state, out = _spec_advance(state, spec, drafts, margins, y,
                                    lens_prob, stop_ids)
+    if mesh is not None:
+        new_cache, new_state = _constrain_serve(new_cache, new_state, mesh, cfg)
     return new_cache, new_state, out
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "codecs", "sae_layer", "proj_layer",
-                          "tap_layer", "stop_ids"),
+                          "tap_layer", "stop_ids", "mesh"),
          donate_argnames=("cache", "state"))
 def serve_spec_verify_multi(
     params: Params,
@@ -491,6 +547,7 @@ def serve_spec_verify_multi(
     proj_layer: int,
     tap_layer: int,
     stop_ids: Tuple[int, ...] = STOP_IDS,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[KVCache, SlotState, SpecStepOut]:
     """Mixed-word verify: scan-over-words chunk forwards merged by word
     mask (the ``serve_step_multi`` shape), then ONE shared accept/advance
@@ -504,9 +561,13 @@ def serve_spec_verify_multi(
     if not any(codec != "zero" for _, codec in codecs):
         new_cache, y, lens_prob = _verify_forward(
             params, cfg, sae, cache, state, chunk, feed_valid, cols, alive,
-            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+            mesh=mesh)
         new_state, out = _spec_advance(state, spec, drafts, margins, y,
                                        lens_prob, stop_ids)
+        if mesh is not None:
+            new_cache, new_state = _constrain_serve(
+                new_cache, new_state, mesh, cfg)
         return new_cache, new_state, out
 
     W = next(arr.shape[0] for fields in bank.values()
@@ -522,7 +583,8 @@ def serve_spec_verify_multi(
         new_cache, y, lens_prob = _verify_forward(
             params_w, cfg, sae, cache_c, state, chunk,
             feed_valid & sel[:, None], cols, sel,
-            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer)
+            sae_layer=sae_layer, proj_layer=proj_layer, tap_layer=tap_layer,
+            mesh=mesh)
         sel_r = sel[None, :, None, None, None]
         merged = KVCache(
             k=jnp.where(sel_r, new_cache.k, cache_c.k),
@@ -541,6 +603,8 @@ def serve_spec_verify_multi(
         (jnp.arange(W, dtype=jnp.int32), bank))
     new_state, out = _spec_advance(state, spec, drafts, margins, y,
                                    lens_prob, stop_ids)
+    if mesh is not None:
+        new_cache, new_state = _constrain_serve(new_cache, new_state, mesh, cfg)
     return new_cache, new_state, out
 
 
@@ -566,9 +630,11 @@ class SpecServeEngine(ServeEngine):
                  sae: Optional[sae_ops.SAEParams] = None,
                  words=(), delta_bank: Optional[Tuple] = None,
                  draft_layer: Optional[int] = None,
-                 block_size: Optional[int] = None):
+                 block_size: Optional[int] = None,
+                 mesh: Optional[Mesh] = None):
         super().__init__(params, cfg, tok, engine_config=engine_config,
-                         sae=sae, words=words, delta_bank=delta_bank)
+                         sae=sae, words=words, delta_bank=delta_bank,
+                         mesh=mesh)
         # Per-word plans (env > calibration artifact > heuristic).  k is a
         # shape parameter — one engine-wide value, the deepest plan among
         # resident words; G is the engine ceiling, per-slot g_s rides as
@@ -589,6 +655,7 @@ class SpecServeEngine(ServeEngine):
         # PR 9's TRASH-column role.
         self.cache = KVCache.zeros(
             cfg, self.ec.slots, max_len=self.ec.max_context + self.block + 1)
+        self._pin()   # re-place the widened cache (and spec) on the mesh
         self.aot_draft = ("serve.spec.draft.multi" if self.multi
                           else "serve.spec.draft")
         self.aot_verify = ("serve.spec.verify.multi" if self.multi
@@ -615,6 +682,16 @@ class SpecServeEngine(ServeEngine):
 
     # -- program plumbing ----------------------------------------------------
 
+    def _pin(self) -> None:
+        """Vanilla pinning plus the speculation plan rows (``self.spec``
+        is created after the base __init__ runs its first pin — guard)."""
+        super()._pin()
+        if self.mesh is not None and getattr(self, "spec", None) is not None:
+            row = NamedSharding(self.mesh, PS("dp"))
+            self.spec = SpecSlots(
+                block=jax.device_put(self.spec.block, row),
+                margin=jax.device_put(self.spec.margin, row))
+
     def _draft_static(self) -> Dict[str, Any]:
         static = dict(cfg=self.cfg, draft_layer=self.draft_layer,
                       block_size=self.block,
@@ -622,6 +699,8 @@ class SpecServeEngine(ServeEngine):
                       proj_layer=self.ec.proj_layer)
         if self.multi:
             static["codecs"] = self.delta_codecs
+        if self.mesh is not None:
+            static["mesh"] = self.mesh
         return static
 
     def _draft_dynamic(self) -> Dict[str, Any]:
@@ -639,6 +718,8 @@ class SpecServeEngine(ServeEngine):
                       stop_ids=self.ec.stop_ids)
         if self.multi:
             static["codecs"] = self.delta_codecs
+        if self.mesh is not None:
+            static["mesh"] = self.mesh
         return static
 
     def _verify_dynamic(self, drafts, margins) -> Dict[str, Any]:
@@ -656,6 +737,13 @@ class SpecServeEngine(ServeEngine):
             self._draft_dynamic(), self._draft_static(), execute=False)
         drafts = jnp.zeros((self.ec.slots, self.block), jnp.int32)
         margins = jnp.zeros((self.ec.slots, self.block), jnp.float32)
+        if self.mesh is not None:
+            # The live verify consumes the draft program's P("dp", None)
+            # outputs — build against the same placement or the first real
+            # dispatch would be a signature miss.
+            row = NamedSharding(self.mesh, PS("dp", None))
+            drafts = jax.device_put(drafts, row)
+            margins = jax.device_put(margins, row)
         verify = aot.entry(self.aot_verify, self._verify_fn).build(
             self._verify_dynamic(drafts, margins), self._verify_static(),
             execute=False)
@@ -715,6 +803,7 @@ class SpecServeEngine(ServeEngine):
         self.spec = SpecSlots(
             block=self.spec.block.at[slot].set(int(g)),
             margin=self.spec.margin.at[slot].set(float(exit_margin)))
+        self._pin()
 
     def accept_stats(self) -> Dict[str, Any]:
         """Engine-level accept accounting (the `_serve.json` spec block)."""

@@ -642,11 +642,18 @@ def test_entry_points_and_baseline_unchanged():
         "runtime.speculate.draft_step",
         "runtime.speculate.verify_block",
         "serve.engine.serve_step",
+        "serve.engine.serve_step[tp]",
         "serve.engine.serve_step_multi",
+        "serve.engine.serve_step_multi[tp]",
         "serve.spec_engine.serve_spec_draft",
+        "serve.spec_engine.serve_spec_draft[tp]",
         "serve.spec_engine.serve_spec_verify",
+        "serve.spec_engine.serve_spec_verify[tp]",
     ]
     with open(os.path.join(_REPO, "tools", "tbx_baseline.json")) as f:
         baseline = json.load(f)
     assert baseline["version"] == 2    # move-stable fingerprints (scope-keyed)
-    assert len(baseline["findings"]) == 13
+    # 13 pre-tp + the 4 [tp] local-shard readout transients traced on the
+    # forced 8-device mesh + their 3 distinct 1-device-fallback shapes
+    # (verify's coincides), so the gate is green at either device count.
+    assert len(baseline["findings"]) == 20

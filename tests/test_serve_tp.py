@@ -1,0 +1,431 @@
+"""Tensor-parallel serving (ISSUE 18): tier-1 parity + autotune gates.
+
+The contract under test:
+
+- a ``ServeEngine(mesh=serve_mesh(tp))`` — one pjit step program over the
+  dp×tp registry mesh, params/bank/KV sharded on tp, slots on dp — answers
+  the SAME seeded mixed-scenario traffic BIT-IDENTICALLY to an unsharded
+  engine built from the identical config and params (tokens exact, lens
+  probabilities allclose), including mid-run slot recycling, EOS/budget
+  finishes, and a mid-load drain;
+- the sharded arm serves every step from ONE warmed executable (zero AOT
+  misses after ``warm_start``), with the speculative draft/verify programs
+  under the same gate;
+- ``serve.autotune.solve`` turns the measured HBM watermark (or the env
+  budget override) into a dp-aligned admission width with the right
+  verdict, publishes it as the ``serve.slots.width`` gauge, and the
+  ``serve_plan_bytes`` plan it prices from tracks the engine's actually
+  resident bytes;
+- the solved width moves admission (``SlotScheduler.set_slot_limit`` /
+  ``occupancy``), rides the heartbeat (``ProgressReporter.serving_update``
+  slots block), and moves the replica router's shed threshold
+  (``BurnRouter`` occupancy weights + the typed ``fleet-saturated`` shed).
+
+All tests run on the conftest-forced 8-host-device CPU mesh (tp=2 → dp=4).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from taboo_brittleness_tpu.obs import metrics
+from taboo_brittleness_tpu.obs.progress import ProgressReporter, read_progress
+from taboo_brittleness_tpu.runtime import aot
+from taboo_brittleness_tpu.serve import autotune, loadgen
+from taboo_brittleness_tpu.serve.replica import (
+    REJECT_FLEET_SATURATED, BurnRouter)
+from taboo_brittleness_tpu.serve.scheduler import SlotScheduler
+from taboo_brittleness_tpu.serve.server import SERVE_SUMMARY_FILENAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+
+TP = 2
+
+#: every scenario family the paper sweeps, all under the exactness gate.
+MIX = {"chat": 1.0, "chat_lens": 1.0, "sae_ablate": 1.0,
+       "projection": 1.0, "forcing": 1.0}
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < TP or jax.device_count() % TP,
+    reason=f"needs a device count divisible by tp={TP}")
+
+
+def _run_arm(shard, *, n=10, seed=11, speculative=False, drain_after=None):
+    """One loadgen pass over a freshly built synthetic engine; returns the
+    report, the per-request Response map, and the AOT stats delta."""
+    aot.reset()
+    engine, scenarios, tgt = loadgen.build_synthetic_engine(
+        tp=TP, shard=shard, speculative=speculative)
+    streams = {}
+    report = loadgen.run_inprocess(
+        engine, n_requests=n, seed=seed, rate=500.0, concurrency=n,
+        mix=MIX, scenarios=scenarios, lens_target_id=tgt,
+        on_complete=lambda r: streams.__setitem__(r.id, r))
+    return engine, report, streams, aot.stats()
+
+
+def _assert_streams_equal(ref, tp):
+    assert set(ref) == set(tp)
+    for rid in sorted(ref):
+        a, b = ref[rid], tp[rid]
+        assert b.scenario == a.scenario and b.ok == a.ok, rid
+        assert b.tokens == a.tokens, (rid, a.scenario)
+        assert b.finish == a.finish, rid
+        assert b.text == a.text, rid
+        if a.lens_probs is None:
+            assert b.lens_probs is None, rid
+        else:
+            np.testing.assert_allclose(
+                b.lens_probs, a.lens_probs, atol=1e-6, err_msg=rid)
+
+
+def _assert_zero_miss(stats):
+    assert stats, "no AOT programs registered"
+    for name, s in stats.items():
+        assert s["misses"] == 0 and s["fallbacks"] == 0, (name, s)
+    assert sum(s["hits"] for s in stats.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# Bit-for-bit parity: sharded vs unsharded, all scenarios, recycle, drain.
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_tp_parity_mixed_scenarios_with_recycle():
+    """10 requests over 4 slots — every slot recycles at least once — across
+    the full scenario mix: token streams exact, lens probs allclose, and the
+    sharded arm zero-miss after warm start."""
+    eng_ref, rep_ref, ref, _ = _run_arm(False)
+    eng_tp, rep_tp, tp, stats = _run_arm(True)
+
+    assert eng_ref.mesh is None
+    assert dict(eng_tp.mesh.shape)["tp"] == TP
+    assert dict(eng_tp.mesh.shape)["dp"] == jax.device_count() // TP
+    assert rep_ref["goodput"]["completed"] == 10
+    assert rep_tp["goodput"]["completed"] == 10
+    # Both EOS and budget finishes occur in the plan (the EOS/early-stop
+    # edge rides the same parity gate as full-budget sessions).
+    assert {r.finish for r in ref.values()} <= {"eos", "budget"}
+    _assert_streams_equal(ref, tp)
+    _assert_zero_miss(stats)
+
+
+@needs_mesh
+def test_tp_parity_speculative_engine():
+    """The speculative engine's draft/verify programs under the same mesh +
+    exactness + zero-miss contract."""
+    _, rep_ref, ref, _ = _run_arm(False, n=8, seed=3, speculative=True)
+    eng_tp, rep_tp, tp, stats = _run_arm(True, n=8, seed=3, speculative=True)
+
+    assert eng_tp.speculative
+    assert rep_ref["goodput"]["completed"] == 8
+    assert rep_tp["goodput"]["completed"] == 8
+    _assert_streams_equal(ref, tp)
+    _assert_zero_miss(stats)
+
+
+@needs_mesh
+def test_tp_parity_mid_load_drain():
+    """Drain mid-load on both arms: accepted sessions (in-flight AND
+    queued) run to completion with identical streams; later submits are
+    rejected on both arms alike."""
+    def drain_arm(shard):
+        aot.reset()
+        engine, scenarios, tgt = loadgen.build_synthetic_engine(
+            tp=TP, shard=shard)
+        engine.warm_start()
+        sched = SlotScheduler(engine, queue_limit=32, lens_target_id=tgt)
+        plan = loadgen.build_schedule(
+            8, seed=21, rate=1e6, mix=MIX, scenarios=scenarios,
+            prompts=("Give me a hint",))
+        reqs = [req for _, req in plan]
+        for req in reqs[:6]:
+            assert sched.submit(req), req.id
+        out = sched.step()
+        sched.drain()
+        late_ok = [sched.submit(req) for req in reqs[6:]]
+        out += sched.run_until_idle()
+        return {r.id: r for r in out if r.reject_reason is None}, late_ok
+
+    ref, late_ref = drain_arm(False)
+    tp, late_tp = drain_arm(True)
+    assert len(ref) == 6 and late_ref == [False, False]
+    assert late_tp == late_ref
+    _assert_streams_equal(ref, tp)
+
+
+# ---------------------------------------------------------------------------
+# HBM-watermark autotuner.
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_autotune_env_budget_verdicts(monkeypatch):
+    """The solver's verdict ladder against the env budget override: a huge
+    budget clamps to the configured width, a starvation budget shrinks to
+    the dp floor, a just-right budget lands 'ok' — always dp-aligned, with
+    admit_limit = 2×width and the solved width on the gauge."""
+    engine, _, _ = loadgen.build_synthetic_engine(tp=TP, shard=True)
+    dp = dict(engine.mesh.shape)["dp"]
+    assert engine.ec.slots % dp == 0
+
+    monkeypatch.setenv("TBX_SERVE_AUTOTUNE_BYTES", str(1 << 40))
+    plan = autotune.solve(engine)
+    assert plan.verdict == "clamped" and plan.source == "env"
+    assert plan.width == engine.ec.slots
+    assert plan.admit_limit == 2 * plan.width
+    assert metrics.gauge("serve.slots.width").value == plan.width
+
+    monkeypatch.setenv("TBX_SERVE_AUTOTUNE_BYTES", str(1 << 10))
+    starved = autotune.solve(engine)
+    assert starved.verdict == "shrunk"
+    assert starved.width == max(dp, 0) and starved.width % dp == 0
+
+    # budget ≈ fixed + 5·per_slot affords raw ∈ [4, 8) → dp-aligns to
+    # exactly the configured 4 → 'ok'.
+    exact = int((plan.fixed_bytes + 5 * plan.per_slot_bytes)
+                / (1.0 - autotune.DEFAULT_RESERVE)) + 1
+    monkeypatch.setenv("TBX_SERVE_AUTOTUNE_BYTES", str(exact))
+    ok = autotune.solve(engine)
+    assert ok.verdict == "ok" and ok.width == engine.ec.slots
+
+    # slots_block is the heartbeat shape.
+    block = ok.slots_block(active=1)
+    assert block == {"width": ok.width, "active": 1,
+                     "free": ok.width - 1, "verdict": "ok"}
+
+
+def test_autotune_fallback_without_signals(monkeypatch):
+    """No env budget and no accelerator limit/headroom gauges (the CPU
+    case): the solver must not guess — fallback verdict at the configured
+    width, never a crash."""
+    monkeypatch.delenv("TBX_SERVE_AUTOTUNE_BYTES", raising=False)
+    engine, _, _ = loadgen.build_synthetic_engine(tp=TP, shard=False)
+    plan = autotune.solve(engine)
+    assert plan.verdict == "fallback"
+    assert plan.width == engine.ec.slots
+    assert plan.budget_bytes is None
+    d = plan.to_dict()
+    assert "plan" not in d and d["verdict"] == "fallback"
+
+
+@needs_mesh
+def test_autotune_plan_tracks_measured_residency(monkeypatch):
+    """Plan-vs-measured drift gate: the per-device byte plan the solver
+    prices from must track what the sharded engine actually holds resident
+    (params + KV pages + slot state), and the ``mem.hbm.live_bytes`` gauge
+    (CPU fallback: summed live-array shard bytes) must cover it."""
+    monkeypatch.setenv("TBX_SERVE_AUTOTUNE_BYTES", str(1 << 40))
+    engine, _, _ = loadgen.build_synthetic_engine(tp=TP, shard=True)
+    plan = autotune.solve(engine)
+    total = plan.fixed_bytes + engine.ec.slots * plan.per_slot_bytes
+
+    ndev = jax.device_count()
+    measured = 0
+    for tree in (engine.params, engine.cache, engine.state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            measured += sum(s.data.nbytes for s in leaf.addressable_shards)
+    measured /= ndev
+    assert measured > 0
+    # The plan prices exactly the resident pytrees from eval_shape, so
+    # drift beyond rounding means the plan and the engine disagree about
+    # what is resident — the undercount bug class this gate pins.
+    assert 0.7 * measured <= total <= 1.5 * measured, (total, measured)
+
+    from taboo_brittleness_tpu.obs import memory
+    memory.sample(compact=True)
+    live = metrics.gauge("mem.hbm.live_bytes").value
+    assert live is not None and live >= measured * ndev * 0.9
+
+
+# ---------------------------------------------------------------------------
+# The solved width moves admission, the heartbeat, and the router.
+# ---------------------------------------------------------------------------
+
+def test_scheduler_slot_limit_and_occupancy():
+    engine, scenarios, tgt = loadgen.build_synthetic_engine(
+        tp=TP, shard=False)
+    engine.warm_start()
+    sched = SlotScheduler(engine, queue_limit=32, lens_target_id=tgt)
+    assert sched.occupancy() == {"width": engine.ec.slots, "active": 0,
+                                 "free": engine.ec.slots}
+    assert sched.set_slot_limit(2) == 2
+    plan = loadgen.build_schedule(4, seed=5, rate=1e6, mix={"chat": 1.0},
+                                  scenarios=scenarios,
+                                  prompts=("Give me a hint",))
+    for _, req in plan:
+        assert sched.submit(req)
+    sched.step()
+    occ = sched.occupancy()
+    assert occ["width"] == 2 and occ["active"] <= 2
+    assert occ["free"] == occ["width"] - occ["active"]
+    assert sched.in_flight <= 2 and sched.queue_depth >= 2
+    # Widening mid-run admits the queued sessions on the next fill.
+    assert sched.set_slot_limit(99) == engine.ec.slots    # clamped high
+    responses = sched.run_until_idle()
+    assert len([r for r in responses if r.ok]) == 4
+    assert sched.set_slot_limit(0) == 1                   # clamped low
+
+
+def test_progress_heartbeat_slots_block(tmp_path):
+    rep = ProgressReporter(str(tmp_path / "_progress.json"), total_words=0,
+                           interval=3600)
+    rep.serving_update(in_flight=1, completed=2, queued=3,
+                       slots={"width": 4, "active": 1, "free": 3,
+                              "verdict": "shrunk"})
+    rep.write_now()
+    on_disk = read_progress(rep.path)
+    assert on_disk["serving"]["slots"] == {
+        "width": 4, "active": 1, "free": 3, "verdict": "shrunk"}
+    # Like latency, the last block persists across slots-less heartbeats.
+    rep.serving_update(in_flight=0, completed=3)
+    snap = rep.snapshot()
+    assert snap["serving"]["slots"]["width"] == 4
+    assert snap["serving"]["completed_requests"] == 3
+
+
+def _heartbeat(path, *, slots=None, queued=0, slo=None):
+    doc = {"status": "running", "pid": 1, "workload": "serve",
+           # tbx: wallclock-ok — fabricated heartbeat freshness for the test
+           "updated_at": time.time(), "heartbeat_seconds": 5.0,
+           "serving": {"in_flight": 0, "completed_requests": 0,
+                       "queued": queued}}
+    if slots is not None:
+        doc["serving"]["slots"] = slots
+    if slo is not None:
+        doc["slo"] = slo
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def test_router_occupancy_weights_and_saturation_shed(tmp_path):
+    out = str(tmp_path)
+    router = BurnRouter(out, ["r0", "r1", "r2"], burn_cap=14.4)
+    _heartbeat(os.path.join(out, "_progress.r0.json"),
+               slots={"width": 4, "active": 2, "free": 2, "verdict": "ok"})
+    _heartbeat(os.path.join(out, "_progress.r1.json"), queued=3,
+               slots={"width": 4, "active": 4, "free": 0, "verdict": "ok"})
+    _heartbeat(os.path.join(out, "_progress.r2.json"))   # no slots block
+
+    view = router.view()
+    # Zero burn: the pure-burn weight is 1.0, scaled by free/width where
+    # the block exists.  r1 is full AND backlogged → saturated, weight 0.
+    assert view["r0"]["weight"] == pytest.approx(0.5)
+    assert view["r0"]["slots_width"] == 4 and view["r0"]["slots_free"] == 2
+    assert not view["r0"]["saturated"]
+    assert view["r1"]["weight"] == 0.0 and view["r1"]["saturated"]
+    # No slots block: unscaled weight, never saturates (mixed-fleet compat).
+    assert view["r2"]["weight"] == pytest.approx(1.0)
+    assert not view["r2"]["saturated"] and "slots_width" not in view["r2"]
+    assert not BurnRouter.all_saturated(view)
+
+    # The router routes around the full replica...
+    for _ in range(16):
+        assert router.pick(view) in ("r0", "r2")
+
+    # ...and when EVERY live replica is full + backlogged, the fleet sheds
+    # with the typed reason.
+    _heartbeat(os.path.join(out, "_progress.r0.json"), queued=1,
+               slots={"width": 4, "active": 4, "free": 0, "verdict": "ok"})
+    _heartbeat(os.path.join(out, "_progress.r2.json"), queued=2,
+               slots={"width": 2, "active": 2, "free": 0,
+                      "verdict": "shrunk"})
+    view = router.view()
+    assert BurnRouter.all_saturated(view)
+    assert router.pick(view) is None
+    assert REJECT_FLEET_SATURATED == "fleet-saturated"
+
+    # A full-but-idle fleet (no backlog) must WAIT, not shed: momentary
+    # fullness with heartbeat lag is not saturation.
+    _heartbeat(os.path.join(out, "_progress.r0.json"), queued=0,
+               slots={"width": 4, "active": 4, "free": 0, "verdict": "ok"})
+    assert not BurnRouter.all_saturated(router.view())
+
+
+# ---------------------------------------------------------------------------
+# Reporting surfaces: bench_compare band + the spool e2e.
+# ---------------------------------------------------------------------------
+
+def test_bench_compare_serve_tp_band(tmp_path):
+    def write(repo, n, parsed):
+        os.makedirs(repo, exist_ok=True)
+        with open(os.path.join(repo, f"BENCH_r{n}.json"), "w") as f:
+            json.dump({"n": n, "parsed": parsed}, f)
+
+    regressed = str(tmp_path / "regressed")
+    write(regressed, 1, {"serve_tp_ab": {"tp_speedup": 1.0}})
+    write(regressed, 2, {"serve_tp_ab": {"tp_speedup": 0.6}})
+    _, regressions, rc = bench_compare.compare(regressed)
+    assert rc == 1
+    assert any("serve_tp_ab.tp_speedup" in r for r in regressions)
+
+    inside = str(tmp_path / "inside")
+    write(inside, 1, {"serve_tp_ab": {"tp_speedup": 1.0}})
+    write(inside, 2, {"serve_tp_ab": {"tp_speedup": 0.9}})
+    _, regressions, rc = bench_compare.compare(inside)
+    assert rc == 0 and not regressions
+
+    # A round that ran without a multi-device mesh (skip-note dict, no
+    # tp_speedup) is skipped, never failed.
+    absent = str(tmp_path / "absent")
+    write(absent, 1, {"serve_tp_ab": {"tp_speedup": 1.0}})
+    write(absent, 2, {"value": 1.0})
+    lines, regressions, rc = bench_compare.compare(absent)
+    assert rc == 0 and not regressions
+    assert any("serve_tp_ab.tp_speedup" in ln and "skipped" in ln
+               for ln in lines)
+
+
+@needs_mesh
+def test_serve_subprocess_tp_spool_e2e(tmp_path):
+    """Real ``tbx serve --synthetic --tp 2`` answering spooled mixed
+    traffic: zero AOT misses after warm start, the mesh + autotune blocks
+    in the exit summary, and the solved width riding the heartbeat."""
+    out = str(tmp_path / "spool")
+    n = 6
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["TBX_OBS_PROGRESS_S"] = "0.1"
+    env.pop("TBX_SERVE_TP", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+         "--synthetic", "--output-dir", out, "--slots", "4", "--tp", str(TP),
+         "--poll", "0.02", "--max-requests", str(n)],
+        env=env, cwd=REPO)
+    try:
+        report = loadgen.run_spool(
+            out, n_requests=n, seed=9, rate=500.0, concurrency=n,
+            mix={"chat": 1.0, "sae_ablate": 1.0, "forcing": 1.0},
+            timeout_s=240.0)
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0
+    assert report["goodput"]["completed"] == n
+
+    with open(os.path.join(out, SERVE_SUMMARY_FILENAME)) as f:
+        summary = json.load(f)
+    assert summary["aot"]["misses"] == 0
+    assert summary["aot"]["fallbacks"] == 0
+    assert summary["aot"]["hits"] == summary["engine_steps"] > 0
+    assert summary["mesh"]["tp"] == TP
+    assert summary["mesh"]["dp"] == 8 // TP
+    assert summary["autotune"]["verdict"] in (
+        "ok", "clamped", "shrunk", "fallback")
+    assert summary["autotune"]["width"] >= 1
+
+    progress = read_progress(os.path.join(out, "_progress.json"))
+    slots = progress["serving"]["slots"]
+    assert slots["width"] == summary["autotune"]["width"]
+    assert slots["verdict"] == summary["autotune"]["verdict"]
+    assert slots["free"] == slots["width"] - slots["active"]

@@ -65,6 +65,13 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # spec_ab's — a calibration/lens regression moves it first.
     "serve_spec_ab.spec_speedup": (0.25, True, 0.0),
     "serve_spec_ab.accept_rate": (0.25, True, 0.0),
+    # Tensor-parallel serving rollout metric (bench.py serve_tp_ab, ISSUE
+    # 18): the sharded-over-unsharded loadgen speedup must not slide back.
+    # On the CPU smoke's forced-host-device mesh the "speedup" is really a
+    # collectives-overhead watermark (< 1 is expected there); the band
+    # tracks the trend either way.  Skipped with a note when a round ran
+    # without a multi-device mesh.
+    "serve_tp_ab.tp_speedup": (0.25, True, 0.0),
     # Elastic-fleet recovery (bench.py fleet_recovery, ISSUE 10): the time
     # from a worker death's lease expiry to the re-issued unit committing
     # must not creep up.  Wide band (±50%): the path crosses subprocess

@@ -48,8 +48,13 @@ else
   skip "ruff"
 fi
 
+# Force an 8-host-device mesh so the [tp] deep entries trace SHARDED (the
+# acceptance shape); the baseline also carries the 1-device fallback
+# fingerprints so a bare run stays green.
 gate "tbx-check (static + deep + conc)" \
-  env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu.analysis \
+  env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m taboo_brittleness_tpu.analysis \
   --deep --baseline tools/tbx_baseline.json \
   taboo_brittleness_tpu/ tools/ tests/
 
@@ -76,6 +81,13 @@ if [ "$FAST" -eq 0 ]; then
 
   gate "serve loadgen selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
+
+  # Tensor-parallel serving parity: spool identical traffic through a
+  # tp=2-sharded engine and an unsharded reference on a forced 8-device
+  # host mesh; token streams must match bit-for-bit and the tp arm must
+  # report zero AOT misses after warm start.
+  gate "serve tp selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu serve --selfcheck
 
   gate "fleet selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu fleet --selfcheck
