@@ -1072,6 +1072,21 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
     config = Config(word_plurals={w: [w] for w in words})
     seeds = {"n": 0}
 
+    serve_burst = 0
+    serve_engine = serve_scen = serve_tgt = None
+    if live:
+        # Live arm also proves REQUEST TRACING is noise-level: each rep
+        # appends a small in-process serve burst (same compute both arms;
+        # the obs-on arm additionally mints trace contexts, opens one
+        # lifecycle span per request, and records TTFT histograms +
+        # exemplars).  Engine built/compiled once, off the books.
+        from taboo_brittleness_tpu.serve import loadgen as serve_loadgen
+
+        serve_burst = 8 if on_accel else 16
+        serve_engine, serve_scen, serve_tgt = (
+            serve_loadgen.build_synthetic_engine(max_new_tokens=4))
+        serve_engine.warm_start()
+
     def smoke_decode(word):
         # Fresh inputs per call (per word x rep): the TPU runtime dedupes
         # byte-identical re-executions, which would zero the compute both
@@ -1107,6 +1122,18 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
                 compute_mode=lambda p, c, t, cf, m: None,
                 score_word=lambda cf, w, m, payload: smoke_decode(w),
                 output_dir=out_dir, pipeline="obs_ab_smoke")
+            if serve_burst:
+                from taboo_brittleness_tpu import obs as obs_pkg
+                from taboo_brittleness_tpu.serve import (
+                    loadgen as serve_loadgen)
+
+                serve_dir = os.path.join(out_dir, "serve")
+                with obs_pkg.sweep_observer(serve_dir,
+                                            pipeline="obs_ab_serve"):
+                    serve_loadgen.run_inprocess(
+                        serve_engine, n_requests=serve_burst, seed=1,
+                        rate=500.0, concurrency=8, scenarios=serve_scen,
+                        lens_target_id=serve_tgt)
             dt = time.perf_counter() - t0
             events_path = os.path.join(out_dir, "_events.jsonl")
             n_events = 0
@@ -1155,9 +1182,13 @@ def _obs_overhead_ab(params, cfg, new_tokens: int, reps: int,
                          if overhead is not None else None),
         "events_per_run": events,
         "live_sampler": bool(live),
+        "serve_burst_requests": serve_burst,
         "budget": ("obs-on (windowed spool + SLO engine + flight recorder "
-                   "at TBX_OBS_TS_S=0.5) must stay <2% wall over obs-off "
-                   "(ratio of paired-rep totals)" if live else
+                   "at TBX_OBS_TS_S=0.5, plus request tracing: per-request "
+                   "lifecycle spans, trace-context minting, TTFT histograms "
+                   "+ exemplars over an in-process serve burst) must stay "
+                   "<2% wall over obs-off (ratio of paired-rep totals)"
+                   if live else
                    "obs-on must stay <2% wall over obs-off (ratio of "
                    "paired-rep totals)"),
     }
@@ -2113,11 +2144,15 @@ def main() -> int:
             dict(grid_stage["attack_search"])
             if grid_stage and "error" not in grid_stage else None),
         # Serving SLO (serve subsystem): closed-loop loadgen over the
-        # resident engine — pooled p50/p99 + goodput; per-scenario table in
-        # the detail block "serve_latency".
+        # resident engine — pooled p50/p99 + TTFT p50/p99 + goodput;
+        # per-scenario table in the detail block "serve_latency".
         "serve_latency": (serve_stage and {
             "p50_s": serve_stage["overall"]["p50_s"],
             "p99_s": serve_stage["overall"]["p99_s"],
+            **({"ttft_p50": serve_stage["overall_ttft"]["p50_s"],
+                "ttft_p99": serve_stage["overall_ttft"]["p99_s"]}
+               if (serve_stage.get("overall_ttft") or {}).get("count")
+               else {}),
             "completed_per_second":
                 serve_stage["goodput"]["completed_per_second"],
             "goodput": (serve_stage["goodput"]["completed"],

@@ -1075,6 +1075,25 @@ def cmd_top(args) -> int:
     return top.run(args.dir, once=args.once, interval=args.interval)
 
 
+def cmd_trace(args) -> int:
+    """Per-request waterfalls (``obs.reqtrace``) assembled from a serve
+    run's event streams: attempt chains across replica death, TTFT,
+    critical-path attribution.  Read-only and stdlib-only."""
+    from taboo_brittleness_tpu.obs import reqtrace
+
+    argv: List[str] = []
+    if args.dir:
+        argv.append(args.dir)
+    if args.request:
+        argv += ["--request", args.request]
+    if args.trace:
+        argv += ["--trace", args.trace]
+    argv += ["--slowest", str(args.slowest)]
+    if args.selfcheck:
+        argv.append("--selfcheck")
+    return reqtrace.main(argv)
+
+
 def cmd_grid(args) -> int:
     """Gemma-Scope grid sweep (``grid/``): capture each word's residuals
     ONCE while tapping every grid layer in a single launched program, then
@@ -1744,6 +1763,29 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--rows", type=int, default=10,
                     help="batch rows assumed by the roofline cost model")
     sc.set_defaults(fn=cmd_spec_calibrate)
+
+    tr = sub.add_parser(
+        "trace",
+        help="per-request waterfalls from a serve run's event streams "
+             "(attempt chains across replica death, TTFT, critical path)",
+        description="Joins the merged and per-worker _events*.jsonl "
+                    "streams of a serve run into per-request waterfalls: "
+                    "every attempt span under one trace id (a re-spooled "
+                    "retry is a new attempt under the SAME trace), the "
+                    "coordinator's route/respool/respond points, TTFT, and "
+                    "a queue/prefill/decode critical-path split. Read-only.")
+    tr.add_argument("dir", nargs="?",
+                    help="results dir (or a direct _events.jsonl path)")
+    tr.add_argument("--request", default=None, metavar="RID",
+                    help="render one request id's trace")
+    tr.add_argument("--trace", default=None, metavar="TID",
+                    help="render one trace_id (e.g. a tbx top exemplar)")
+    tr.add_argument("--slowest", type=int, default=10, metavar="N",
+                    help="render the N slowest completed traces (default)")
+    tr.add_argument("--selfcheck", action="store_true",
+                    help="gate the committed serve_fleet fixture "
+                         "(CI smoke, tools/check.sh)")
+    tr.set_defaults(fn=cmd_trace)
     return p
 
 

@@ -37,7 +37,8 @@ import uuid
 from typing import Any, Iterator, Optional, Sequence
 
 from taboo_brittleness_tpu.obs import (
-    flightrec, memory, metrics, profile, progress, slo, timeseries, trace)
+    flightrec, memory, metrics, profile, progress, reqtrace, slo, timeseries,
+    trace)
 from taboo_brittleness_tpu.obs.trace import (
     EVENTS_FILENAME, NULL_SPAN, SCHEMA_VERSION, Tracer, activate, deactivate,
     enabled, event, events_path, get_tracer, iter_events, last_seq, span)
@@ -52,7 +53,7 @@ __all__ = [
     "TimeseriesRecorder", "Tracer",
     "activate", "deactivate", "enabled", "event", "events_path", "flightrec",
     "get_tracer", "iter_events", "last_seq", "memory", "metrics", "profile",
-    "progress", "read_progress", "slo", "span", "sweep_observer",
+    "progress", "read_progress", "reqtrace", "slo", "span", "sweep_observer",
     "timeseries", "trace", "warn",
 ]
 

@@ -116,6 +116,17 @@ class FlightRecorder:
         if extra:
             payload["context"] = extra
         try:
+            # Burn → trace exemplars: snapshot the worst trace ids per
+            # latency series so a postmortem dump links back to the exact
+            # requests that were hurting when the dump fired.
+            from taboo_brittleness_tpu.obs import reqtrace
+
+            exemplars = reqtrace.peek_exemplars()
+            if exemplars:
+                payload["exemplars"] = exemplars
+        except Exception:  # noqa: BLE001 — fail-open
+            pass
+        try:
             import json
 
             tmp = f"{path}.tmp.{os.getpid()}"

@@ -95,10 +95,20 @@ def default_targets() -> List[SloTarget]:
                                                     "2.5")))
     except ValueError:
         latency_s = 2.5
+    try:
+        ttft_s = max(0.001, float(os.environ.get("TBX_SLO_TTFT_S", "1.0")))
+    except ValueError:
+        ttft_s = 1.0
     return [
         # Per-scenario end-to-end serve latency: ≤ latency_s for all but 5%.
         SloTarget(name="serve_latency", source="histogram",
                   metric="serve.latency.*", threshold=latency_s,
+                  op="le", budget=0.05),
+        # Per-scenario time-to-first-token (submit → first emitted token,
+        # re-timed on the surviving attempt after a re-spool): ≤ ttft_s for
+        # all but 5% — the interactivity half of the latency story.
+        SloTarget(name="serve_ttft", source="histogram",
+                  metric="serve.ttft.*", threshold=ttft_s,
                   op="le", budget=0.05),
         # Goodput: ≥ 99% of admitted requests complete (per window).
         SloTarget(name="serve_goodput", source="ratio",
@@ -167,11 +177,12 @@ class SloEngine:
     # -- per-window observation --------------------------------------------
 
     def _observations(self, target: SloTarget, hists, counter_deltas,
-                      gauges) -> List[Tuple[str, float, float]]:
-        """(series key, bad, total) contributions of one window.  A series
-        with nothing to say this window contributes (0, 0) implicitly by
-        not appearing — idle windows age old badness out of the spans."""
-        out: List[Tuple[str, float, float]] = []
+                      gauges) -> List[Tuple[str, float, float, str]]:
+        """(series key, bad, total, metric name) contributions of one
+        window.  A series with nothing to say this window contributes
+        (0, 0) implicitly by not appearing — idle windows age old badness
+        out of the spans."""
+        out: List[Tuple[str, float, float, str]] = []
         if target.source == "histogram":
             for name, win in hists.items():
                 if not fnmatch.fnmatchcase(name, target.metric):
@@ -181,19 +192,20 @@ class SloEngine:
                     continue
                 bad = sum(1 for v in samples if not target.good(v))
                 out.append((_series_key(target, name), float(bad),
-                            float(len(samples))))
+                            float(len(samples)), name))
         elif target.source == "gauge":
             for name, value in gauges.items():
                 if not fnmatch.fnmatchcase(name, target.metric):
                     continue
                 out.append((_series_key(target, name),
-                            0.0 if target.good(value) else 1.0, 1.0))
+                            0.0 if target.good(value) else 1.0, 1.0, name))
         elif target.source == "ratio":
             den = counter_deltas.get(target.metric_b, 0.0)
             if den > 0:
                 num = counter_deltas.get(target.metric, 0.0)
                 out.append((target.name,
-                            0.0 if target.good(num / den) else 1.0, 1.0))
+                            0.0 if target.good(num / den) else 1.0, 1.0,
+                            target.metric))
         return out
 
     def observe_window(self, *, dur: float, hists: Dict[str, Any],
@@ -203,18 +215,18 @@ class SloEngine:
         ``slo.burn.<series>`` gauges; emit at most one ``obs.warn`` per
         newly-sustained alert episode.  Returns the heartbeat block
         ``{series: {burn, fast, slow, ok}}``."""
-        contributions: Dict[str, Tuple[SloTarget, float, float]] = {}
+        contributions: Dict[str, Tuple[SloTarget, float, float, str]] = {}
         for target in self.targets:
-            for key, bad, total in self._observations(
+            for key, bad, total, metric in self._observations(
                     target, hists, counter_deltas, gauges):
-                contributions[key] = (target, bad, total)
+                contributions[key] = (target, bad, total, metric)
         block: Dict[str, Dict[str, Any]] = {}
         # Every KNOWN series advances each window — absent = (0, 0) — so a
         # regression that stops the traffic entirely still ages out.
         keys = set(self._series) | set(contributions)
         for key in sorted(keys):
-            target, bad, total = contributions.get(
-                key, (None, 0.0, 0.0))
+            target, bad, total, metric = contributions.get(
+                key, (None, 0.0, 0.0, ""))
             series = self._series.get(key)
             if series is None:
                 if target is None:
@@ -231,6 +243,17 @@ class SloEngine:
             ok = burn < target.alert_burn
             block[key] = {"burn": burn, "fast": round(fast, 4),
                           "slow": round(slow, 4), "ok": ok}
+            if metric and target.source == "histogram":
+                # Burn → trace exemplars: the window's worst trace ids for
+                # this series ride the heartbeat block, so an operator can
+                # jump straight from a burning row to ``tbx trace``.
+                try:
+                    from taboo_brittleness_tpu.obs import reqtrace
+                    exemplars = reqtrace.take_exemplars(metric)
+                    if exemplars:
+                        block[key]["exemplars"] = exemplars
+                except Exception:  # noqa: BLE001 — fail-open
+                    pass
             try:
                 self.registry.gauge(f"slo.burn.{key}").set(burn)
             except Exception:  # noqa: BLE001 — fail-open

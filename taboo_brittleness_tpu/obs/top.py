@@ -212,9 +212,15 @@ def _slo_lines(latest: Dict[str, Any]) -> List[str]:
     for key in sorted(block):
         cell = block[key]
         flag = "ok" if cell.get("ok") else "ALERT"
-        out.append(f"  {key:<28} {cell.get('burn', 0):>8.2f}x  "
-                   f"fast {cell.get('fast', 0):.2f}  "
-                   f"slow {cell.get('slow', 0):.2f}  {flag}")
+        line = (f"  {key:<28} {cell.get('burn', 0):>8.2f}x  "
+                f"fast {cell.get('fast', 0):.2f}  "
+                f"slow {cell.get('slow', 0):.2f}  {flag}")
+        exemplars = cell.get("exemplars") or []
+        if exemplars:
+            # Worst trace ids this window — feed them to ``tbx trace
+            # <results_dir> --trace <id>`` for the full waterfall.
+            line += "  traces: " + ",".join(str(t) for t in exemplars[:3])
+        out.append(line)
     return out
 
 

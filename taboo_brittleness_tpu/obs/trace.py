@@ -47,7 +47,10 @@ SCHEMA_VERSION = 1
 EVENTS_FILENAME = "_events.jsonl"
 
 #: Span kinds, outermost first — the hierarchy trace_report renders.
-KINDS = ("run", "word", "phase", "program", "point")
+#: ``request`` spans (serve.scheduler) are per-request lifecycle intervals:
+#: they parent under the run span but live OFF the per-thread stack (many
+#: interleave on the one serve thread), opened via :meth:`Tracer.span_detached`.
+KINDS = ("run", "word", "phase", "program", "request", "point")
 
 
 def enabled() -> bool:
@@ -348,6 +351,32 @@ class Tracer:
         self._stack().append(sp)
         return sp
 
+    def span_detached(self, name: str, *, kind: str = "request",
+                      parent: Optional[int] = None, **attrs: Any) -> Span:
+        """Open a span WITHOUT joining the per-thread stack.
+
+        For intervals that overlap arbitrarily on one thread (the serve
+        loop's per-request lifecycle spans: many requests in flight, none
+        nesting inside another): the span still parents under the thread's
+        current span (or an explicit ``parent=``), but later ``span()``
+        calls on this thread do NOT nest under it, and ending it cannot
+        pop unrelated spans off the stack.  End explicitly via
+        ``sp.end()``."""
+        cur = self.current_span()
+        parent_id = parent if parent is not None else (
+            cur.span_id if cur is not None else None)
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        rec: Dict[str, Any] = {"ev": "start", "kind": kind, "name": name,
+                               "id": span_id}
+        if parent_id is not None:
+            rec["parent"] = parent_id
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        self._emit(rec)
+        return Span(self, name, kind, span_id, parent_id, dict(attrs))
+
     def event(self, name: str, *, parent: Optional[int] = None,
               **attrs: Any) -> None:
         """A zero-duration point event (retry, quarantine, prefetch start,
@@ -471,6 +500,16 @@ def span(name: str, *, kind: str = "phase", **attrs: Any):
         return NULL_SPAN
     try:
         return t.span(name, kind=kind, **attrs)
+    except Exception:  # noqa: BLE001 — fail-open
+        return NULL_SPAN
+
+
+def span_detached(name: str, *, kind: str = "request", **attrs: Any):
+    t = get_tracer()
+    if t is None:
+        return NULL_SPAN
+    try:
+        return t.span_detached(name, kind=kind, **attrs)
     except Exception:  # noqa: BLE001 — fail-open
         return NULL_SPAN
 

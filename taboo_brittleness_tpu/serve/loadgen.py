@@ -27,6 +27,7 @@ import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from taboo_brittleness_tpu.obs import reqtrace
 from taboo_brittleness_tpu.serve.scheduler import (
     Request, Scenario, SlotScheduler, default_scenarios)
 
@@ -87,19 +88,30 @@ def build_schedule(
             prompt=prompts[i % len(prompts)],
             scenario=scenarios[name],
             seed=seed * 10_000 + i,
-            word=word)))
+            word=word,
+            trace=reqtrace.mint())))
     return out
 
 
 def _report(per_scenario_lat: Dict[str, List[float]], *,
             admitted: int, completed: int, rejected: int, quarantined: int,
-            wall_seconds: float, config: Dict[str, Any]) -> Dict[str, Any]:
+            wall_seconds: float, config: Dict[str, Any],
+            per_scenario_ttft: Optional[Dict[str, List[float]]] = None,
+            ) -> Dict[str, Any]:
+    ttft = per_scenario_ttft or {}
+    scenarios_block: Dict[str, Any] = {}
+    for name, lats in sorted(per_scenario_lat.items()):
+        block = _latency_block(lats)
+        if ttft.get(name):
+            block["ttft"] = _latency_block(ttft[name])
+        scenarios_block[name] = block
     return {
         "stage": "serve_latency",
-        "scenarios": {name: _latency_block(lats)
-                      for name, lats in sorted(per_scenario_lat.items())},
+        "scenarios": scenarios_block,
         "overall": _latency_block(
             [x for lats in per_scenario_lat.values() for x in lats]),
+        "overall_ttft": _latency_block(
+            [x for vals in ttft.values() for x in vals]),
         "goodput": {
             "admitted": admitted,
             "completed": completed,
@@ -145,6 +157,7 @@ def run_inprocess(
     engine.warm_start()
 
     lat: Dict[str, List[float]] = {}
+    ttft: Dict[str, List[float]] = {}
     t0 = clock()
     pending = list(plan)
     outstanding = 0
@@ -166,6 +179,9 @@ def run_inprocess(
                 if resp.ok:
                     lat.setdefault(resp.scenario, []).append(
                         resp.latency_seconds)
+                    if resp.ttft_seconds is not None:
+                        ttft.setdefault(resp.scenario, []).append(
+                            resp.ttft_seconds)
         elif pending:
             # Nothing in flight and the next arrival is in the future: sleep
             # to it (closed loop, not busy wait).
@@ -175,7 +191,8 @@ def run_inprocess(
     wall = clock() - t0
     speculative = bool(getattr(engine, "speculative", False))
     report = _report(
-        lat, admitted=sched.admitted, completed=sched.completed,
+        lat, per_scenario_ttft=ttft,
+        admitted=sched.admitted, completed=sched.completed,
         rejected=sched.rejected, quarantined=sched.quarantined,
         wall_seconds=wall,
         config={"mode": "in-process", "n_requests": n_requests, "seed": seed,
@@ -216,6 +233,7 @@ def run_spool(
                           scenarios=scenarios, prompts=prompts, words=words)
 
     lat: Dict[str, List[float]] = {}
+    ttft: Dict[str, List[float]] = {}
     submit_at: Dict[str, float] = {}
     scenario_of: Dict[str, str] = {}
     pending = list(plan)
@@ -230,7 +248,9 @@ def run_spool(
             rid = spool.put({"id": req.id, "prompt": req.prompt,
                              "scenario": req.scenario.name,
                              "seed": req.seed,
-                             **({"word": req.word} if req.word else {})})
+                             **({"word": req.word} if req.word else {}),
+                             **({reqtrace.CTX_KEY: req.trace}
+                                if req.trace else {})})
             submit_at[rid] = clock()
             scenario_of[rid] = req.scenario.name
             awaiting.append(rid)
@@ -244,12 +264,18 @@ def run_spool(
             if resp.get("ok"):
                 lat.setdefault(scenario_of[rid], []).append(
                     clock() - submit_at[rid])
+                if resp.get("ttft_seconds") is not None:
+                    # Server-side TTFT (admit → first token); the client-side
+                    # clocks above include spool transit, this one doesn't.
+                    ttft.setdefault(scenario_of[rid], []).append(
+                        float(resp["ttft_seconds"]))
         awaiting = still
         if awaiting or pending:
             time.sleep(poll_s)
     wall = clock() - t0
     return _report(
-        lat, admitted=len(submit_at), completed=completed,
+        lat, per_scenario_ttft=ttft,
+        admitted=len(submit_at), completed=completed,
         rejected=0, quarantined=len(submit_at) - completed,
         wall_seconds=wall,
         config={"mode": "spool", "spool": spool_dir,
@@ -421,6 +447,17 @@ def selfcheck(n_requests: int = 32, seed: int = 0) -> Dict[str, Any]:
         missing = [k for k in LATENCY_KEYS if k not in block]
         assert not missing, f"scenario {name} missing keys {missing}"
         assert block["count"] > 0, f"scenario {name} never ran"
+        tb = block.get("ttft")
+        assert tb and tb["count"] > 0, (
+            f"scenario {name} has no TTFT samples: {block}")
+        missing = [k for k in LATENCY_KEYS if k not in tb]
+        assert not missing, f"scenario {name} ttft missing keys {missing}"
+        assert tb["p99_s"] <= block["max_s"] + 1e-9, (
+            f"scenario {name}: TTFT p99 above max latency — "
+            f"first token cannot land after the response: {block}")
+    ot = report.get("overall_ttft")
+    assert ot and ot["count"] == report["overall"]["count"], (
+        f"overall TTFT incomplete: {ot} vs {report['overall']}")
     assert set(report["scenarios"]) == set(scenarios), (
         "selfcheck mix must exercise every scenario: "
         f"{sorted(report['scenarios'])} vs {sorted(scenarios)}")

@@ -55,6 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from taboo_brittleness_tpu import obs
 from taboo_brittleness_tpu.obs import metrics as obs_metrics
+from taboo_brittleness_tpu.obs import reqtrace
 from taboo_brittleness_tpu.obs.progress import read_progress
 from taboo_brittleness_tpu.runtime import supervise
 from taboo_brittleness_tpu.runtime import fleet as fleet_mod
@@ -318,12 +319,15 @@ def _shed(spool: RequestSpool, rid: str, payload: Dict[str, Any],
     past the burn cap, or every admission width full with a backlog),
     committed first-writer-wins like any response so a racing late replica
     completion stays benign."""
+    ctx = reqtrace.parse(payload)
     spool.respond_exclusive(
         Response(id=rid, ok=False,
                  scenario=str(payload.get("scenario", "chat")),
                  finish="rejected",
                  reject_reason=reason,
-                 error=f"admission rejected ({reason})"),
+                 error=f"admission rejected ({reason})",
+                 trace_id=ctx.get("trace_id") if ctx else None,
+                 attempt=int(ctx.get("attempt", 0)) if ctx else 0),
         holder=ROUTER_HOLDER)
 
 
@@ -409,7 +413,16 @@ def run_serve_fleet(
             nonlocal respooled
             excluded = sorted(set(wrapper.get("excluded", ())) | {holder})
             nxt = attempt + 1
-            spool.assign(rid, dict(wrapper.get("request") or {}), target,
+            payload = dict(wrapper.get("request") or {})
+            # The re-spool is a retry child under the SAME trace: bump the
+            # carried context's attempt and record the dead holder so the
+            # surviving replica's request span (and the response stamp)
+            # keep one trace_id across the death.
+            ctx = reqtrace.parse(payload)
+            if ctx is not None:
+                payload[reqtrace.CTX_KEY] = ctx = reqtrace.for_attempt(
+                    ctx, nxt, dead_holder=holder)
+            spool.assign(rid, payload, target,
                          attempt=nxt, excluded=excluded)
             spool.release_claimed(rid, attempt, holder)
             issued[rid] = nxt
@@ -422,7 +435,9 @@ def run_serve_fleet(
                 # tbx: wallclock-ok — serialized metadata for humans
                 "at": time.time()})
             ob.event("serve_fleet.respool", request=rid, worker=target,
-                     attempt=nxt, excluded=excluded, reason=reason)
+                     attempt=nxt, excluded=excluded, reason=reason,
+                     dead_holder=holder,
+                     **({"trace": ctx.get("trace_id")} if ctx else {}))
 
         while True:
             now_mono = time.monotonic()

@@ -48,7 +48,8 @@ import numpy as np
 from taboo_brittleness_tpu import obs
 from taboo_brittleness_tpu.obs import flightrec
 from taboo_brittleness_tpu.obs import metrics as obs_metrics
-from taboo_brittleness_tpu.obs import timeseries
+from taboo_brittleness_tpu.obs import reqtrace, timeseries
+from taboo_brittleness_tpu.obs import trace as obs_trace
 from taboo_brittleness_tpu.runtime import chat, resilience
 from taboo_brittleness_tpu.runtime.resilience import current_worker_id
 from taboo_brittleness_tpu.serve.engine import ServeEngine
@@ -128,6 +129,17 @@ class Request:
     seed: int = 0
     submitted_at: float = 0.0      # monotonic; stamped by submit()
     word: Optional[str] = None     # taboo word; None = the engine's default
+    # Distributed trace context (obs.reqtrace: trace_id/attempt/...) carried
+    # in from the request payload; None = untraced (legacy / direct tests).
+    trace: Optional[Dict[str, Any]] = None
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.get("trace_id") if self.trace else None
+
+    @property
+    def attempt(self) -> int:
+        return int(self.trace.get("attempt", 0)) if self.trace else 0
 
 
 @dataclasses.dataclass
@@ -155,6 +167,12 @@ class Response:
     accepted: int = 0
     exited_early: int = 0
     early_agreement: Optional[float] = None
+    # Distributed-trace stamp (obs.reqtrace): the trace this response
+    # resolves, which attempt answered, and submit→first-token seconds on
+    # the serving attempt (None before the first token / when untraced).
+    trace_id: Optional[str] = None
+    attempt: int = 0
+    ttft_seconds: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -172,6 +190,10 @@ class _Session:
     accepted: int = 0
     early: int = 0
     early_agree: int = 0
+    # Request-lifecycle span (kind="request", off the thread stack) opened
+    # at submit; NULL_SPAN when no tracer is active.
+    span: Any = obs_trace.NULL_SPAN
+    ttft_seconds: Optional[float] = None
 
 
 class SlotScheduler:
@@ -199,6 +221,9 @@ class SlotScheduler:
         self._clock = clock
         self._queue: Deque[Request] = deque()
         self._sessions: Dict[int, _Session] = {}      # slot -> session
+        # Request-lifecycle spans opened at submit, adopted by the session
+        # at admit (queued requests own a span before they own a slot).
+        self._req_spans: Dict[str, Any] = {}
         self._scenarios_completed: set = set()
         self._speculative = bool(getattr(engine, "speculative", False))
         self._accept: Dict[str, Dict[str, int]] = {}  # scenario -> totals
@@ -265,7 +290,24 @@ class SlotScheduler:
         self._queue.append(req)
         obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
         obs.event("serve.request", request=req.id,
-                  scenario=req.scenario.name, prompt_tokens=len(ids))
+                  scenario=req.scenario.name, prompt_tokens=len(ids),
+                  **({"trace": req.trace_id} if req.trace_id else {}))
+        # Per-request lifecycle span (obs.reqtrace): detached from the
+        # thread stack (many requests interleave on this one thread),
+        # parented under the serve run span, ended by _finish.  Flushed
+        # immediately so a replica killed mid-decode leaves the START on
+        # disk — the fleet merge then closes it with a synthesized error
+        # end, which is the dead attempt the waterfall shows.
+        tracer = obs_trace.get_tracer()
+        if tracer is not None:
+            try:
+                self._req_spans[req.id] = tracer.span_detached(
+                    reqtrace.REQUEST_SPAN, kind="request", request=req.id,
+                    scenario=req.scenario.name, attempt=req.attempt,
+                    **({"trace": req.trace_id} if req.trace_id else {}))
+                tracer.flush()
+            except Exception:  # noqa: BLE001 — tracing is fail-open
+                pass
         self._fill_slots()
         return True
 
@@ -335,10 +377,12 @@ class SlotScheduler:
                 basis=self._basis(req),
                 lens_target=(self.lens_target_id if sc.lens_readout else -1),
                 word_id=0 if word_id is None else word_id, **extra)
+            span = self._req_spans.pop(req.id, obs_trace.NULL_SPAN)
             self._sessions[slot] = _Session(request=req, slot=slot,
-                                            admitted_at=now)
+                                            admitted_at=now, span=span)
             self.admitted += 1
             queue_wait = now - req.submitted_at
+            span.set(slot=slot, queue_seconds=round(queue_wait, 6))
             obs_metrics.counter("serve.admitted").inc()
             obs_metrics.histogram("serve.queue_wait").observe(queue_wait)
             obs.event("serve.admit", request=req.id, slot=slot,
@@ -392,6 +436,8 @@ class SlotScheduler:
             if multi_col:
                 for j in range(out.toks.shape[1]):
                     if bool(out.emit[slot, j]):
+                        if not sess.tokens:
+                            self._first_token(sess)
                         sess.tokens.append(int(out.toks[slot, j]))
                         if sess.request.scenario.lens_readout:
                             sess.lens_probs.append(
@@ -405,6 +451,8 @@ class SlotScheduler:
                 sess.early += int(out.early[slot])
                 sess.early_agree += int(out.early_agree[slot])
             elif bool(out.emitted[slot]):
+                if not sess.tokens:
+                    self._first_token(sess)
                 sess.tokens.append(int(out.tok[slot]))
                 if sess.request.scenario.lens_readout:
                     sess.lens_probs.append(float(out.lens_prob[slot]))
@@ -420,6 +468,18 @@ class SlotScheduler:
             obs_metrics.counter("serve.spec.accepted").inc(step_accepted)
         self._after_step(responses)
         return responses
+
+    def _first_token(self, sess: _Session) -> None:
+        """TTFT mark: submit → the session's FIRST emitted token (this
+        attempt's clock — a re-spooled request restarts it on the surviving
+        replica).  One point event parented to the request span plus the
+        ``serve.ttft.<scenario>`` observation at _finish."""
+        req = sess.request
+        sess.ttft_seconds = round(self._clock() - req.submitted_at, 6)
+        sess.span.event(
+            reqtrace.FIRST_TOKEN_POINT, request=req.id,
+            attempt=req.attempt, ttft_seconds=sess.ttft_seconds,
+            **({"trace": req.trace_id} if req.trace_id else {}))
 
     def _fire_spec_verify(self, sess: _Session) -> None:
         """The ``serve.spec.verify`` fault site, with ONE in-place retry:
@@ -463,7 +523,9 @@ class SlotScheduler:
             drafted=sess.drafted, accepted=sess.accepted,
             exited_early=sess.early,
             early_agreement=(round(sess.early_agree / sess.early, 4)
-                             if sess.early else None))
+                             if sess.early else None),
+            trace_id=req.trace_id, attempt=req.attempt,
+            ttft_seconds=sess.ttft_seconds)
         if ok:
             self.completed += 1
             self._scenarios_completed.add(req.scenario.name)
@@ -474,6 +536,14 @@ class SlotScheduler:
             obs_metrics.histogram(
                 f"serve.latency.{req.scenario.name}").observe(
                 resp.latency_seconds)
+            reqtrace.note_exemplar(f"serve.latency.{req.scenario.name}",
+                                   req.trace_id, resp.latency_seconds)
+            if sess.ttft_seconds is not None:
+                obs_metrics.histogram(
+                    f"serve.ttft.{req.scenario.name}").observe(
+                    sess.ttft_seconds)
+                reqtrace.note_exemplar(f"serve.ttft.{req.scenario.name}",
+                                       req.trace_id, sess.ttft_seconds)
             if self._speculative:
                 agg = self._accept.setdefault(req.scenario.name, {
                     "responses": 0, "emitted": 0, "steps": 0,
@@ -507,6 +577,28 @@ class SlotScheduler:
                   **spec_attrs,
                   **({"word": req.word} if req.word else {}),
                   **({"error": resp.error} if resp.error else {}))
+        # Terminal close of the request-lifecycle span: exactly one
+        # terminal=True end per served attempt (check_request_traces) —
+        # quarantines close with status="error" and stay terminal (the
+        # error response IS the answer).
+        end_attrs: Dict[str, Any] = {
+            **spec_attrs,
+            "terminal": True, "finish": finish, "steps": sess.steps,
+            "emitted": len(sess.tokens),
+            "latency_seconds": resp.latency_seconds}
+        if sess.ttft_seconds is not None:
+            end_attrs["ttft_seconds"] = sess.ttft_seconds
+        sess.span.set(**end_attrs)
+        sess.span.end(error=exc)
+        # Flush BEFORE the response commit: a replica killed at the commit
+        # fault site must leave this terminal end on disk, or the answered
+        # request would read as unresolved after the fleet merge.
+        tracer = obs_trace.get_tracer()
+        if tracer is not None:
+            try:
+                tracer.flush()
+            except Exception:  # noqa: BLE001 — tracing is fail-open
+                pass
         if self.on_complete is not None:
             self.on_complete(resp)
         return resp
@@ -564,6 +656,20 @@ class SlotScheduler:
                                "p99_s": _r(h.quantile(0.99)),
                                "max_s": _r(h.max), "n": h.count},
             }
+            # Time-to-first-token rides next to end-to-end latency (the
+            # TTFT SLO's per-scenario view; absent for sessions that
+            # emitted no token).
+            ht = obs_metrics.histogram(f"serve.ttft.{name}")
+            if ht.count:
+                twin = ht.windowed()
+                scenarios[name]["ttft"] = {
+                    "window": {"p50_s": _r(twin["p50"]),
+                               "p99_s": _r(twin["p99"]),
+                               "max_s": _r(twin["max"]), "n": twin["n"]},
+                    "cumulative": {"p50_s": _r(ht.quantile(0.5)),
+                                   "p99_s": _r(ht.quantile(0.99)),
+                                   "max_s": _r(ht.max), "n": ht.count},
+                }
         return {"window_s": timeseries.window_seconds(),
                 "scenarios": scenarios}
 

@@ -77,7 +77,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from taboo_brittleness_tpu import obs
-from taboo_brittleness_tpu.obs import flightrec
+from taboo_brittleness_tpu.obs import flightrec, reqtrace
 from taboo_brittleness_tpu.obs.progress import (
     PROGRESS_FILENAME, ProgressReporter)
 from taboo_brittleness_tpu.obs.trace import EVENTS_FILENAME
@@ -138,9 +138,11 @@ class RequestSpool:
     # -- client side --------------------------------------------------------
 
     def put(self, payload: Dict[str, Any]) -> str:
-        """Submit one request (loadgen / external client).  Returns the id."""
+        """Submit one request (loadgen / external client).  Returns the id.
+        Mints the distributed trace context (obs.reqtrace) unless the
+        client already carries one — submit is the trace's birthplace."""
         rid = str(payload.get("id") or uuid.uuid4().hex[:12])
-        payload = {**payload, "id": rid}
+        payload, _ctx, _minted = reqtrace.ensure({**payload, "id": rid})
         atomic_json_dump(payload,
                          os.path.join(self.requests_dir, f"{rid}.json"))
         return rid
@@ -560,7 +562,8 @@ def _to_request(payload: Dict[str, Any],
     return Request(id=str(payload.get("id") or uuid.uuid4().hex[:12]),
                    prompt=str(payload.get("prompt", "")),
                    scenario=sc, seed=int(payload.get("seed", 0) or 0),
-                   word=str(word) if word is not None else None)
+                   word=str(word) if word is not None else None,
+                   trace=reqtrace.parse(payload))
 
 
 def serve_forever(
@@ -665,7 +668,8 @@ def serve_forever(
             keeper.remove(resp.id, attempt)
         spool.release_claimed(resp.id, attempt, holder)
         obs.event("serve.respond", request=resp.id, attempt=attempt,
-                  duplicate=not won)
+                  duplicate=not won,
+                  **({"trace": resp.trace_id} if resp.trace_id else {}))
 
     sched = SlotScheduler(engine, queue_limit=queue_limit,
                           lens_target_id=lens_target_id,
@@ -696,10 +700,26 @@ def serve_forever(
         block["verdict"] = tuned.verdict if tuned is not None else "off"
         return block
 
+    warned_pretrace = [False]
+
     def _take(payload: Dict[str, Any]) -> None:
         """Claimed requests ALWAYS get a response: parse+submit, and answer
         a rejection (unknown scenario, over-capacity prompt/budget) with an
-        explicit rejected response instead of dropping it silently."""
+        explicit rejected response instead of dropping it silently.
+
+        Old-format payloads (a mid-upgrade spool, pre-trace fixtures) get a
+        ``synthetic: true`` trace context minted HERE at claim, with a
+        one-shot warn — they serve exactly as before, just traceable from
+        this hop on."""
+        payload, ctx, minted = reqtrace.ensure(payload, synthetic=True)
+        if minted and not warned_pretrace[0]:
+            warned_pretrace[0] = True
+            obs.warn(
+                "[serve] request without a trace context (pre-trace "
+                "client/spool?) — minted a synthetic one at claim; "
+                "responses stay traceable from this hop on",
+                name="serve.pretrace_request",
+                request=str(payload.get("id")))
         req = _to_request(payload, scenarios)
         if req is None:
             _respond(Response(
@@ -707,7 +727,9 @@ def serve_forever(
                 scenario=str(payload.get("scenario")),
                 finish="rejected", replica=wid,
                 reject_reason=REJECT_UNKNOWN_SCENARIO,
-                error="unknown scenario"))
+                error="unknown scenario",
+                trace_id=ctx.get("trace_id"),
+                attempt=int(ctx.get("attempt", 0))))
             return
         if not sched.submit(req):
             reason = sched.last_reject_reason
@@ -715,7 +737,8 @@ def serve_forever(
                 id=req.id, ok=False, scenario=req.scenario.name,
                 finish="rejected", replica=wid, reject_reason=reason,
                 error="admission rejected "
-                      f"({reason or 'capacity envelope or draining'})"))
+                      f"({reason or 'capacity envelope or draining'})",
+                trace_id=req.trace_id, attempt=req.attempt))
 
     def _claim_into_scheduler() -> None:
         limit = queue_limit - sched.queue_depth
@@ -734,7 +757,16 @@ def serve_forever(
             attempt = int(rec.get("attempt", 0))
             held[rid] = attempt
             keeper.add(rid, attempt)
-            _take(dict(rec.get("request") or {}))
+            payload = dict(rec.get("request") or {})
+            ctx = reqtrace.parse(payload)
+            if ctx is not None and int(ctx.get("attempt", 0)) != attempt:
+                # Keep the context honest against the wrapper (the re-spool
+                # writer bumps both; a hand-rolled assign might not).
+                payload[reqtrace.CTX_KEY] = ctx = reqtrace.for_attempt(
+                    ctx, attempt)
+            obs.event("serve.claim", request=rid, attempt=attempt,
+                      **({"trace": ctx.get("trace_id")} if ctx else {}))
+            _take(payload)
 
     # Resume: a predecessor's claimed-but-unanswered requests come first.
     # Fleet replicas skip this — their recovery route is lease expiry.

@@ -1,0 +1,519 @@
+"""End-to-end request tracing (ISSUE 19): context propagation, lifecycle
+spans, the waterfall assembler, TTFT SLOs, and burn→trace exemplars.
+
+The centerpiece is a chaos e2e: a replica killed mid-decode (``serve.step``
+die) leaves its request's first attempt as a dangling span the fleet merge
+closes with a synthesized error end; the re-spooled request completes on a
+surviving replica as a SECOND attempt under the SAME trace_id, with TTFT
+re-timed on the surviving attempt, and ``check_request_traces`` holds on
+the merged stream.
+
+Around it: context mint/parse/ensure/for_attempt units, the exemplar
+registry (worst-K per series, drain vs peek), SLO cells carrying exemplar
+trace ids into the ``tbx top`` burn table and flightrec dumps, the
+``check_request_traces`` invariants over hand-built streams, an in-process
+serve burst proving spans/TTFT/exemplars land end to end, the legacy
+pre-trace-payload path (synthetic mint at claim + one-shot warn), and the
+``serve_latency.ttft_p99`` bench_compare gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.obs import reqtrace, slo, top
+from taboo_brittleness_tpu.obs import trace as trace_mod
+from taboo_brittleness_tpu.runtime.resilience import atomic_json_dump
+from taboo_brittleness_tpu.serve.server import RequestSpool
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_exemplars():
+    reqtrace.reset_exemplars()
+    yield
+    reqtrace.reset_exemplars()
+
+
+# ---------------------------------------------------------------------------
+# Context mint / parse / propagation units.
+# ---------------------------------------------------------------------------
+
+
+def test_mint_parse_roundtrip():
+    ctx = reqtrace.mint()
+    assert ctx["v"] == reqtrace.CTX_VERSION
+    assert len(ctx["trace_id"]) == 16 and ctx["attempt"] == 0
+    parsed = reqtrace.parse({reqtrace.CTX_KEY: ctx})
+    assert parsed is not None and parsed["trace_id"] == ctx["trace_id"]
+    assert "synthetic" not in parsed
+
+
+def test_parse_rejects_newer_version_and_garbage():
+    newer = {**reqtrace.mint(), "v": reqtrace.CTX_VERSION + 1}
+    assert reqtrace.parse({reqtrace.CTX_KEY: newer}) is None
+    assert reqtrace.parse({reqtrace.CTX_KEY: "not-a-dict"}) is None
+    assert reqtrace.parse({reqtrace.CTX_KEY: {"v": 1}}) is None  # no id
+    assert reqtrace.parse({"id": "r0"}) is None
+    assert reqtrace.parse(None) is None
+
+
+def test_ensure_is_idempotent_and_marks_synthetic_mints():
+    payload, ctx, minted = reqtrace.ensure({"id": "r0"}, synthetic=True)
+    assert minted and ctx["synthetic"] is True
+    assert payload[reqtrace.CTX_KEY]["trace_id"] == ctx["trace_id"]
+    again, ctx2, minted2 = reqtrace.ensure(payload)
+    assert not minted2 and ctx2["trace_id"] == ctx["trace_id"]
+    assert again is payload
+
+
+def test_for_attempt_keeps_trace_and_records_dead_holders():
+    ctx = reqtrace.mint()
+    child = reqtrace.for_attempt(ctx, 1, dead_holder="w1-i0")
+    assert child["trace_id"] == ctx["trace_id"]
+    assert child["attempt"] == 1 and child["dead"] == ["w1-i0"]
+    grand = reqtrace.for_attempt(child, 2, dead_holder="w0-i1")
+    assert grand["trace_id"] == ctx["trace_id"]
+    assert grand["dead"] == ["w0-i1", "w1-i0"]
+
+
+# ---------------------------------------------------------------------------
+# Exemplar registry.
+# ---------------------------------------------------------------------------
+
+
+def test_exemplars_keep_worst_k_and_drain(monkeypatch):
+    monkeypatch.setenv("TBX_TRACE_EXEMPLARS", "2")
+    for tid, v in (("aa", 0.1), ("bb", 0.9), ("cc", 0.5)):
+        reqtrace.note_exemplar("serve.latency.chat", tid, v)
+    assert reqtrace.take_exemplars("serve.latency.chat") == ["bb", "cc"]
+    # Drained: the current window is empty, but peek still serves the last
+    # drained window (flightrec dumps fire between windows).
+    assert reqtrace.take_exemplars("serve.latency.chat") == []
+    assert reqtrace.peek_exemplars() == {"serve.latency.chat": ["bb", "cc"]}
+
+
+def test_exemplars_disabled_at_zero_cap(monkeypatch):
+    monkeypatch.setenv("TBX_TRACE_EXEMPLARS", "0")
+    reqtrace.note_exemplar("serve.latency.chat", "aa", 1.0)
+    assert reqtrace.peek_exemplars() == {}
+
+
+def test_slo_engine_attaches_exemplars_to_histogram_cells():
+    reqtrace.note_exemplar("serve.ttft.chat", "deadbeefcafef00d", 9.0)
+    engine = slo.SloEngine(emit_alerts=False)
+    block = engine.observe_window(
+        dur=1.0, hists={"serve.ttft.chat": {"samples": [9.0]}},
+        counter_deltas={}, gauges={})
+    cell = block["serve_ttft.chat"]
+    assert cell["exemplars"] == ["deadbeefcafef00d"]
+    assert not cell["ok"], "9s TTFT must burn the default 1s objective"
+
+
+def test_top_burn_table_renders_exemplar_trace_ids():
+    lines = top._slo_lines({"slo": {"serve_ttft.chat": {
+        "burn": 5.0, "fast": 5.0, "slow": 5.0, "ok": False,
+        "exemplars": ["deadbeefcafef00d"]}}})
+    assert any("deadbeefcafef00d" in ln for ln in lines)
+
+
+def test_flightrec_dump_carries_exemplars(tmp_path):
+    from taboo_brittleness_tpu.obs import flightrec
+
+    reqtrace.note_exemplar("serve.latency.chat", "feedfacefeedface", 2.0)
+    rec = flightrec.FlightRecorder(capacity=8)
+    rec.configure(str(tmp_path))
+    rec.record("test.tick")
+    path = rec.dump("test")
+    assert path is not None
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["exemplars"]["serve.latency.chat"] == ["feedfacefeedface"]
+
+
+# ---------------------------------------------------------------------------
+# check_request_traces over hand-built streams.
+# ---------------------------------------------------------------------------
+
+
+def _span(i, req, *, trace="t0", attempt=0, worker=None, parent=None,
+          t=0.0):
+    ev = {"v": 1, "seq": i, "t": t, "ev": "start", "kind": "request",
+          "name": "serve.request", "id": i,
+          "attrs": {"request": req, "trace": trace, "attempt": attempt}}
+    if worker is not None:
+        ev["worker"] = worker
+    if parent is not None:
+        ev["parent"] = parent
+    return ev
+
+
+def _end(i, seq, *, status="ok", terminal=True, emitted=2, ttft=0.01,
+         synthesized=False, worker=None, t=1.0):
+    attrs = {}
+    if terminal:
+        attrs.update({"terminal": True, "emitted": emitted})
+        if ttft is not None:
+            attrs["ttft_seconds"] = ttft
+    if synthesized:
+        attrs["synthesized"] = True
+    ev = {"v": 1, "seq": seq, "t": t, "ev": "end", "kind": "request",
+          "name": "serve.request", "id": i, "dur": t, "status": status,
+          "attrs": attrs}
+    if worker is not None:
+        ev["worker"] = worker
+    return ev
+
+
+def test_check_request_traces_clean_single_attempt():
+    events = [_span(1, "r0"), _end(1, 2)]
+    assert trace_report.check_request_traces("x", events) == []
+
+
+def test_check_request_traces_noop_on_plain_streams():
+    events = [{"v": 1, "seq": 1, "t": 0.0, "ev": "start", "kind": "run",
+               "name": "sweep", "id": 1}]
+    assert trace_report.check_request_traces("x", events) == []
+
+
+def test_check_request_traces_flags_unresolved_request():
+    events = [_span(1, "r0"),
+              _end(1, 2, status="error", terminal=False)]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("never resolved" in e for e in errs)
+
+
+def test_check_request_traces_flags_trace_disagreement():
+    events = [_span(1, "r0", trace="t0"), _end(1, 3, terminal=False,
+                                               status="error"),
+              _span(2, "r0", trace="OTHER", attempt=1), _end(2, 4)]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("disagree on trace id" in e for e in errs)
+
+
+def test_check_request_traces_respool_chain_is_clean():
+    # Attempt 0 killed mid-decode (synthesized close), attempt 1 terminal.
+    events = [_span(1, "r0", worker="w1"),
+              _end(1, 2, status="error", terminal=False, synthesized=True,
+                   worker="w1"),
+              _span(3, "r0", attempt=1, worker="w0"),
+              _end(3, 4, worker="w0")]
+    assert trace_report.check_request_traces("x", events) == []
+
+
+def test_check_request_traces_flags_unexplained_double_terminal():
+    events = [_span(1, "r0", worker="w0"), _end(1, 2, worker="w0"),
+              _span(3, "r0", attempt=1, worker="w2"), _end(3, 4,
+                                                           worker="w2")]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("resolves exactly once" in e for e in errs)
+
+
+def test_check_request_traces_allows_killed_incarnation_orphan():
+    # w1 finished decode (terminal flushed) then died before its commit:
+    # the extra terminal is explained by w1's synthesized ends elsewhere.
+    events = [_span(1, "r0", worker="w1"), _end(1, 2, worker="w1"),
+              # another span of the killed incarnation, merge-closed
+              _span(3, "r1", worker="w1"),
+              _end(3, 4, status="error", terminal=False, synthesized=True,
+                   worker="w1"),
+              _span(5, "r1", attempt=1, worker="w0"), _end(5, 6,
+                                                           worker="w0"),
+              _span(7, "r0", attempt=1, worker="w0"), _end(7, 8,
+                                                           worker="w0")]
+    assert trace_report.check_request_traces("x", events) == []
+
+
+def test_check_request_traces_allows_duplicate_dispatch():
+    events = [_span(1, "r0", worker="w0"), _end(1, 2, worker="w0"),
+              _span(3, "r0", attempt=1, worker="w2"),
+              _end(3, 4, worker="w2"),
+              {"v": 1, "seq": 5, "t": 2.0, "ev": "point", "kind": "point",
+               "name": "serve.respond",
+               "attrs": {"request": "r0", "duplicate": True}}]
+    assert trace_report.check_request_traces("x", events) == []
+
+
+def test_check_request_traces_flags_missing_ttft():
+    events = [_span(1, "r0"), _end(1, 2, ttft=None)]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("no ttft_seconds" in e for e in errs)
+
+
+def test_check_request_traces_flags_floating_first_token():
+    events = [_span(1, "r0"), _end(1, 2),
+              {"v": 1, "seq": 3, "t": 0.5, "ev": "point", "kind": "point",
+               "name": "serve.first_token", "parent": 999,
+               "attrs": {"request": "r0", "ttft_seconds": 0.01}}]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("floating TTFT" in e for e in errs)
+
+
+def test_check_request_traces_flags_synthesized_terminal():
+    events = [_span(1, "r0", worker="w1"),
+              _end(1, 2, status="error", synthesized=True, worker="w1")]
+    errs = trace_report.check_request_traces("x", events)
+    assert any("merge-synthesized" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# In-process serve burst: spans, TTFT, exemplars land end to end.
+# ---------------------------------------------------------------------------
+
+
+def test_inprocess_serve_traces_end_to_end(tmp_path):
+    from taboo_brittleness_tpu import obs
+    from taboo_brittleness_tpu.serve import loadgen
+
+    engine, scen, tgt = loadgen.build_synthetic_engine(max_new_tokens=4)
+    out = str(tmp_path / "serve")
+    responses = []
+    with obs.sweep_observer(out, pipeline="serve-test"):
+        report = loadgen.run_inprocess(
+            engine, n_requests=6, seed=3, rate=500.0, concurrency=6,
+            scenarios=scen, lens_target_id=tgt,
+            on_complete=responses.append)
+
+    ok = [r for r in responses if r.ok]
+    assert ok, "burst produced no completions"
+    assert all(r.trace_id for r in responses), "responses must be stamped"
+    for r in ok:
+        assert r.ttft_seconds is not None
+        assert 0 < r.ttft_seconds <= r.latency_seconds + 1e-9
+
+    # The report grew TTFT histogram blocks next to latency.
+    assert report["overall_ttft"]["count"] == len(ok)
+    for block in report["scenarios"].values():
+        assert block["ttft"]["count"] > 0
+
+    events_path = os.path.join(out, "_events.jsonl")
+    events = list(trace_mod.iter_events(events_path))
+    assert trace_report.check_request_traces(events_path, events) == []
+
+    # Every completion's trace_id resolves through the assembler, with the
+    # TTFT riding the terminal attempt.
+    traces = reqtrace.assemble([events_path])
+    for r in ok:
+        tr = traces[r.trace_id]
+        term = tr.terminal_attempt
+        assert term is not None and term.status == "ok"
+        assert term.attrs.get("ttft_seconds") == pytest.approx(
+            r.ttft_seconds)
+        assert reqtrace.render(tr)
+
+    # Completions registered burn→trace exemplars for both series families.
+    ex = reqtrace.peek_exemplars()
+    assert any(k.startswith("serve.latency.") for k in ex)
+    assert any(k.startswith("serve.ttft.") for k in ex)
+
+
+def test_scheduler_latency_percentiles_carry_ttft():
+    from taboo_brittleness_tpu.obs import metrics as obs_metrics
+    from taboo_brittleness_tpu.serve import loadgen
+    from taboo_brittleness_tpu.serve.scheduler import SlotScheduler
+
+    obs_metrics.reset()  # percentiles read the process-global histograms
+    engine, scen, tgt = loadgen.build_synthetic_engine(max_new_tokens=4)
+    sched = SlotScheduler(engine, lens_target_id=tgt)
+    engine.warm_start()
+    plan = loadgen.build_schedule(
+        4, seed=0, rate=0.0, mix={"chat": 1.0},
+        scenarios=scen, prompts=("Give me a hint",))
+    for _, req in plan:
+        assert sched.submit(req)
+    while sched.in_flight or sched.queue_depth:
+        sched.step()
+    pct = sched.latency_percentiles()
+    chat = pct["scenarios"]["chat"]
+    assert chat["ttft"]["cumulative"]["n"] == 4
+    assert 0 < chat["ttft"]["cumulative"]["p99_s"] <= (
+        chat["cumulative"]["p99_s"] + 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Legacy pre-trace payloads (satellite: mid-upgrade spools keep serving).
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_pretrace_requests_still_serve(tmp_path):
+    out = str(tmp_path / "spool")
+    n = 3
+    spool = RequestSpool(out)
+    # Old-format request files: no trace context, written straight into the
+    # intake (bypassing RequestSpool.put, which would mint one).
+    for i in range(n):
+        atomic_json_dump(
+            {"id": f"old{i:02d}", "prompt": "Give me a hint",
+             "scenario": "chat", "seed": i},
+            os.path.join(spool.requests_dir, f"old{i:02d}.json"))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TABOO_FAULT_PLAN", None)
+    env.pop("TBX_WORKER_ID", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+         "--synthetic", "--output-dir", out, "--slots", "4",
+         "--poll", "0.02", "--max-new-tokens", "4",
+         "--max-requests", str(n)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    for i in range(n):
+        resp = spool.get_response(f"old{i:02d}")
+        assert resp is not None and resp["ok"], resp
+        # Context minted at claim: the response is traceable from that hop.
+        assert resp["trace_id"] and resp["attempt"] == 0
+        assert resp["ttft_seconds"] is not None
+
+    # The mint warned ONCE, not per request.
+    warns = [ev for ev in trace_mod.iter_events(
+        os.path.join(out, "_events.jsonl"))
+        if ev.get("name") == "serve.pretrace_request"]
+    assert len(warns) == 1, f"expected one-shot warn, got {len(warns)}"
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance e2e: one trace across replica death.
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_respool_keeps_one_trace_across_death(tmp_path, monkeypatch):
+    """Replica w1 dies mid-decode (``serve.step`` die): its in-flight
+    request's first attempt is closed by the fleet merge with a synthesized
+    error end, the re-spooled request completes elsewhere as attempt 1
+    under the SAME trace_id with TTFT re-timed on the surviving attempt,
+    and ``check_request_traces`` holds on the merged stream."""
+    from taboo_brittleness_tpu.runtime import resilience, supervise
+    from taboo_brittleness_tpu.serve.replica import chaos_smoke
+
+    supervise.reset_drain()
+    resilience.set_injector(resilience.FaultInjector())
+    for key in ("TABOO_FAULT_PLAN", "TBX_INCARNATION", "TBX_WORKER_ID"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.setenv("TBX_OBS_PROGRESS_S", "0.2")
+    monkeypatch.setenv("TBX_SUPERVISE_BACKOFF_S", "0")
+
+    out = str(tmp_path / "fleet")
+    plan = {"serve.step": [
+        {"mode": "die", "times": 1, "match": "w1", "incarnation": 0}]}
+    res = chaos_smoke(out, n_requests=9, fault_plan=plan)
+    assert res.status == "done" and res.exit_code == 0, res.to_dict()
+    assert res.respooled >= 1, "the die fault never forced a re-spool"
+
+    events_path = os.path.join(out, "_events.jsonl")
+    events = list(trace_mod.iter_events(events_path))
+    assert trace_report.check_request_traces(events_path, events) == []
+
+    traces = reqtrace.assemble([events_path])
+    chains = [t for t in traces.values() if len(t.attempts) > 1]
+    assert chains, "no multi-attempt trace despite a re-spool"
+    for tr in chains:
+        # ONE trace: every attempt span of the request carries this id.
+        for ev in events:
+            attrs = ev.get("attrs") or {}
+            if (ev.get("ev") == "start" and ev.get("kind") == "request"
+                    and attrs.get("request") == tr.request):
+                assert attrs.get("trace") == tr.trace_id
+        # Exactly one attempt carries the ok terminal, and the response
+        # file resolves the same trace at that attempt.
+        terminals = [a for a in tr.attempts if a.terminal]
+        winners = [a for a in terminals if a.status == "ok"]
+        assert len(winners) == 1, tr.request
+        spool = RequestSpool(out, fleet=True)
+        resp = spool.get_response(tr.request)
+        assert resp is not None and resp["trace_id"] == tr.trace_id
+        assert resp["attempt"] == winners[0].number
+
+    # At least one chain crossed the DEATH: under full-suite load a lease
+    # can also expire on a merely-slow holder (duplicate-respond path), so
+    # only chains whose early attempt was merge-synthesized must show the
+    # acceptance shape — and the serve.step die guarantees one exists.
+    death_chains = [t for t in chains
+                    if any(a.synthesized for a in t.attempts)]
+    assert death_chains, "no chain crossed the replica death"
+    for tr in death_chains:
+        attempts = sorted(tr.attempts, key=lambda a: a.number)
+        dead = next(a for a in attempts if a.synthesized)
+        survivor = attempts[-1]
+        # Died attempt: closed by the merge, never terminal.
+        assert dead.status == "error" and not dead.terminal
+        # Surviving attempt: terminal, and TTFT timed on THIS attempt.
+        assert survivor.terminal and survivor.status == "ok"
+        assert survivor.number > dead.number
+        if float(survivor.attrs.get("emitted", 0) or 0) > 0:
+            assert survivor.attrs.get("ttft_seconds") is not None
+        # And the waterfall renders the death + recovery.
+        text = reqtrace.render(tr)
+        assert "DIED" in text and "attempt" in text
+
+    # The full drift gate stays green on the merged stream.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--check", events_path],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Fixture + CLI gates.
+# ---------------------------------------------------------------------------
+
+
+def test_committed_serve_fleet_fixture_passes_trace_selfcheck():
+    fixture = os.path.join(REPO, "tests", "fixtures", "obs", "serve_fleet")
+    assert os.path.isdir(fixture), "serve_fleet fixture missing"
+    assert reqtrace.selfcheck(fixture) == 0
+
+
+def test_trace_cli_resolves_fixture_request(capsys):
+    fixture = os.path.join(REPO, "tests", "fixtures", "obs", "serve_fleet")
+    traces = reqtrace.assemble(reqtrace.find_event_files(fixture))
+    tid = next(t.trace_id for t in traces.values()
+               if t.terminal_attempt is not None
+               and not t.trace_id.startswith("("))
+    assert reqtrace.main([fixture, "--trace", tid]) == 0
+    assert tid in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the serve_latency.ttft_p99 regression gate.
+# ---------------------------------------------------------------------------
+
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_ttft_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"serve_latency": {"ttft_p99": 0.10}})
+    _write_round(tmp_path, 2, {"serve_latency": {"ttft_p99": 0.13}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_ttft_flags_regression(tmp_path):
+    _write_round(tmp_path, 1, {"serve_latency": {"ttft_p99": 0.10}})
+    _write_round(tmp_path, 2, {"serve_latency": {"ttft_p99": 0.30}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("serve_latency.ttft_p99" in r for r in regressions)
+
+
+def test_bench_compare_round_without_ttft_skips_with_note(tmp_path):
+    _write_round(tmp_path, 1, {"serve_latency": {"p99_s": 0.5}})
+    _write_round(tmp_path, 2, {"serve_latency": {"p99_s": 0.5,
+                                                 "ttft_p99": 0.1}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions

@@ -47,6 +47,10 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     "measured_study_seconds_per_word": (0.25, False, 0.0),
     "projected_full_sweep_hours": (0.25, False, 0.0),
     "serve_latency.p99_s": (0.50, False, 0.0),
+    # TTFT p99 (submit -> first emitted token, ISSUE 19): the interactivity
+    # half of the serving SLO — a prefill/admission regression moves it
+    # before end-to-end p99 does.
+    "serve_latency.ttft_p99": (0.50, False, 0.0),
     "serve_latency.completed_per_second": (0.25, True, 0.0),
     # Fused-loop rollout metrics (bench.py sweep.fused_ab, ISSUE 8):
     # fused-over-legacy launch speedup must not slide back, and the fused

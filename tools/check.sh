@@ -68,6 +68,10 @@ gate "trace_report device-join (device fixture)" \
 gate "trace_report fleet (multi-worker fixture)" \
   python tools/trace_report.py --check tests/fixtures/obs/fleet/_events.jsonl
 
+gate "trace_report serve-fleet (request traces)" \
+  python tools/trace_report.py --check \
+  tests/fixtures/obs/serve_fleet/_events.jsonl
+
 if [ "$FAST" -eq 0 ]; then
   gate "report sync (exec-summary bench table)" \
     python tools/report_bench_row.py --check \
@@ -78,6 +82,11 @@ if [ "$FAST" -eq 0 ]; then
 
   gate "tbx top selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu top --once --selfcheck
+
+  # Request-trace assembler over the committed serve_fleet fixture: the
+  # slowest-5 waterfalls must render with coherent attempt chains + TTFT.
+  gate "tbx trace selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu trace --selfcheck
 
   gate "serve loadgen selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck

@@ -98,6 +98,17 @@ def _make_serve_fleet_fixture() -> int:
         print("make_fleet_fixture: regenerated serve_fleet fixture FAILS "
               "trace_report --check", file=sys.stderr)
         return rc
+
+    # The request-trace assembler gate (tbx trace --selfcheck) must hold on
+    # the regenerated fixture too: waterfalls render, attempt chains are
+    # coherent, TTFT parses.
+    from taboo_brittleness_tpu.obs import reqtrace
+
+    rc = reqtrace.selfcheck(SERVE_FLEET_FIXTURE_DIR)
+    if rc != 0:
+        print("make_fleet_fixture: regenerated serve_fleet fixture FAILS "
+              "tbx trace --selfcheck", file=sys.stderr)
+        return rc
     shutil.rmtree(out, ignore_errors=True)
     return 0
 

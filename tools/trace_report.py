@@ -876,6 +876,118 @@ def check_serve_fleet(path: str, events: List[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def check_request_traces(path: str,
+                         events: List[Dict[str, Any]]) -> List[str]:
+    """Per-request lifecycle-span invariants for ``--check`` (empty =
+    clean; no-op on streams without ``request``-kind spans).  Gated over
+    serve streams (``serve/scheduler.py`` opens one ``serve.request`` span
+    per admitted request; ``obs/reqtrace.py`` carries the context):
+
+    - exactly one TERMINAL end (``attrs.terminal``) per request — zero is
+      allowed only when the run drained/stalled; more than one only when
+      each extra is explained by a ``duplicate=true`` ``serve.respond``
+      point (raced commits) or by a killed incarnation (a worker that died
+      between finishing decode and committing its response leaves an
+      orphaned terminal; the fleet merge confesses the kill via
+      synthesized ends on that worker's stream);
+    - every attempt span of a request agrees on the ``trace`` id — a
+      re-spooled retry is a new attempt under the SAME trace, never a new
+      trace;
+    - a span closed by the fleet merge (``attrs.synthesized``) is a dead
+      attempt: when the run completed, a later attempt must carry the
+      terminal for that request;
+    - an ok terminal that emitted tokens must carry ``ttft_seconds``, and
+      every ``serve.first_token`` point must parent into a request span of
+      the same request (the TTFT event is causally attached, not floating).
+    """
+    errors: List[str] = []
+    spans, points = build_spans(events)
+    by_req: Dict[str, List[Span]] = {}
+    for s in spans.values():
+        if s.kind == "request":
+            by_req.setdefault(str(s.attrs.get("request")), []).append(s)
+    if not by_req:
+        return errors
+
+    # Worker stamp per span (merged streams) + the set of killed
+    # incarnations (any worker stream the merge had to close spans for).
+    span_worker: Dict[Any, Any] = {}
+    killed_workers = set()
+    for ev in events:
+        if ev.get("ev") == "start" and ev.get("worker") is not None:
+            span_worker[ev.get("id")] = ev.get("worker")
+        elif (ev.get("ev") == "end"
+              and (ev.get("attrs") or {}).get("synthesized")):
+            killed_workers.add(ev.get("worker"))
+
+    drained = any(
+        s.attrs.get("drained") for s in spans.values() if s.kind == "run")
+    exit_status = "done"
+    dup_responds: Dict[str, int] = {}
+    first_tokens: List[Dict[str, Any]] = []
+    for p in points:
+        name = str(p.get("name", ""))
+        attrs = p.get("attrs") or {}
+        if name == "serve_fleet.exit":
+            exit_status = str(attrs.get("status", "done"))
+        elif name == "serve.respond" and attrs.get("duplicate", False):
+            req = str(attrs.get("request"))
+            dup_responds[req] = dup_responds.get(req, 0) + 1
+        elif name == "serve.first_token":
+            first_tokens.append(p)
+    incomplete_ok = drained or exit_status in ("drained", "stalled")
+
+    for req, group in sorted(by_req.items()):
+        traces = {str(s.attrs["trace"]) for s in group
+                  if s.attrs.get("trace")}
+        if len(traces) > 1:
+            errors.append(
+                f"{path}: request {req} attempts disagree on trace id "
+                f"({sorted(traces)}) — re-spool must keep the trace")
+        terminals = [s for s in group if s.attrs.get("terminal")]
+        if not terminals and not incomplete_ok:
+            errors.append(
+                f"{path}: request {req} has {len(group)} attempt span(s) "
+                "but no terminal end — it never resolved")
+        if len(terminals) > 1:
+            orphaned = sum(
+                1 for s in terminals
+                if span_worker.get(s.id) in killed_workers)
+            if len(terminals) - 1 > dup_responds.get(req, 0) + orphaned:
+                errors.append(
+                    f"{path}: request {req} carries {len(terminals)} "
+                    "terminal ends not explained by duplicate responds or "
+                    "killed incarnations — a request resolves exactly once")
+        for s in terminals:
+            if s.attrs.get("synthesized"):
+                errors.append(
+                    f"{path}: request {req} span {s.id} is both terminal "
+                    "and merge-synthesized — a dead attempt cannot be the "
+                    "resolution")
+            if (s.status == "ok" and float(s.attrs.get("emitted", 0) or 0) > 0
+                    and s.attrs.get("ttft_seconds") is None):
+                errors.append(
+                    f"{path}: request {req} completed ok with "
+                    f"{s.attrs.get('emitted')} token(s) but no "
+                    "ttft_seconds on the terminal span")
+
+    for p in first_tokens:
+        attrs = p.get("attrs") or {}
+        req = str(attrs.get("request"))
+        parent = spans.get(p.get("parent"))
+        if parent is None or parent.kind != "request":
+            errors.append(
+                f"{path}: serve.first_token for request {req} does not "
+                "parent into a request span (floating TTFT event)")
+        elif str(parent.attrs.get("request")) != req:
+            errors.append(
+                f"{path}: serve.first_token for request {req} parented "
+                f"into span {parent.id} of request "
+                f"{parent.attrs.get('request')} — TTFT attached to the "
+                "wrong attempt")
+    return errors
+
+
 def check_timeseries(events_path: str) -> List[str]:
     """Windowed-metrics-spool invariants for ``--check`` (empty = clean;
     no-op when no ``_metrics*.jsonl`` sits next to the events file).  Every
@@ -1373,6 +1485,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # responses, lease expiry -> re-spool chains, routed -> resolved.
         errors += check_serve_fleet(args.events,
                                     list(iter_events(args.events)))
+        # Per-request lifecycle-trace invariants (serve/scheduler.py +
+        # obs/reqtrace.py): one terminal per request, one trace per
+        # attempt chain, TTFT causally attached.
+        errors += check_request_traces(args.events,
+                                       list(iter_events(args.events)))
         # Windowed-metrics + flight-recorder invariants (obs.timeseries /
         # obs.flightrec): no-ops when no sibling artifacts exist.
         errors += check_timeseries(args.events)
