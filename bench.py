@@ -55,6 +55,12 @@ account (BASELINE.json north_star: "< 1 h on v5e-8") in two blocks:
   spec_speedup, and the per-round re-proof that the lossless scenarios'
   token streams are exact (adaptive_depth is excluded from the exactness
   bit by contract — it trades exactness for depth-k early exit).
+- "gateway_latency" (BENCH_GATEWAY=1, CPU-smoke default-on): the network
+  front door's cost (ISSUE 20) — one serve + one gateway subprocess over a
+  shared spool, the SAME seeded loadgen schedule driven over HTTP+SSE
+  (run_socket: connect/TTFB/network-TTFT/stream-complete) and spool-direct
+  (run_spool); stream-complete p50/p99, network TTFT p99, the TTFT delta
+  the gateway hop adds, and the typed-429 shed rate.
 - "serve_tp_ab" (BENCH_SERVE_TP_AB, default-on): the TENSOR-PARALLEL
   serving A/B (ISSUE 18) — the same seeded loadgen schedule driven sharded
   (one pjit step program over a dp×tp mesh) vs unsharded with identical
@@ -1670,6 +1676,101 @@ def _serve_fleet_recovery_bench(on_accel: bool) -> dict:
     }
 
 
+def _gateway_latency_bench(on_accel: bool) -> dict:
+    """``gateway_latency`` stage (BENCH_GATEWAY=1, CPU-smoke default-on):
+    what the network front door costs (ISSUE 20).
+
+    Runs the REAL stack — one ``serve`` subprocess and one ``gateway``
+    subprocess over a shared spool — and drives the SAME seeded loadgen
+    schedule twice: once over HTTP+SSE (``run_socket``: connect/TTFB/
+    network-TTFT/stream-complete clocks) and once spool-direct
+    (``run_spool``, the pre-gateway client path).  Committed numbers:
+    stream-complete p50/p99, network TTFT p50/p99, the TTFT delta the
+    gateway hop adds over spool-direct, and the typed-429 shed rate
+    (expected 0 at this gentle rate — nonzero means admission is shedding
+    a healthy fleet).  CPU-pinned like the other control-plane stages: it
+    measures the ingress path, not model throughput."""
+    import signal
+    import subprocess
+    import tempfile
+
+    from taboo_brittleness_tpu.runtime import supervise as supervise_mod
+    from taboo_brittleness_tpu.serve import loadgen as loadgen_mod
+    from taboo_brittleness_tpu.serve.gateway import wait_for_gateway
+
+    n_requests = int(os.environ.get("BENCH_GATEWAY_REQUESTS", "12"))
+    rate = float(os.environ.get("BENCH_GATEWAY_RATE", "50"))
+    root = tempfile.mkdtemp(prefix="tbx_bench_gateway_")
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "TBX_OBS_PROGRESS_S": "0.2"}
+    t0 = time.perf_counter()
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+         "--synthetic", "--output-dir", root,
+         "--slots", "4", "--max-new-tokens", "6", "--poll", "0.05"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    gateway = subprocess.Popen(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "gateway",
+         "--output-dir", root, "--port", "0"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        port = wait_for_gateway(root, timeout_s=300.0)
+        if port is None:
+            return {"error": "gateway heartbeat never published a port"}
+        prompts = ("Give me a hint", "Give me a clue about the word")
+        # One untimed warm-up through the spool first: the replica's first
+        # request pays the step-program compile, and either timed arm would
+        # otherwise book that compile as ingress latency.
+        loadgen_mod.run_spool(root, n_requests=1, seed=99, rate=1000.0,
+                              concurrency=1, timeout_s=300.0,
+                              prompts=prompts)
+        socket_rep = loadgen_mod.run_socket(
+            f"http://127.0.0.1:{port}", n_requests=n_requests, seed=0,
+            rate=rate, concurrency=8, timeout_s=300.0, prompts=prompts)
+        spool_rep = loadgen_mod.run_spool(
+            root, n_requests=n_requests, seed=1,
+            rate=rate, concurrency=8, timeout_s=300.0, prompts=prompts)
+    except Exception as e:  # noqa: BLE001 — a broken stage must not void the round
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        for proc in (gateway, serve):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (gateway, serve):
+            try:
+                proc.wait(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    good = socket_rep["goodput"]
+    shed_rate = (round(good["rejected"] / n_requests, 4)
+                 if n_requests else 0.0)
+    gw_ttft = socket_rep.get("overall_ttft") or {}
+    sp_ttft = spool_rep.get("overall_ttft") or {}
+    ttft_delta = (round(gw_ttft["p99_s"] - sp_ttft["p99_s"], 6)
+                  if gw_ttft.get("count") and sp_ttft.get("count") else None)
+    drained = (gateway.returncode == supervise_mod.EXIT_DRAINED
+               and serve.returncode == supervise_mod.EXIT_DRAINED)
+    return {
+        "requests": n_requests,
+        "completed": good["completed"],
+        "shed_rate": shed_rate,
+        "reject_reasons": socket_rep["config"].get("reject_reasons") or {},
+        "p50_s": socket_rep["overall"]["p50_s"],
+        "p99_s": socket_rep["overall"]["p99_s"],
+        "ttft_p50_s": gw_ttft.get("p50_s"),
+        "ttft_p99_s": gw_ttft.get("p99_s"),
+        "connect_p99_s": socket_rep["socket"]["connect"]["p99_s"],
+        "ttfb_p99_s": socket_rep["socket"]["ttfb"]["p99_s"],
+        "spool_ttft_p99_s": sp_ttft.get("p99_s"),
+        "ttft_gateway_overhead_p99_s": ttft_delta,
+        "drained_clean": drained,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _delta_switch_bench(on_accel: bool) -> dict:
     """``delta_switch`` stage (BENCH_DELTA=1, CPU-smoke default-on): the
     base-resident word-switch path (ISSUE 12).
@@ -2004,6 +2105,10 @@ def main() -> int:
     if os.environ.get("BENCH_SERVE_FLEET", "1") == "1":
         serve_fleet_stage = _serve_fleet_recovery_bench(on_accel)
 
+    gateway_stage = None
+    if os.environ.get("BENCH_GATEWAY", "1") == "1":
+        gateway_stage = _gateway_latency_bench(on_accel)
+
     delta_stage = None
     if os.environ.get("BENCH_DELTA", "1") == "1":
         delta_stage = _delta_switch_bench(on_accel)
@@ -2118,6 +2223,18 @@ def main() -> int:
              "shed_rate": serve_fleet_stage.get("shed_rate")}
             if serve_fleet_stage and "error" not in serve_fleet_stage
             else None),
+        # Network front door (serve/gateway.py, stage gateway_latency): the
+        # SAME loadgen schedule over HTTP+SSE vs spool-direct — stream-
+        # complete p99, network TTFT p99, the TTFT delta the gateway hop
+        # adds, and the typed-429 shed rate; full stage in the detail block.
+        "gateway_latency": (
+            {"p50_s": gateway_stage.get("p50_s"),
+             "p99_s": gateway_stage.get("p99_s"),
+             "ttft_p99": gateway_stage.get("ttft_p99_s"),
+             "ttft_gateway_overhead_p99_s":
+                 gateway_stage.get("ttft_gateway_overhead_p99_s"),
+             "shed_rate": gateway_stage.get("shed_rate")}
+            if gateway_stage and "error" not in gateway_stage else None),
         # Base-resident delta switch (runtime/delta.py, stage delta_switch):
         # pack word−base deltas, then time warmed load→apply→ready word
         # switches over the resident base — median latency, delta-vs-full
@@ -2204,6 +2321,7 @@ def main() -> int:
              "serve_tp_ab": serve_tp_stage,
              "fleet_recovery": fleet_stage,
              "serve_fleet_recovery": serve_fleet_stage,
+             "gateway_latency": gateway_stage,
              "delta_switch": delta_stage,
              "grid_sweep": grid_stage,
              "device_profile": device_profile},

@@ -13,7 +13,8 @@ reference bug noted in SURVEY.md anti-goals):
     python -m taboo_brittleness_tpu prompting     [-c CFG] [--modes naive adversarial]
     python -m taboo_brittleness_tpu supervise --output-dir DIR -- <subcommand ...>
     python -m taboo_brittleness_tpu serve   --output-dir DIR [--synthetic] [--slots N]
-    python -m taboo_brittleness_tpu loadgen [--spool DIR | --synthetic] [-n N] [--selfcheck]
+    python -m taboo_brittleness_tpu loadgen [--spool DIR | --socket URL | --synthetic] [-n N]
+    python -m taboo_brittleness_tpu gateway --output-dir DIR [--port P] [--selfcheck]
 
 Every subcommand accepts the reference's ``configs/default.yaml`` schema
 unchanged (config.load_config).
@@ -34,6 +35,12 @@ Exit codes (the restart-vs-fail contract outer orchestration keys off):
   response, then the process exits.  Partial results on disk are valid and
   a relaunch resumes them (``runtime.supervise`` restarts on exactly this
   code; a relaunched server re-queues claimed-but-unanswered requests).
+  ``gateway`` drains at a STREAM boundary — the listening socket closes
+  (new connections are refused, late requests get 503 ``draining``), every
+  open SSE stream runs to its ``done`` event, then exit 75.  Because every
+  accepted request is already durable in the spool, even a SIGKILL'd
+  gateway loses only sockets: a relaunched gateway (or any sibling over
+  the same spool) serves the backlog, and clients re-attach by request id.
 """
 
 from __future__ import annotations
@@ -681,7 +688,12 @@ def cmd_loadgen(args) -> int:
             name, _, w = part.partition("=")
             mix[name.strip()] = float(w) if w else 1.0
     words = tuple(args.words or ()) or None
-    if args.spool:
+    if args.socket:
+        report = loadgen_mod.run_socket(
+            args.socket, n_requests=args.n, seed=args.seed, rate=args.rate,
+            concurrency=args.concurrency, mix=mix, words=words,
+            timeout_s=args.timeout)
+    elif args.spool:
         report = loadgen_mod.run_spool(
             args.spool, n_requests=args.n, seed=args.seed, rate=args.rate,
             concurrency=args.concurrency, mix=mix, words=words,
@@ -701,6 +713,23 @@ def cmd_loadgen(args) -> int:
     print(json.dumps(report))
     dropped = report["goodput"]["admitted"] - report["goodput"]["completed"]
     return 0 if dropped == 0 else 1
+
+
+def cmd_gateway(args) -> int:
+    """Streaming HTTP front door over the request spool (``serve.gateway``):
+    durable-before-ack admission, per-token SSE, typed 429 backpressure,
+    deadline propagation, client-disconnect cancellation, drain on 75."""
+    from taboo_brittleness_tpu.serve import gateway as gateway_mod
+
+    if args.selfcheck:
+        return gateway_mod.main_selfcheck()
+    if not args.output_dir:
+        raise SystemExit("gateway: --output-dir is required (the spool "
+                         "shared with a running `serve`)")
+    cfg = gateway_mod.GatewayConfig(
+        output_dir=args.output_dir, host=args.host, port=args.port,
+        window=args.window, poll_s=args.poll)
+    return gateway_mod.run_gateway(cfg)
 
 
 def cmd_delta_pack(args) -> int:
@@ -1447,6 +1476,10 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--spool", default=None,
                     help="drive a RUNNING serve via its output dir instead "
                          "of in-process")
+    lg.add_argument("--socket", default=None, metavar="URL",
+                    help="drive a RUNNING gateway over HTTP (e.g. "
+                         "http://127.0.0.1:8080); reports connect/TTFB/"
+                         "TTFT/stream-complete per scenario")
     lg.add_argument("-n", type=int, default=32, help="requests to send")
     lg.add_argument("--seed", type=int, default=0)
     lg.add_argument("--rate", type=float, default=50.0,
@@ -1465,6 +1498,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="CPU-sized CI smoke: tiny model, 32 requests, "
                          "asserts goodput == admitted + histogram schema")
     lg.set_defaults(fn=cmd_loadgen)
+
+    gw = sub.add_parser(
+        "gateway",
+        help="streaming HTTP front door over the request spool",
+        description="Stdlib-only asyncio HTTP/1.1 ingress: POST "
+                    "/v1/generate spools the request durably BEFORE the "
+                    "200, then streams per-token SSE; GET /v1/healthz and "
+                    "/v1/stats. Typed 429 backpressure (queue-full, "
+                    "tenant-quota, all-replicas-burning, fleet-saturated "
+                    "with burn-derived Retry-After), X-Tbx-Deadline-Ms "
+                    "deadline propagation, client disconnect = typed "
+                    "cancellation, SIGTERM drain on exit 75. Stateless: "
+                    "run N gateways over one spool.")
+    gw.add_argument("--output-dir", default=None,
+                    help="the request spool directory (shared with `serve`)")
+    gw.add_argument("--host", default="127.0.0.1")
+    gw.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral; the bound port is "
+                         "published in _gateway.json)")
+    gw.add_argument("--window", type=int, default=64,
+                    help="max concurrently open SSE streams before typed "
+                         "queue-full 429s")
+    gw.add_argument("--poll", type=float, default=0.02,
+                    help="token-stream/response tail poll interval, seconds")
+    gw.add_argument("--selfcheck", action="store_true",
+                    help="loopback socket smoke: real serve subprocess, N "
+                         "streamed completions, one mid-stream cancel, one "
+                         "over-quota 429, 413/400 rejects, exactly-once, "
+                         "SIGTERM drain on 75")
+    gw.set_defaults(fn=cmd_gateway)
 
     dp = sub.add_parser(
         "delta-pack",

@@ -133,6 +133,70 @@ def ensure(payload: Dict[str, Any], *,
     return {**payload, CTX_KEY: ctx}, ctx, True
 
 
+#: HTTP header the gateway reads/propagates the context from (ISSUE 20).
+#: Format is traceparent-style: ``<2-hex version>-<16..32 hex trace id>-
+#: <16 hex parent span id or zeros>-<2 hex flags>``; the W3C field layout,
+#: our 16-hex trace ids.
+TRACE_HEADER = "x-tbx-trace"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def parse_header(value: Optional[str]) -> Optional[Dict[str, Any]]:
+    """A trace context from a traceparent-style HTTP header, or None for a
+    missing/malformed header (the caller re-mints with the one-shot warn —
+    ``ensure_from_header``).  Longer (W3C 32-hex) trace ids are accepted
+    and truncated to this repo's 16-hex form."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    ver, tid, parent, _flags = parts
+    if len(ver) != 2 or not set(ver) <= _HEX:
+        return None
+    if not (16 <= len(tid) <= 32) or not set(tid) <= _HEX:
+        return None
+    if set(tid) == {"0"}:
+        return None
+    if len(parent) != 16 or not set(parent) <= _HEX:
+        return None
+    return {
+        "v": CTX_VERSION,
+        "trace_id": tid[:16],
+        "parent": None if set(parent) == {"0"} else parent,
+        "attempt": 0,
+    }
+
+
+def format_header(ctx: Dict[str, Any]) -> str:
+    """The wire form of a context — what a socket client (``tbx loadgen
+    --socket``) sends so its pre-minted trace survives the HTTP hop."""
+    parent = str(ctx.get("parent") or "").lower()
+    if len(parent) != 16 or not set(parent) <= _HEX:
+        parent = "0" * 16
+    return f"00-{ctx['trace_id']}-{parent}-01"
+
+
+def ensure_from_header(payload: Dict[str, Any],
+                       header: Optional[str]) -> Tuple[Dict[str, Any],
+                                                       Dict[str, Any], bool]:
+    """(payload-with-context, context, minted?) for a request arriving over
+    HTTP: a valid header's context rides into the spool payload (the
+    waterfall spans the socket hop); an absent or malformed header mints a
+    fresh context HERE at the gateway — the trace's birthplace moves to the
+    edge.  A context already in the payload body wins over the header
+    (explicit beats transport)."""
+    ctx = parse(payload)
+    if ctx is not None:
+        return payload, ctx, False
+    ctx = parse_header(header)
+    if ctx is not None:
+        return {**payload, CTX_KEY: ctx}, ctx, False
+    ctx = mint(attempt=0)
+    return {**payload, CTX_KEY: ctx}, ctx, True
+
+
 def for_attempt(ctx: Dict[str, Any], attempt: int,
                 *, dead_holder: Optional[str] = None) -> Dict[str, Any]:
     """The re-spool child context: SAME trace_id, bumped attempt, the dead
@@ -226,9 +290,14 @@ def reset_exemplars() -> None:
 # ---------------------------------------------------------------------------
 
 #: Coordinator point events joined into a trace by their ``request`` attr.
+#: The gateway.* points (ISSUE 20) extend the waterfall across the socket
+#: hop: accept → spooled → stream start/done (or shed/cancel) bracket the
+#: replica-side lifecycle.
 _COORD_POINTS = ("serve_fleet.route", "serve_fleet.respool",
                  "serve_fleet.reroute", "serve_fleet.lease_expired",
-                 "serve_fleet.shed", "serve.respond", "serve.claim")
+                 "serve_fleet.shed", "serve.respond", "serve.claim",
+                 "gateway.accept", "gateway.shed", "gateway.cancel",
+                 "gateway.stream_done")
 
 
 def find_event_files(path: str) -> List[str]:

@@ -128,6 +128,7 @@ def collect(output_dir: str) -> Dict[str, Any]:
         "serve": _read_json(os.path.join(output_dir, "_serve.json")),
         "serve_fleet": _read_json(os.path.join(output_dir,
                                                "_serve_fleet.json")),
+        "gateway": _read_json(os.path.join(output_dir, "_gateway.json")),
         "windows": windows,
         "exits": exits,
         "n_windows": n_windows,
@@ -290,6 +291,27 @@ def render(state: Dict[str, Any]) -> str:
             f"dupes {sf.get('duplicate_commits', 0)}"
             + (f"  recovery {sf['recovery_seconds']:.1f}s"
                if sf.get("recovery_seconds") is not None else ""))
+    gw = state.get("gateway")
+    if gw:
+        win = gw.get("window") or {}
+        shed = gw.get("shed") or {}
+        line = (f"gateway: {'draining' if gw.get('draining') else 'up'}  "
+                f"port {gw.get('port', '?')}  "
+                f"streams {gw.get('open_streams', 0)}/"
+                f"{win.get('limit', '?')}  "
+                f"accepted {gw.get('accepted', 0)}  "
+                f"done {gw.get('completed', 0)}  "
+                f"canceled {gw.get('canceled', 0)}")
+        if shed:
+            line += "  shed " + ",".join(
+                f"{k}={shed[k]}" for k in sorted(shed))
+        lines.append(line)
+        tenants = gw.get("tenants") or {}
+        tenant_shed = {t: c.get("shed", 0) for t, c in tenants.items()
+                       if c.get("shed", 0)}
+        if tenant_shed:
+            lines.append("  tenant shed: " + "  ".join(
+                f"{t}={tenant_shed[t]}" for t in sorted(tenant_shed)))
     lanes = state.get("lanes") or []
     if lanes:
         lines.append("lanes:")
@@ -381,6 +403,12 @@ def main_selfcheck(fixture_dir: Optional[str] = None) -> int:
                             "summary")
         elif "serve-fleet:" not in sf_frame:
             problems.append("serve_fleet fixture: summary line not "
+                            "rendered")
+        if not sf_state.get("gateway"):
+            problems.append("serve_fleet fixture: no _gateway.json "
+                            "heartbeat")
+        elif "gateway:" not in sf_frame or "tenant shed:" not in sf_frame:
+            problems.append("serve_fleet fixture: gateway lane not "
                             "rendered")
     if problems:
         # tbx: TBX009-ok — CLI stdout contract (selfcheck verdict)

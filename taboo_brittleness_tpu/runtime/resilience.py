@@ -575,6 +575,21 @@ FAULT_SITES = (
     #                       link; `die` here is the "replica killed at first
     #                       commit, response never lands" chaos case the
     #                       serve-fleet selfcheck arms
+    "gateway.accept",     # serve.gateway.Gateway — fired per accepted HTTP
+    #                       request before admission checks (context: path +
+    #                       tenant); a fault answers 500 and the client
+    #                       retries against another gateway — the spool never
+    #                       saw the request, so exactly-once is untouched
+    "gateway.spool_put",  # serve.gateway.Gateway — fired just before the
+    #                       durable RequestSpool.put; `die` here is the
+    #                       "gateway killed between accept and ack" chaos
+    #                       case: the client got no 200, so it may retry;
+    #                       the request is not in the spool, nothing leaks
+    "gateway.stream_write",  # serve.gateway.Gateway — fired per SSE event
+    #                       write (context: request id); a fault mid-stream
+    #                       drops the client connection while the replica
+    #                       finishes (or the cancel tombstone aborts it) —
+    #                       the response file stays authoritative
 )
 
 _FAULT_MODES = ("fail", "delay", "truncate", "die")

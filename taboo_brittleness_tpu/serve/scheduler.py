@@ -64,6 +64,15 @@ REJECT_PROMPT_TOO_LONG = "prompt-too-long"
 REJECT_UNKNOWN_SCENARIO = "unknown-scenario"   # server-side (pre-submit)
 REJECT_ALL_REPLICAS_BURNING = "all-replicas-burning"  # router shed
 REJECT_FLEET_SATURATED = "fleet-saturated"     # router shed: no free slots
+REJECT_TENANT_QUOTA = "tenant-quota"           # gateway token-bucket shed
+
+#: Typed TERMINAL finish reasons beyond eos/budget/quarantined (ISSUE 20):
+#: a canceled request (client disconnected mid-stream; the gateway's cancel
+#: tombstone) and a deadline-expired one (``X-Tbx-Deadline-Ms`` rode the
+#: payload and ran out) both resolve with an explicit response — never
+#: silently dropped, never a synthesized fleet-merge error.
+FINISH_CANCELED = "canceled"
+FINISH_DEADLINE = "deadline-exceeded"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +141,14 @@ class Request:
     # Distributed trace context (obs.reqtrace: trace_id/attempt/...) carried
     # in from the request payload; None = untraced (legacy / direct tests).
     trace: Optional[Dict[str, Any]] = None
+    # Two-level admission priority (ISSUE 20): >0 = high (the gateway maps
+    # tenant quota config onto this) — high-priority requests drain first
+    # when slots free up; within a level, FIFO.
+    priority: int = 0
+    # Absolute wall-clock (epoch) deadline stamped by the gateway from
+    # X-Tbx-Deadline-Ms; None = no deadline.  Epoch, not monotonic, because
+    # it crosses the gateway->spool->replica process boundary.
+    deadline_at: Optional[float] = None
 
     @property
     def trace_id(self) -> Optional[str]:
@@ -202,12 +219,19 @@ class SlotScheduler:
     Single-threaded by design: the serve loop owns ``submit``/``step``.
     ``on_complete`` (optional) fires with each :class:`Response` as it
     resolves — the server's spool writer and the loadgen's collector hook.
+    ``on_token`` (optional) fires as ``on_token(request, token_id, n)``
+    with every emitted token as it lands (``n`` = tokens emitted so far,
+    including this one) — the server's token-spool writer the gateway
+    tails for per-token SSE streaming (ISSUE 20).  Fail-open: a raising
+    hook drops that stream write (counted), never the session.
     """
 
     def __init__(self, engine: ServeEngine, *,
                  queue_limit: int = 64,
                  lens_target_id: int = -1,
                  on_complete: Optional[Callable[[Response], None]] = None,
+                 on_token: Optional[Callable[[Request, int, int],
+                                             None]] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.queue_limit = int(queue_limit)
@@ -218,8 +242,13 @@ class SlotScheduler:
         self.slot_limit = int(engine.ec.slots)
         self.lens_target_id = int(lens_target_id)
         self.on_complete = on_complete
+        self.on_token = on_token
         self._clock = clock
         self._queue: Deque[Request] = deque()
+        # High-priority lane (Request.priority > 0): drains before _queue
+        # when slots free; both lanes share ONE queue_limit so priority
+        # reorders, never enlarges, the admission window.
+        self._queue_hi: Deque[Request] = deque()
         self._sessions: Dict[int, _Session] = {}      # slot -> session
         # Request-lifecycle spans opened at submit, adopted by the session
         # at admit (queued requests own a span before they own a slot).
@@ -232,6 +261,8 @@ class SlotScheduler:
         self.rejected = 0
         self.completed = 0
         self.quarantined = 0
+        self.canceled = 0
+        self.deadline_expired = 0
         # Why the most recent submit() returned False (a REJECT_* constant):
         # the caller builds its typed rejected Response from this without
         # changing the bool submit contract.
@@ -245,11 +276,11 @@ class SlotScheduler:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._queue_hi)
 
     @property
     def idle(self) -> bool:
-        return not self._sessions and not self._queue
+        return not (self._sessions or self._queue or self._queue_hi)
 
     def set_slot_limit(self, width: int) -> int:
         """Install the autotuner's solved width as the admission cap,
@@ -272,7 +303,7 @@ class SlotScheduler:
         bounded queue is full, or when the request cannot fit the engine's
         shape envelope.  True = the request WILL be served (queued or
         admitted on the next ``step``)."""
-        if self.draining or len(self._queue) >= self.queue_limit:
+        if self.draining or self.queue_depth >= self.queue_limit:
             self._reject(req, REJECT_DRAINING if self.draining
                          else REJECT_QUEUE_FULL)
             return False
@@ -287,8 +318,8 @@ class SlotScheduler:
             return False
         self.last_reject_reason = None
         req.submitted_at = self._clock()
-        self._queue.append(req)
-        obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
+        (self._queue_hi if req.priority > 0 else self._queue).append(req)
+        obs_metrics.gauge("serve.queue_depth").set(self.queue_depth)
         obs.event("serve.request", request=req.id,
                   scenario=req.scenario.name, prompt_tokens=len(ids),
                   **({"trace": req.trace_id} if req.trace_id else {}))
@@ -322,6 +353,7 @@ class SlotScheduler:
         """Request ids this scheduler currently owns (queued + in-flight) —
         the server's mid-run claimed-but-unanswered audit subtracts these."""
         return ([s.request.id for s in self._sessions.values()]
+                + [r.id for r in self._queue_hi]
                 + [r.id for r in self._queue])
 
     def drain(self) -> None:
@@ -352,15 +384,37 @@ class SlotScheduler:
         return np.asarray(projection.random_subspace(
             key, self.engine.cfg.hidden_size, rank))
 
+    @staticmethod
+    def _now_epoch() -> float:
+        # tbx: wallclock-ok — deadlines cross processes, stamped as epoch
+        return time.time()
+
+    def _expired(self, req: Request) -> bool:
+        return (req.deadline_at is not None
+                and self._now_epoch() > req.deadline_at)
+
+    def _next_queued(self) -> Optional[Request]:
+        """Pop the next admissible request: high-priority lane first, and
+        deadline-expired entries resolve typed HERE (never decoded, never
+        dropped) without consuming the slot."""
+        while self._queue_hi or self._queue:
+            req = (self._queue_hi.popleft() if self._queue_hi
+                   else self._queue.popleft())
+            if self._expired(req):
+                self._resolve_queued(req, FINISH_DEADLINE)
+                continue
+            return req
+        return None
+
     def _fill_slots(self) -> None:
-        if not self._queue:
+        if not (self._queue or self._queue_hi):
             return
         for slot in self.engine.free_slots():
-            if not self._queue:
-                break
             if slot >= self.slot_limit:
                 continue   # above the autotuned width: never admits
-            req = self._queue.popleft()
+            req = self._next_queued()
+            if req is None:
+                break
             now = self._clock()
             sc = req.scenario
             word_id = self.engine.word_index(req.word)
@@ -389,7 +443,71 @@ class SlotScheduler:
                       scenario=sc.name, queue_seconds=round(queue_wait, 4),
                       **({"word": req.word} if req.word else {}))
         obs_metrics.gauge("serve.in_flight").set(len(self._sessions))
-        obs_metrics.gauge("serve.queue_depth").set(len(self._queue))
+        obs_metrics.gauge("serve.queue_depth").set(self.queue_depth)
+
+    # -- cancellation / typed queued terminals (ISSUE 20) --------------------
+
+    def cancel(self, rid: str) -> bool:
+        """Resolve one request as ``canceled`` (the gateway's client-
+        disconnect tombstone, observed by the serve loop between steps —
+        for the speculative engine that boundary IS the verify-block
+        boundary, since each scheduler step is one draft+verify block).
+        Queued: removed and answered without decoding.  In-flight: the
+        slot is released and the partial stream resolves typed.  Returns
+        False when this scheduler does not own the request (already
+        resolved, or never claimed here)."""
+        for q in (self._queue_hi, self._queue):
+            for req in q:
+                if req.id == rid:
+                    q.remove(req)
+                    self._resolve_queued(req, FINISH_CANCELED)
+                    obs_metrics.gauge("serve.queue_depth").set(
+                        self.queue_depth)
+                    return True
+        for slot, sess in list(self._sessions.items()):
+            if sess.request.id == rid:
+                resp = self._finish(slot, FINISH_CANCELED)
+                self._after_step([resp])
+                return True
+        return False
+
+    def _count_typed_terminal(self, finish: str) -> None:
+        if finish == FINISH_CANCELED:
+            self.canceled += 1
+            obs_metrics.counter("serve.canceled").inc()
+        elif finish == FINISH_DEADLINE:
+            self.deadline_expired += 1
+            obs_metrics.counter("serve.deadline_exceeded").inc()
+
+    def _resolve_queued(self, req: Request, finish: str) -> Response:
+        """Typed terminal for a request that never reached a slot (canceled
+        or deadline-expired while queued): explicit response, span closed
+        terminal with zero tokens — exactly-once still holds."""
+        now = self._clock()
+        waited = (round(now - req.submitted_at, 6)
+                  if req.submitted_at else 0.0)
+        resp = Response(
+            id=req.id, scenario=req.scenario.name, ok=False, word=req.word,
+            finish=finish, queue_seconds=waited, latency_seconds=waited,
+            replica=current_worker_id(),
+            trace_id=req.trace_id, attempt=req.attempt)
+        self._count_typed_terminal(finish)
+        obs.event("serve.complete", request=req.id,
+                  scenario=req.scenario.name, finish=finish, steps=0,
+                  ok=False, latency_seconds=waited)
+        span = self._req_spans.pop(req.id, obs_trace.NULL_SPAN)
+        span.set(terminal=True, finish=finish, steps=0, emitted=0,
+                 latency_seconds=waited)
+        span.end()
+        tracer = obs_trace.get_tracer()
+        if tracer is not None:
+            try:
+                tracer.flush()
+            except Exception:  # noqa: BLE001 — tracing is fail-open
+                pass
+        if self.on_complete is not None:
+            self.on_complete(resp)
+        return resp
 
     # -- stepping ------------------------------------------------------------
 
@@ -406,6 +524,16 @@ class SlotScheduler:
             if not self._sessions:
                 return []
         responses: List[Response] = []
+        # Deadline sweep BETWEEN steps — for the speculative engine this is
+        # between verify blocks (one scheduler step = one draft+verify
+        # block): an expired in-flight session resolves typed and releases
+        # its slot before the next launch.
+        for slot, sess in list(self._sessions.items()):
+            if self._expired(sess.request):
+                responses.append(self._finish(slot, FINISH_DEADLINE))
+        if not self._sessions:
+            self._after_step(responses)
+            return responses
         # Flight-recorder step record BEFORE the fault site fires, so a
         # poisoned step is IN the ring the quarantine dump freezes.
         flightrec.record("serve.step",
@@ -439,6 +567,7 @@ class SlotScheduler:
                         if not sess.tokens:
                             self._first_token(sess)
                         sess.tokens.append(int(out.toks[slot, j]))
+                        self._emit_token(sess)
                         if sess.request.scenario.lens_readout:
                             sess.lens_probs.append(
                                 float(out.lens_prob[slot, j]))
@@ -454,6 +583,7 @@ class SlotScheduler:
                 if not sess.tokens:
                     self._first_token(sess)
                 sess.tokens.append(int(out.tok[slot]))
+                self._emit_token(sess)
                 if sess.request.scenario.lens_readout:
                     sess.lens_probs.append(float(out.lens_prob[slot]))
             if bool(out.finished[slot]):
@@ -480,6 +610,17 @@ class SlotScheduler:
             reqtrace.FIRST_TOKEN_POINT, request=req.id,
             attempt=req.attempt, ttft_seconds=sess.ttft_seconds,
             **({"trace": req.trace_id} if req.trace_id else {}))
+
+    def _emit_token(self, sess: _Session) -> None:
+        """Per-token streaming hook (the server's token-spool writer; the
+        gateway tails it for SSE).  Fail-open: a raising hook drops that
+        write — the response file stays the authoritative stream."""
+        if self.on_token is None:
+            return
+        try:
+            self.on_token(sess.request, sess.tokens[-1], len(sess.tokens))
+        except Exception:  # noqa: BLE001 — streaming is fail-open
+            obs_metrics.counter("serve.stream_dropped").inc()
 
     def _fire_spec_verify(self, sess: _Session) -> None:
         """The ``serve.spec.verify`` fault site, with ONE in-place retry:
@@ -509,7 +650,12 @@ class SlotScheduler:
         self.engine.release(slot)
         now = self._clock()
         req = sess.request
-        ok = exc is None
+        # Canceled / deadline-expired sessions are typed terminals: not ok
+        # (the client did not get a completed stream), not an error (no
+        # exception; the span closes status="ok" with finish carrying the
+        # reason — never the fleet-merge's synthesized error).
+        typed = exc is None and finish in (FINISH_CANCELED, FINISH_DEADLINE)
+        ok = exc is None and not typed
         resp = Response(
             id=req.id, scenario=req.scenario.name, ok=ok, word=req.word,
             text=self.engine.tok.decode(sess.tokens) if sess.tokens else "",
@@ -556,6 +702,13 @@ class SlotScheduler:
                 agg["accepted"] += sess.accepted
                 agg["exited_early"] += sess.early
                 agg["early_agree"] += sess.early_agree
+        elif typed:
+            # Canceled / deadline-expired: neither completed (no latency
+            # observation — an aborted stream is not a served request) nor
+            # quarantined (nothing is broken; no flightrec postmortem).
+            self._count_typed_terminal(finish)
+            flightrec.record("serve.typed_terminal", request=req.id,
+                             scenario=req.scenario.name, finish=finish)
         else:
             self.quarantined += 1
             obs_metrics.counter("serve.quarantined").inc()

@@ -15,6 +15,12 @@ Layout under ``<output_dir>``::
                                    once the response exists
     responses/<id>.json            the response (atomic write; in fleet mode
                                    an os.link first-writer-wins commit)
+    streams/<id>.jsonl             per-token emission stream (append-mode
+                                   whole-line JSONL; the gateway's SSE
+                                   source — ISSUE 20), GC'd with the claim
+    cancel/<id>.json               client-cancel tombstone (gateway writes
+                                   on disconnect; replicas observe between
+                                   steps / verify blocks)
     _progress.json                 serving-mode heartbeat (obs.progress)
     _events.jsonl                  span/point stream (obs.trace)
     _serve.json                    exit summary incl. AOT step-program stats
@@ -89,7 +95,8 @@ from taboo_brittleness_tpu.runtime.resilience import (
 from taboo_brittleness_tpu.serve import autotune
 from taboo_brittleness_tpu.serve.engine import ServeEngine
 from taboo_brittleness_tpu.serve.scheduler import (
-    REJECT_UNKNOWN_SCENARIO, Request, Response, Scenario, SlotScheduler)
+    FINISH_CANCELED, FINISH_DEADLINE, REJECT_UNKNOWN_SCENARIO, Request,
+    Response, Scenario, SlotScheduler)
 
 SERVE_SUMMARY_FILENAME = "_serve.json"
 REQUESTS_DIRNAME = "requests"
@@ -100,6 +107,35 @@ CLAIMED_DIRNAME = "claimed"
 LEASES_DIRNAME = "leases"
 DUPLICATES_DIRNAME = "_duplicates"
 STOP_MARKER = "_stop"
+STREAMS_DIRNAME = "streams"
+CANCEL_DIRNAME = "cancel"
+
+#: ``RequestSpool.put`` size guard (ISSUE 20): the serialized payload may
+#: not exceed this many bytes — the gateway maps the violation to HTTP 413
+#: BEFORE spooling, so an oversized POST never reaches a replica.
+SPOOL_MAX_BYTES_ENV = "TBX_SPOOL_MAX_BYTES"
+DEFAULT_SPOOL_MAX_BYTES = 256 * 1024
+
+
+def spool_max_bytes() -> int:
+    try:
+        return int(os.environ.get(SPOOL_MAX_BYTES_ENV,
+                                  DEFAULT_SPOOL_MAX_BYTES))
+    except ValueError:
+        return DEFAULT_SPOOL_MAX_BYTES
+
+
+class SpoolValidationError(ValueError):
+    """A payload :meth:`RequestSpool.put` refuses to accept.
+
+    ``reason`` is the typed cause — ``"oversized"`` (serialized payload
+    over the ``TBX_SPOOL_MAX_BYTES`` cap; HTTP 413 at the gateway) or
+    ``"invalid"`` (not a JSON object with a non-empty string ``prompt``;
+    HTTP 400)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
 
 #: How often the serve loop sweeps resolved ``.claimed`` tombstones (the
 #: GC satellite): cheap, but not every 50ms poll.
@@ -126,10 +162,14 @@ class RequestSpool:
         self.leases_dir = os.path.join(root, LEASES_DIRNAME)
         self.duplicates_dir = os.path.join(self.responses_dir,
                                            DUPLICATES_DIRNAME)
+        self.streams_dir = os.path.join(root, STREAMS_DIRNAME)
+        self.cancel_dir = os.path.join(root, CANCEL_DIRNAME)
         self.lease_store = LeaseStore(self.leases_dir)
         self._last_gc: Optional[float] = None
         os.makedirs(self.requests_dir, exist_ok=True)
         os.makedirs(self.responses_dir, exist_ok=True)
+        os.makedirs(self.streams_dir, exist_ok=True)
+        os.makedirs(self.cancel_dir, exist_ok=True)
         if self.fleet:
             for d in (self.assigned_dir, self.claimed_dir, self.leases_dir,
                       self.duplicates_dir):
@@ -140,15 +180,69 @@ class RequestSpool:
     def put(self, payload: Dict[str, Any]) -> str:
         """Submit one request (loadgen / external client).  Returns the id.
         Mints the distributed trace context (obs.reqtrace) unless the
-        client already carries one — submit is the trace's birthplace."""
+        client already carries one — submit is the trace's birthplace.
+
+        Guards (ISSUE 20): raises :class:`SpoolValidationError` for a
+        payload that is not a JSON object with a non-empty string
+        ``prompt`` (``reason="invalid"``) or whose serialization exceeds
+        ``TBX_SPOOL_MAX_BYTES`` (``reason="oversized"``) — the gateway
+        answers 400/413 instead of spooling a request no replica would
+        serve."""
+        if not isinstance(payload, dict):
+            raise SpoolValidationError(
+                "invalid", "request payload must be a JSON object")
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise SpoolValidationError(
+                "invalid",
+                "request payload needs a non-empty string 'prompt'")
         rid = str(payload.get("id") or uuid.uuid4().hex[:12])
         payload, _ctx, _minted = reqtrace.ensure({**payload, "id": rid})
+        try:
+            blob = json.dumps(payload).encode("utf-8")
+        except (TypeError, ValueError) as exc:
+            raise SpoolValidationError(
+                "invalid",
+                f"payload not JSON-serializable: {exc}") from exc
+        cap = spool_max_bytes()
+        if len(blob) > cap:
+            raise SpoolValidationError(
+                "oversized",
+                f"serialized request is {len(blob)} bytes > {cap} cap")
         atomic_json_dump(payload,
                          os.path.join(self.requests_dir, f"{rid}.json"))
         return rid
 
     def response_path(self, rid: str) -> str:
         return os.path.join(self.responses_dir, f"{rid}.json")
+
+    # -- streaming / cancellation (ISSUE 20: the gateway front door) ---------
+
+    def stream_path(self, rid: str) -> str:
+        """Per-request token emission file (append-mode whole-line JSONL,
+        written by the serving replica's :class:`TokenStreamWriter`; the
+        gateway tails it for SSE)."""
+        return os.path.join(self.streams_dir, f"{rid}.jsonl")
+
+    def cancel(self, rid: str) -> str:
+        """Drop a cancellation tombstone (client disconnected / gave up).
+        Idempotent; replicas observe it between steps — an unclaimed
+        request is answered with a typed ``canceled`` terminal at claim, an
+        in-flight one releases its slot at the next step boundary."""
+        path = os.path.join(self.cancel_dir, f"{rid}.json")
+        # tbx: wallclock-ok — tombstone timestamps cross processes (epoch)
+        atomic_json_dump({"id": rid, "canceled_at": time.time()}, path)
+        return path
+
+    def is_canceled(self, rid: str) -> bool:
+        return os.path.exists(os.path.join(self.cancel_dir, f"{rid}.json"))
+
+    def canceled_ids(self) -> List[str]:
+        try:
+            names = os.listdir(self.cancel_dir)
+        except OSError:
+            return []
+        return sorted(n[:-5] for n in names if n.endswith(".json"))
 
     def get_response(self, rid: str) -> Optional[Dict[str, Any]]:
         path = self.response_path(rid)
@@ -273,6 +367,26 @@ class RequestSpool:
                     removed += 1
                 except OSError:
                     pass
+        # Cancel tombstones and token-stream files are per-request scratch:
+        # once the response exists they are dead weight.  A gateway tailing
+        # the stream holds an open fd, so the unlink never truncates a live
+        # reader (POSIX), and the ``done`` SSE event carries the
+        # authoritative text from the response file anyway.
+        for d, suffix in ((self.cancel_dir, ".json"),
+                          (self.streams_dir, ".jsonl")):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(suffix):
+                    continue
+                if self.get_response(name[:-len(suffix)]) is not None:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                        removed += 1
+                    except OSError:
+                        pass
         return removed
 
     # -- stop marker (fleet coordinator -> replicas) -------------------------
@@ -463,6 +577,47 @@ class RequestSpool:
             return 0
 
 
+class TokenStreamWriter:
+    """Per-request token emission files under ``streams/`` — the gateway's
+    SSE source (ISSUE 20).  The scheduler's ``on_token`` hook appends one
+    ``{"n", "tok", "piece"}`` line per emitted token and flushes, so a
+    tailing reader only ever sees complete lines (O_APPEND, one write per
+    line) and a SIGKILL mid-line costs at most the final token of a stream
+    that the response file supersedes anyway.  One open fd per in-flight
+    request, closed when the request resolves."""
+
+    def __init__(self, spool: RequestSpool, decode=None):
+        self.spool = spool
+        self.decode = decode            # tok.decode, for SSE text pieces
+        self._files: Dict[str, Any] = {}
+
+    def emit(self, rid: str, tok: int, n: int) -> None:
+        f = self._files.get(rid)
+        if f is None:
+            f = open(self.spool.stream_path(rid), "a")
+            self._files[rid] = f
+        line: Dict[str, Any] = {"n": int(n), "tok": int(tok)}
+        if self.decode is not None:
+            try:
+                line["piece"] = self.decode([int(tok)])
+            except Exception:  # noqa: BLE001 — pieces are cosmetic; ids rule
+                pass
+        f.write(json.dumps(line) + "\n")
+        f.flush()
+
+    def finish(self, rid: str) -> None:
+        f = self._files.pop(rid, None)
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        for rid in list(self._files):
+            self.finish(rid)
+
+
 class ServeLeaseKeeper:
     """ONE renewal thread for ALL of a replica's held request leases —
     the per-unit :class:`runtime.fleet.LeaseKeeper` generalized to a
@@ -559,10 +714,20 @@ def _to_request(payload: Dict[str, Any],
     if max_new is not None:
         sc = dataclasses.replace(sc, max_new_tokens=int(max_new))
     word = payload.get("word")
+    try:
+        priority = int(payload.get("priority", 0) or 0)
+    except (TypeError, ValueError):
+        priority = 0
+    try:
+        deadline_at = (float(payload["deadline_at"])
+                       if payload.get("deadline_at") is not None else None)
+    except (TypeError, ValueError):
+        deadline_at = None
     return Request(id=str(payload.get("id") or uuid.uuid4().hex[:12]),
                    prompt=str(payload.get("prompt", "")),
                    scenario=sc, seed=int(payload.get("seed", 0) or 0),
                    word=str(word) if word is not None else None,
+                   priority=priority, deadline_at=deadline_at,
                    trace=reqtrace.parse(payload))
 
 
@@ -656,9 +821,20 @@ def serve_forever(
             lease_s=lease_s if lease_s is not None
             else lease_seconds()).start()
 
+    # Per-token stream files (ISSUE 20): the gateway tails these for SSE.
+    # Default-on (append+flush of one short line per token); TBX_SERVE_STREAM=0
+    # turns it off for overhead-sensitive benches without a gateway.
+    streams: Optional[TokenStreamWriter] = None
+    if os.environ.get("TBX_SERVE_STREAM", "1") == "1":
+        streams = TokenStreamWriter(spool,
+                                    decode=getattr(engine, "tok", None)
+                                    and engine.tok.decode)
+
     def _respond(resp: Response) -> None:
         """Response writer: plain atomic in single mode; first-writer-wins
         commit + lease/claim release in fleet mode."""
+        if streams is not None:
+            streams.finish(resp.id)
         if not replica:
             spool.respond(resp)
             return
@@ -673,7 +849,10 @@ def serve_forever(
 
     sched = SlotScheduler(engine, queue_limit=queue_limit,
                           lens_target_id=lens_target_id,
-                          on_complete=_respond, clock=clock)
+                          on_complete=_respond, clock=clock,
+                          on_token=((lambda req, tok, n:
+                                     streams.emit(req.id, tok, n))
+                                    if streams is not None else None))
     warm = engine.warm_start()
     obs.event("serve.warm_start", **{k: v for k, v in warm.items()
                                      if k in ("source", "trace_seconds",
@@ -720,6 +899,35 @@ def serve_forever(
                 "responses stay traceable from this hop on",
                 name="serve.pretrace_request",
                 request=str(payload.get("id")))
+        rid = str(payload.get("id"))
+        if spool.is_canceled(rid):
+            # Canceled before this replica admitted it: answer the typed
+            # terminal so the client's wait resolves — never a silent drop.
+            _respond(Response(
+                id=rid, ok=False,
+                scenario=str(payload.get("scenario", "chat")),
+                finish=FINISH_CANCELED, replica=wid,
+                trace_id=ctx.get("trace_id"),
+                attempt=int(ctx.get("attempt", 0))))
+            return
+        deadline = payload.get("deadline_at")
+        if deadline is not None:
+            try:
+                # tbx: wallclock-ok — deadlines are cross-process epoch stamps
+                expired = time.time() > float(deadline)
+            except (TypeError, ValueError):
+                expired = False
+            if expired:
+                # Skip-at-claim (ISSUE 20b): an expired request never costs
+                # a decode slot; the client gets the typed terminal.
+                _respond(Response(
+                    id=rid, ok=False,
+                    scenario=str(payload.get("scenario", "chat")),
+                    finish=FINISH_DEADLINE, replica=wid,
+                    error="deadline expired before claim",
+                    trace_id=ctx.get("trace_id"),
+                    attempt=int(ctx.get("attempt", 0))))
+                return
         req = _to_request(payload, scenarios)
         if req is None:
             _respond(Response(
@@ -798,6 +1006,13 @@ def serve_forever(
         while True:
             if supervise.drain_requested() and not sched.draining:
                 sched.drain()
+            # Client cancellations (gateway disconnects) are tombstones in
+            # cancel/ — observed here between steps, which for speculative
+            # engines is between verify blocks (one block per step).  Owned
+            # requests release their slot now; unclaimed ones are answered
+            # typed at claim (_take); foreign ones are another replica's.
+            for rid in spool.canceled_ids():
+                sched.cancel(rid)
             if not sched.draining:
                 _claim_into_scheduler()
             stepped = False
@@ -845,6 +1060,8 @@ def serve_forever(
     finally:
         if keeper is not None:
             keeper.stop()
+        if streams is not None:
+            streams.close()
         spool.gc_claimed(force=True)
         summary = {
             "status": status,
@@ -853,6 +1070,8 @@ def serve_forever(
             "admitted": sched.admitted,
             "rejected": sched.rejected,
             "quarantined": sched.quarantined,
+            "canceled": sched.canceled,
+            "deadline_expired": sched.deadline_expired,
             "aot": _step_program_stats(engine),
         }
         if tuned is not None:

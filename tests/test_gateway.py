@@ -1,0 +1,914 @@
+"""Network front door acceptance (ISSUE 20): `tbx gateway` — durable-ack
+HTTP/SSE ingress over the request spool, with backpressure, deadlines,
+tenant quotas, client-disconnect cancellation and chaos-proven drain.
+
+The centerpiece is a REAL chaos e2e: a replica fleet over one spool with a
+gateway subprocess in front, live socket load, the gateway SIGKILLed
+mid-stream and replica ``w0`` killed by a ``die`` fault mid-decode.  Every
+accepted request must be answered exactly once (the SIGKILL loses only
+sockets — the spool backlog is untouched and a relaunched gateway serves
+it), a client disconnect must resolve as a typed ``canceled`` terminal
+(never the fleet-merge's synthesized error), an expired
+``X-Tbx-Deadline-Ms`` must resolve typed ``deadline-exceeded``, and the
+merged event stream — gateway spans folded in — must stay green under
+``trace_report --check`` (which includes ``check_request_traces``).
+
+Around it: spool put-guard units (the 400/413-before-spooling fix) plus
+the torn-file claim-skip regression, token-bucket / quota-config /
+fleet-pressure units, scheduler cancel/deadline/priority units, trace-
+header parsing units, in-gateway fault-site drills for ``gateway.accept``
+/ ``gateway.spool_put`` / ``gateway.stream_write`` (TBX206 arming), two
+fake-replica socket e2es (the test plays the replica by writing stream
+and response files, so no engine spin-up), and the ``gateway_latency``
+bench_compare gate.
+"""
+
+import glob
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from taboo_brittleness_tpu.obs import reqtrace
+from taboo_brittleness_tpu.runtime import fleet as fleet_mod
+from taboo_brittleness_tpu.runtime import resilience, supervise
+from taboo_brittleness_tpu.runtime.resilience import (
+    FaultInjector, RetryPolicy)
+from taboo_brittleness_tpu.serve import gateway as gw_mod
+from taboo_brittleness_tpu.serve.gateway import (
+    GatewayClient, TenantQuotas, TokenBucket, burn_retry_after, close_stream,
+    fleet_pressure, iter_sse, parse_quota, wait_for_gateway)
+from taboo_brittleness_tpu.serve.replica import run_serve_fleet
+from taboo_brittleness_tpu.serve.scheduler import (
+    FINISH_CANCELED, FINISH_DEADLINE, Request, Response, SlotScheduler,
+    default_scenarios)
+from taboo_brittleness_tpu.serve.server import (
+    RequestSpool, SpoolValidationError)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_compare  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+    monkeypatch.delenv("TBX_WORKER_ID", raising=False)
+    monkeypatch.delenv("TABOO_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("TBX_GATEWAY_QUOTA", raising=False)
+    monkeypatch.delenv("TBX_SPOOL_MAX_BYTES", raising=False)
+    yield
+    supervise.reset_drain()
+    resilience.set_injector(FaultInjector())
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TBX_OBS_PROGRESS_S"] = "0.2"
+    env["TBX_SUPERVISE_BACKOFF_S"] = "0"
+    for k in ("TABOO_FAULT_PLAN", "TBX_INCARNATION", "TBX_WORKER_ID",
+              "TBX_GATEWAY_QUOTA", "TBX_SPOOL_MAX_BYTES"):
+        env.pop(k, None)
+    env.update(extra)
+    return env
+
+
+def _start_gateway(out, *, window=8, env=None, poll="0.01"):
+    """Launch one gateway subprocess over ``out`` and wait for its port
+    (``--port 0`` publishes the bound port in the heartbeat)."""
+    os.makedirs(out, exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "taboo_brittleness_tpu", "gateway",
+         "--output-dir", out, "--port", "0", "--window", str(window),
+         "--poll", poll],
+        env=env or _env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+    port = _wait_port(out, proc.pid)
+    assert port is not None, "gateway never published a port"
+    return proc, GatewayClient(f"http://127.0.0.1:{port}", timeout=60.0)
+
+
+def _wait_port(out, pid, timeout_s=60.0):
+    """The port published by the gateway heartbeat FOR THIS PID — a
+    relaunched gateway must not be discovered through its predecessor's
+    stale heartbeat."""
+    path = os.path.join(out, gw_mod.GATEWAY_HEARTBEAT_FILENAME)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            with open(path) as f:
+                hb = json.load(f)
+            if hb.get("pid") == pid and hb.get("port"):
+                return int(hb["port"])
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    return None
+
+
+def _drain(proc):
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc == supervise.EXIT_DRAINED, f"drain exit {rc}"
+
+
+def _fake_tokens(spool, rid, toks):
+    """Play the replica's TokenStreamWriter: whole-line JSONL appends."""
+    with open(spool.stream_path(rid), "a") as f:
+        for i, t in enumerate(toks):
+            f.write(json.dumps({"n": i + 1, "tok": int(t)}) + "\n")
+            f.flush()
+
+
+def _fake_response(spool, rid, *, ok=True, tokens=(), finish="eos"):
+    spool.respond(Response(id=rid, scenario="chat", ok=ok,
+                           tokens=list(tokens), finish=finish))
+
+
+def _gw_heartbeat(out, wid, *, status="running", age=0.0, fast=0.0,
+                  width=4, free=4, queued=0):
+    """Fabricate the replica-heartbeat contract ``fleet_pressure`` reads
+    (a ``_progress.<wid>.json`` with serve SLO cells + slot occupancy).
+    ``heartbeat_seconds`` is generous so the snapshot stays live across
+    the gateway's pressure-cache TTL."""
+    path = os.path.join(out, f"_progress.{wid}.json")
+    payload = {
+        "v": 1, "worker": wid, "status": status,
+        # tbx: wallclock-ok — the heartbeat contract is epoch-stamped
+        "updated_at": time.time() - age,
+        "heartbeat_seconds": 5.0, "workload": "serve",
+        "serving": {"in_flight": width - free, "completed_requests": 0,
+                    "queued": queued,
+                    "slots": {"width": width, "active": width - free,
+                              "free": free}},
+        "slo": {"serve_latency.chat":
+                {"burn": fast, "fast": fast, "slow": fast,
+                 "ok": fast < 1.0}},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def _no_corrupt(root):
+    return [p for p in glob.glob(os.path.join(root, "**", "*.corrupt"),
+                                 recursive=True)]
+
+
+# ---------------------------------------------------------------------------
+# RequestSpool.put guards (the 400/413-before-spooling fix) + the torn-file
+# claim-skip regression for partially-written gateway puts.
+# ---------------------------------------------------------------------------
+
+
+def test_spool_put_rejects_invalid_payloads(tmp_path):
+    spool = RequestSpool(str(tmp_path))
+    with pytest.raises(SpoolValidationError) as e:
+        spool.put(["not", "an", "object"])
+    assert e.value.reason == "invalid"
+    with pytest.raises(SpoolValidationError) as e:
+        spool.put({"id": "x", "scenario": "chat"})      # no prompt at all
+    assert e.value.reason == "invalid"
+    with pytest.raises(SpoolValidationError) as e:
+        spool.put({"id": "x", "prompt": ""})            # empty prompt
+    assert e.value.reason == "invalid"
+    with pytest.raises(SpoolValidationError) as e:
+        spool.put({"id": "x", "prompt": "p", "blob": {1, 2}})  # unserializable
+    assert e.value.reason == "invalid"
+    # Nothing leaked into the spool from any rejected put.
+    assert os.listdir(spool.requests_dir) == []
+
+
+def test_spool_put_rejects_oversized(tmp_path, monkeypatch):
+    monkeypatch.setenv("TBX_SPOOL_MAX_BYTES", "256")
+    spool = RequestSpool(str(tmp_path))
+    with pytest.raises(SpoolValidationError) as e:
+        spool.put({"id": "big", "prompt": "x" * 1024})
+    assert e.value.reason == "oversized"
+    assert os.listdir(spool.requests_dir) == []
+    # Under the cap still spools.
+    rid = spool.put({"id": "ok", "prompt": "p"})
+    assert os.path.exists(os.path.join(spool.requests_dir, f"{rid}.json"))
+
+
+def test_spool_claim_skips_torn_file_until_it_completes(tmp_path):
+    """The torn-file regression: a partially-written request file (a
+    gateway killed mid-put writes nothing thanks to the atomic rename —
+    but a NON-atomic writer's torn JSON must not crash or consume the
+    claim) is skipped in place and picked up once it parses."""
+    spool = RequestSpool(str(tmp_path))
+    spool.put({"id": "whole", "prompt": "p", "scenario": "chat"})
+    torn = os.path.join(spool.requests_dir, "torn.json")
+    with open(torn, "w") as f:
+        f.write('{"id": "torn", "prompt": "Give me a hi')   # mid-write
+    claimed = spool.claim(10)
+    assert [c["id"] for c in claimed] == ["whole"]
+    assert os.path.exists(torn), "torn file must be left in place"
+    # The writer finishes (atomic replace, as the spool writes): claimable.
+    tmp = torn + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"id": "torn", "prompt": "p", "scenario": "chat"}, f)
+    os.replace(tmp, torn)
+    assert [c["id"] for c in spool.claim(10)] == ["torn"]
+
+
+# ---------------------------------------------------------------------------
+# Tenant quota units: token bucket, config parsing, admission.
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_after():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: now[0])
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()                       # burst exhausted
+    assert b.retry_after() == pytest.approx(0.5)  # 1 token at 2/s
+    now[0] += 0.5
+    assert b.try_take()                           # refilled exactly one
+    assert not b.try_take()
+
+
+def test_parse_quota_fail_open_and_defaults():
+    assert parse_quota("") == {}
+    assert parse_quota("{not json") == {}         # malformed: fail-open
+    assert parse_quota('["not", "a", "dict"]') == {}
+    cfg = parse_quota(json.dumps({
+        "vip": {"rate": 5, "priority": 2},
+        "bogus": "not-a-spec",
+        "*": {"rate": 1, "burst": 3}}))
+    assert cfg["vip"]["rate"] == 5.0 and cfg["vip"]["priority"] == 2
+    assert cfg["vip"]["burst"] == 5.0             # burst defaults to rate
+    assert "bogus" not in cfg
+    assert cfg["*"]["burst"] == 3.0
+
+
+def test_tenant_quotas_admit_priority_and_unlimited():
+    q = TenantQuotas({"vip": {"rate": 0.001, "burst": 1.0, "priority": 2},
+                      "*": {"rate": 1000.0, "burst": 1000.0,
+                            "priority": 0}})
+    ok, wait = q.admit("vip")
+    assert ok and wait == 0.0
+    ok, wait = q.admit("vip")
+    assert not ok and wait > 0.0                  # burst 1, negligible refill
+    assert q.priority("vip") == 2
+    # Unlisted tenants ride the "*" default bucket (and its priority).
+    assert q.admit("anon")[0] and q.priority("anon") == 0
+    # Without any default, unknown tenants are unlimited.
+    q2 = TenantQuotas({"vip": {"rate": 1.0, "burst": 1.0, "priority": 1}})
+    for _ in range(50):
+        assert q2.admit("anon") == (True, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet pressure off replica heartbeats (the typed-429 admission signals).
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_pressure_admits_with_no_live_heartbeat(tmp_path):
+    """No live replica means startup / rolling restart, NOT overload: the
+    spool is durable, so the gateway admits and the requests wait."""
+    out = str(tmp_path)
+    p = fleet_pressure(out, 2.0)
+    assert p["live"] == 0 and not p["burning"] and not p["saturated"]
+    _gw_heartbeat(out, "w0", age=60.0)            # stale: presumed dead
+    _gw_heartbeat(out, "w1", status="done")       # exited
+    p = fleet_pressure(out, 2.0)
+    assert p["live"] == 0 and not p["burning"] and not p["saturated"]
+
+
+def test_fleet_pressure_burning_requires_all_live_replicas(tmp_path):
+    out = str(tmp_path)
+    _gw_heartbeat(out, "w0", fast=5.0)
+    _gw_heartbeat(out, "w1", fast=0.0)
+    p = fleet_pressure(out, 2.0)
+    assert p["live"] == 2 and not p["burning"]    # one healthy replica left
+    _gw_heartbeat(out, "w1", fast=3.0)
+    p = fleet_pressure(out, 2.0)
+    assert p["burning"] and p["max_fast"] == 5.0
+
+
+def test_fleet_pressure_saturated_and_retry_after_clamps(tmp_path):
+    out = str(tmp_path)
+    _gw_heartbeat(out, "w0", fast=0.0, width=4, free=0, queued=3)
+    p = fleet_pressure(out, 2.0)
+    assert p["saturated"] and not p["burning"]
+    # Free slots (or an empty queue) mean not saturated.
+    _gw_heartbeat(out, "w0", fast=0.0, width=4, free=1, queued=3)
+    assert not fleet_pressure(out, 2.0)["saturated"]
+    assert burn_retry_after({"max_fast": 0.0, "burn_cap": 2.0}) == 1
+    assert burn_retry_after({"max_fast": 4.0, "burn_cap": 2.0}) == 4
+    assert burn_retry_after({"max_fast": 1e6, "burn_cap": 2.0}) == 30
+    assert burn_retry_after({"max_fast": "?", "burn_cap": None}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Trace-header ingestion (obs.reqtrace): the socket-hop satellite.
+# ---------------------------------------------------------------------------
+
+
+def test_trace_header_roundtrip_and_malformed():
+    ctx = reqtrace.mint()
+    parsed = reqtrace.parse_header(reqtrace.format_header(ctx))
+    assert parsed is not None
+    assert parsed["trace_id"] == ctx["trace_id"]
+    # W3C 32-hex trace ids are accepted and truncated to the 16-hex form.
+    w3c = f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert reqtrace.parse_header(w3c)["trace_id"] == "ab" * 8
+    for bad in (None, "", "garbage", "00-zzzz-0000-01",
+                f"00-{'0' * 16}-{'cd' * 8}-01",       # all-zero trace id
+                "00-abcd-" + "cd" * 8 + "-01"):       # short trace id
+        assert reqtrace.parse_header(bad) is None
+
+
+def test_ensure_from_header_precedence():
+    # A context in the payload body wins over the header.
+    body_ctx = reqtrace.mint()
+    payload = {"id": "r", "prompt": "p", reqtrace.CTX_KEY: body_ctx}
+    hdr_ctx = reqtrace.mint()
+    out, ctx, minted = reqtrace.ensure_from_header(
+        payload, reqtrace.format_header(hdr_ctx))
+    assert not minted and ctx["trace_id"] == body_ctx["trace_id"]
+    # A valid header rides into the payload.
+    out, ctx, minted = reqtrace.ensure_from_header(
+        {"id": "r", "prompt": "p"}, reqtrace.format_header(hdr_ctx))
+    assert not minted and ctx["trace_id"] == hdr_ctx["trace_id"]
+    assert out[reqtrace.CTX_KEY]["trace_id"] == hdr_ctx["trace_id"]
+    # A malformed header re-mints (the gateway's one-shot warn keys on it).
+    out, ctx, minted = reqtrace.ensure_from_header(
+        {"id": "r", "prompt": "p"}, "not-a-traceparent")
+    assert minted and ctx["trace_id"]
+
+
+def test_iter_sse_parses_events():
+    body = io.BytesIO(
+        b"event: token\ndata: {\"n\": 1, \"tok\": 7}\n\n"
+        b"event: done\ndata: {\"ok\": true}\n\n")
+    events = list(iter_sse(body))
+    assert events == [("token", {"n": 1, "tok": 7}), ("done", {"ok": True})]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: cancellation, deadline expiry, priority lane (the replica-side
+# halves of the gateway contracts).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from taboo_brittleness_tpu.models import gemma2
+    from taboo_brittleness_tpu.ops import sae as sae_ops
+    from taboo_brittleness_tpu.runtime.tokenizer import WordTokenizer
+
+    cfg = gemma2.PRESETS["gemma2_tiny"]
+    params = gemma2.init_params(jax.random.PRNGKey(7), cfg)
+    tok = WordTokenizer(["ship", "moon", "hint", "clue", "secret", "word",
+                         "is", "My", "Give", "me", "a", "the", "about"],
+                        vocab_size=cfg.vocab_size)
+    sae = sae_ops.init_random(jax.random.PRNGKey(8), cfg.hidden_size, 64)
+    return params, cfg, tok, sae
+
+
+@pytest.fixture(scope="module")
+def engine2(tiny):
+    """One compiled 2-slot engine shared by the scheduler tests (stop_ids
+    disabled so decodes run their budget — deterministic step counts)."""
+    from taboo_brittleness_tpu.serve.engine import EngineConfig, ServeEngine
+
+    params, cfg, tok, sae = tiny
+    return ServeEngine(
+        params, cfg, tok,
+        engine_config=EngineConfig(
+            slots=2, max_context=48, prompt_cols=24, latent_slots=4,
+            proj_rank=2, sae_layer=2, proj_layer=2, tap_layer=2,
+            stop_ids=(-1,)),
+        sae=sae)
+
+
+def _req(rid, *, priority=0, deadline_at=None, max_new=4):
+    sc = default_scenarios(max_new_tokens=max_new)["chat"]
+    return Request(id=rid, prompt="Give me a hint", scenario=sc, seed=0,
+                   priority=priority, deadline_at=deadline_at)
+
+
+def test_scheduler_cancel_queued_resolves_typed(engine2):
+    done = []
+    sched = SlotScheduler(engine2, queue_limit=8,
+                          on_complete=done.append)
+    assert sched.submit(_req("q0")) and sched.submit(_req("q1"))
+    assert sched.cancel("q1") is True             # still queued: no decode
+    assert sched.cancel("nope") is False
+    assert [r.id for r in done] == ["q1"]
+    resp = done[0]
+    assert resp.ok is False and resp.finish == FINISH_CANCELED
+    assert resp.tokens == [] and sched.canceled == 1
+    # The untouched request still runs to completion.
+    for _ in range(50):
+        sched.step()
+        if len(done) == 2:
+            break
+    assert done[1].id == "q0" and done[1].ok
+
+
+def test_scheduler_cancel_in_flight_releases_slot(engine2):
+    done = []
+    sched = SlotScheduler(engine2, queue_limit=8,
+                          on_complete=done.append)
+    assert sched.submit(_req("c0", max_new=8))
+    sched.step()
+    assert sched.in_flight == 1
+    assert sched.cancel("c0") is True
+    assert sched.in_flight == 0 and sched.canceled == 1
+    resp = done[0]
+    assert resp.ok is False and resp.finish == FINISH_CANCELED
+    # The slot is genuinely free: the next request admits and completes.
+    assert sched.submit(_req("c1", max_new=2))
+    for _ in range(50):
+        sched.step()
+        if len(done) == 2:
+            break
+    assert done[1].id == "c1" and done[1].ok and done[1].finish == "budget"
+
+
+def test_scheduler_deadline_expired_in_queue_resolves_typed(engine2):
+    done = []
+    sched = SlotScheduler(engine2, queue_limit=8,
+                          on_complete=done.append)
+    # tbx: wallclock-ok — deadlines are cross-process epoch stamps
+    assert sched.submit(_req("late", deadline_at=time.time() - 1.0))
+    sched.step()                                  # pop → typed, never decoded
+    assert [r.id for r in done] == ["late"]
+    resp = done[0]
+    assert resp.ok is False and resp.finish == FINISH_DEADLINE
+    assert resp.tokens == [] and resp.steps == 0
+    assert sched.deadline_expired == 1 and sched.in_flight == 0
+
+
+def test_scheduler_priority_lane_drains_first(engine2):
+    done = []
+    sched = SlotScheduler(engine2, queue_limit=8,
+                          on_complete=done.append)
+    sched.set_slot_limit(1)                       # single admission lane
+    assert sched.submit(_req("a", max_new=2))
+    sched.step()                                  # a occupies the only slot
+    assert sched.submit(_req("b-low", max_new=2))
+    assert sched.submit(_req("c-high", max_new=2, priority=1))
+    for _ in range(100):
+        sched.step()
+        if len(done) == 3:
+            break
+    # The high-priority request jumped the earlier-submitted low one.
+    assert [r.id for r in done] == ["a", "c-high", "b-low"]
+    assert all(r.ok for r in done)
+
+
+# ---------------------------------------------------------------------------
+# Fault-site drills over a real socket (TBX206: gateway.accept /
+# gateway.spool_put / gateway.stream_write armed + fired).
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_fault_sites_drill(tmp_path):
+    """One gateway subprocess with all three sites armed fail-once:
+    an accept fault 500s before any routing, a spool_put fault 500s with
+    NOTHING spooled (the client got no ack, nothing leaks), and a
+    stream_write fault mid-SSE drops the socket and resolves the stream as
+    a cancel tombstone — the client's retry path, not a silent loss."""
+    out = str(tmp_path / "gw")
+    plan = {
+        "gateway.accept": {"mode": "fail", "times": 1},
+        "gateway.spool_put": {"mode": "fail", "times": 1},
+        "gateway.stream_write": {"mode": "fail", "times": 1},
+    }
+    proc, client = _start_gateway(
+        out, env=_env(TABOO_FAULT_PLAN=json.dumps(plan)))
+    spool = RequestSpool(out)
+    try:
+        # 1st request: the accept fault fires before routing → 500.
+        r1 = client.generate({"id": "f1", "prompt": "p", "scenario": "chat"})
+        assert r1["status"] == 500, r1
+        # 2nd request: accept exhausted, the spool_put fault fires BEFORE
+        # the durable write → 500 and an EMPTY spool (no half-accepted
+        # request leaks; the client knows to retry).
+        r2 = client.generate({"id": "f2", "prompt": "p", "scenario": "chat"})
+        assert r2["status"] == 500, r2
+        assert os.listdir(spool.requests_dir) == []
+        assert spool.get_response("f1") is None
+        assert spool.get_response("f2") is None
+        # 3rd request: accepted (200, durably spooled); the first SSE write
+        # faults → the gateway resolves the stream as canceled and drops
+        # the cancel tombstone for the owning replica.
+        conn, status, resp = client.open_stream(
+            {"id": "f3", "prompt": "p", "scenario": "chat"})
+        assert status == 200
+        assert os.path.exists(os.path.join(spool.requests_dir, "f3.json"))
+        _fake_tokens(spool, "f3", [7])            # play the replica
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not spool.is_canceled("f3"):
+            time.sleep(0.05)
+        close_stream(conn, resp)
+        assert spool.is_canceled("f3"), "stream_write fault left no tombstone"
+        st, stats = client.get_json("/v1/stats")
+        assert st == 200
+        assert stats["errors"] >= 2 and stats["canceled"] >= 1
+        assert stats["accepted"] == 1
+    finally:
+        _drain(proc)
+
+
+# ---------------------------------------------------------------------------
+# Socket semantics e2e (fake replica: the test writes streams/responses).
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_socket_semantics(tmp_path):
+    """Durable-before-ack, per-token SSE with exact prefix, deadline and
+    trace headers riding the spooled payload, client disconnect dropping
+    the cancel tombstone, one-shot malformed-header warn, 404/405, and
+    SIGTERM drain on 75 — one gateway process, no engine."""
+    out = str(tmp_path / "gw")
+    proc, client = _start_gateway(out)
+    spool = RequestSpool(out)
+    try:
+        st, hz = client.get_json("/v1/healthz")
+        assert st == 200 and hz["ok"] and not hz["draining"]
+        assert client.get_json("/v1/nope")[0] == 404
+        conn = client._connect()
+        conn.request("GET", "/v1/generate")
+        assert conn.getresponse().status == 405
+        conn.close()
+
+        # Durable ack + headers: once the 200 lands, the request file IS
+        # on disk with the deadline and the client's trace context.
+        ctx = reqtrace.mint()
+        conn, status, resp = client.open_stream(
+            {"id": "s0", "prompt": "Give me a hint", "scenario": "chat"},
+            tenant="acme", deadline_ms=60000, trace_ctx=ctx)
+        assert status == 200
+        req_path = os.path.join(spool.requests_dir, "s0.json")
+        assert os.path.exists(req_path), "200 before the durable spool put"
+        with open(req_path) as f:
+            spooled = json.load(f)
+        assert spooled["tenant"] == "acme"
+        assert spooled[reqtrace.CTX_KEY]["trace_id"] == ctx["trace_id"]
+        # tbx: wallclock-ok — asserting the epoch deadline stamp
+        assert 55.0 < spooled["deadline_at"] - time.time() < 61.0
+
+        # Streamed tokens are an exact prefix of the authoritative done.
+        _fake_tokens(spool, "s0", [7, 8, 9])
+        _fake_response(spool, "s0", tokens=[7, 8, 9], finish="eos")
+        toks, done = [], None
+        for event, data in iter_sse(resp):
+            if event == "token":
+                toks.append(data["tok"])
+            elif event == "done":
+                done = data
+                break
+        close_stream(conn, resp)
+        assert done and done["ok"] and done["finish"] == "eos"
+        assert toks == done["tokens"][:len(toks)] and toks == [7, 8, 9]
+
+        # Client disconnect mid-stream = cancellation tombstone.
+        conn, status, resp = client.open_stream(
+            {"id": "s1", "prompt": "p", "scenario": "chat"})
+        assert status == 200
+        _fake_tokens(spool, "s1", [5])
+        for event, _data in iter_sse(resp):
+            if event == "token":
+                break
+        close_stream(conn, resp)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not spool.is_canceled("s1"):
+            time.sleep(0.05)
+        assert spool.is_canceled("s1"), "disconnect left no cancel tombstone"
+
+        # Malformed X-Tbx-Trace: re-minted context + ONE warn total.
+        for rid in ("s2", "s3"):
+            _fake_response(spool, rid)            # resolves instantly
+            conn = client._connect()
+            conn.request("POST", "/v1/generate",
+                         body=json.dumps({"id": rid, "prompt": "p",
+                                          "scenario": "chat"}),
+                         headers={"Content-Type": "application/json",
+                                  "X-Tbx-Trace": "definitely-not-valid"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            close_stream(conn, resp)
+        with open(os.path.join(spool.requests_dir, "s2.json")) as f:
+            assert f.read().find('"trace_id"') >= 0   # minted at the edge
+        events_path = os.path.join(out, gw_mod.GATEWAY_EVENTS_FILENAME)
+        with open(events_path) as f:
+            warns = [ln for ln in f if '"gateway.bad_trace_header"' in ln]
+        assert len(warns) == 1, "malformed-header warn must be one-shot"
+    finally:
+        _drain(proc)
+    hb_path = os.path.join(out, gw_mod.GATEWAY_HEARTBEAT_FILENAME)
+    with open(hb_path) as f:
+        hb = json.load(f)
+    assert hb["draining"] is True and hb["open_streams"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Backpressure contract e2e: typed 429s with Retry-After, forced-low limits.
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_backpressure_contract(tmp_path):
+    """With the window and a tenant quota forced low and the fleet
+    pressure fabricated, over-limit traffic receives each typed 429 with a
+    Retry-After, while in-quota traffic keeps completing."""
+    out = str(tmp_path / "gw")
+    quota = {"vip": {"rate": 0.001, "burst": 1, "priority": 1}}
+    proc, client = _start_gateway(
+        out, window=1, env=_env(TBX_GATEWAY_QUOTA=json.dumps(quota)))
+    spool = RequestSpool(out)
+    try:
+        # Window: one held stream fills it; the next POST sheds queue-full.
+        conn, status, resp = client.open_stream(
+            {"id": "hold", "prompt": "p", "scenario": "chat"})
+        assert status == 200
+        shed = client.generate({"id": "q1", "prompt": "p",
+                                "scenario": "chat"})
+        assert shed["status"] == 429, shed
+        assert shed["reject"]["error"] == "queue-full"
+        assert shed["retry_after"] is not None
+        _fake_response(spool, "hold")             # release the window
+        for event, _data in iter_sse(resp):
+            if event == "done":
+                break
+        close_stream(conn, resp)
+
+        # Tenant quota: burst 1 at negligible refill → second vip sheds
+        # BEFORE it can occupy the window.
+        _fake_response(spool, "vip-0")
+        ok1 = client.generate({"id": "vip-0", "prompt": "p",
+                               "scenario": "chat"}, tenant="vip")
+        assert ok1["status"] == 200
+        shed = client.generate({"id": "vip-1", "prompt": "p",
+                                "scenario": "chat"}, tenant="vip")
+        assert shed["status"] == 429
+        assert shed["reject"]["error"] == "tenant-quota"
+        assert float(shed["reject"]["retry_after"]) > 0
+
+        # All live replicas burning → typed shed with burn-derived
+        # Retry-After (pressure cache TTL is 0.5s — let it roll over).
+        _gw_heartbeat(out, "w0", fast=50.0)
+        time.sleep(0.7)
+        shed = client.generate({"id": "b1", "prompt": "p",
+                                "scenario": "chat"})
+        assert shed["status"] == 429
+        assert shed["reject"]["error"] == "all-replicas-burning"
+        assert 1 <= int(shed["retry_after"]) <= 30
+
+        # Saturated (zero free slots, queue backed up) → fleet-saturated.
+        _gw_heartbeat(out, "w0", fast=0.0, width=4, free=0, queued=3)
+        time.sleep(0.7)
+        shed = client.generate({"id": "b2", "prompt": "p",
+                                "scenario": "chat"})
+        assert shed["status"] == 429
+        assert shed["reject"]["error"] == "fleet-saturated"
+
+        # Pressure clears → in-quota goodput resumes.
+        os.remove(os.path.join(out, "_progress.w0.json"))
+        time.sleep(0.7)
+        _fake_response(spool, "ok-0")
+        ok2 = client.generate({"id": "ok-0", "prompt": "p",
+                               "scenario": "chat"})
+        assert ok2["status"] == 200 and ok2["done"]["ok"]
+
+        st, stats = client.get_json("/v1/stats")
+        assert st == 200
+        for reason in ("queue-full", "tenant-quota",
+                       "all-replicas-burning", "fleet-saturated"):
+            assert stats["shed"].get(reason, 0) >= 1, (reason, stats)
+        assert stats["tenants"]["vip"]["shed"] >= 1
+        assert stats["accepted"] >= 3
+    finally:
+        _drain(proc)
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance e2e: SIGKILL the gateway mid-stream + fault-kill a
+# replica mid-decode under live socket load.
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_chaos_e2e(tmp_path, monkeypatch):
+    """Replica fleet behind a gateway under live socket load: replica w0
+    die'd mid-decode (lease-expiry → re-spool recovery), gateway g1
+    SIGKILLed mid-stream (loses ONLY sockets — the spooled backlog is
+    untouched and completes), a relaunched gateway g2 serves the same
+    spool, a client disconnect resolves typed ``canceled``, an expired
+    deadline resolves typed ``deadline-exceeded``, every accepted request
+    is answered exactly once, and the merged trace (gateway spans folded
+    in) stays green under ``trace_report --check``."""
+    out = str(tmp_path / "gw")
+    lease_s = 2.5
+    clue = "Give me a clue about the word"
+    # die = replica SIGKILL mid-decode; the matched delay pins the chaos
+    # victims mid-decode (forcing runs its full budget; 50 ms x 20 steps
+    # ≈ 1 s of stream time) so kills/disconnects land while decoding.
+    plan = {"serve.step": [
+        {"mode": "die", "times": 1, "match": "w0", "incarnation": 0},
+        {"mode": "delay", "delay": 0.05, "times": 100000,
+         "match": "slowreq"},
+    ]}
+    for k, v in _env().items():
+        monkeypatch.setenv(k, v)
+    os.makedirs(out, exist_ok=True)
+    spool = RequestSpool(out, fleet=True)
+    g1, client1 = _start_gateway(out, window=8, poll="0.02")
+    state = {"errors": [], "results": {}, "g2": None}
+    n_requests = 7          # 3 via g1 + kill victim + 3 via g2 (see _feed)
+
+    def _feed():
+        try:
+            # Stage 1: three requests to completion through g1 (they ride
+            # out the w0 die → lease expiry → re-spool underneath).
+            for i in range(3):
+                rid = f"g1-{i}"
+                state["results"][rid] = client1.generate(
+                    {"id": rid, "prompt": "Give me a hint about the word",
+                     "scenario": ("chat", "sae_ablate", "forcing")[i],
+                     "seed": i})
+            # Stage 2: open a slow stream and SIGKILL g1 mid-stream.  No
+            # tombstone is dropped (the gateway died, not the client), so
+            # the spooled request must still be answered.
+            conn, status, resp = client1.open_stream(
+                {"id": "slowreq-kill", "prompt": clue,
+                 "scenario": "forcing", "max_new_tokens": 20})
+            state["results"]["kill_status"] = status
+            if status == 200:
+                for event, _data in iter_sse(resp):
+                    if event == "token":
+                        break
+            g1.kill()
+            g1.wait()
+            close_stream(conn, resp)
+            # Stage 3: a relaunched gateway over the SAME spool keeps
+            # serving — durable state lived in the spool, not the process.
+            g2, client2 = _start_gateway(out, window=8, poll="0.02")
+            state["g2"] = g2
+            state["results"]["g2-0"] = client2.generate(
+                {"id": "g2-0", "prompt": "Give me a hint about the word",
+                 "scenario": "chat", "seed": 7})
+            # An already-expired deadline resolves typed at replica claim.
+            state["results"]["late"] = client2.generate(
+                {"id": "late", "prompt": "Give me a hint",
+                 "scenario": "chat"}, deadline_ms=1)
+            # Client disconnect mid-decode → typed canceled terminal.
+            conn, status, resp = client2.open_stream(
+                {"id": "slowreq-cancel", "prompt": clue,
+                 "scenario": "forcing", "max_new_tokens": 20})
+            state["results"]["cancel_status"] = status
+            if status == 200:
+                for event, _data in iter_sse(resp):
+                    if event == "token":
+                        break
+            close_stream(conn, resp)
+        except Exception as exc:  # noqa: BLE001 — surfaced by the asserts
+            state["errors"].append(f"{type(exc).__name__}: {exc}")
+
+    threading.Thread(target=_feed, daemon=True).start()
+    res = run_serve_fleet(
+        out,
+        replica_argv=lambda wid: [
+            sys.executable, "-m", "taboo_brittleness_tpu", "serve",
+            "--synthetic", "--output-dir", out, "--replica",
+            "--slots", "4", "--queue-limit", "8",
+            "--max-new-tokens", "20", "--poll", "0.05",
+            "--lease", str(lease_s)],
+        n_replicas=2,
+        replica_env={"JAX_PLATFORMS": "cpu",
+                     "TABOO_FAULT_PLAN": json.dumps(plan),
+                     "TBX_OBS_PROGRESS_S": "0.2",
+                     "TBX_SUPERVISE_BACKOFF_S": "0"},
+        lease_s=lease_s, poll_s=0.2, max_requests=n_requests,
+        max_wall_s=300.0, max_incarnations=4, supervise_poll=0.2,
+        grace=2.0, wedge_after=8.0,
+        policy=RetryPolicy(max_retries=6, base_delay=0.0))
+
+    assert state["errors"] == [], state["errors"]
+    assert res.status == "done" and res.exit_code == 0, res.to_dict()
+    if state["g2"] is not None:
+        _drain(state["g2"])
+
+    # The durable-ack contract: every accepted request answered exactly
+    # once — including the one whose gateway was SIGKILLed mid-stream.
+    rids = ["g1-0", "g1-1", "g1-2", "slowreq-kill", "g2-0", "late",
+            "slowreq-cancel"]
+    for rid in rids:
+        assert spool.get_response(rid) is not None, f"{rid} unanswered"
+    n_responses = sum(1 for n in os.listdir(spool.responses_dir)
+                      if n.endswith(".json"))
+    assert n_responses == n_requests
+    assert res.duplicate_commits == spool.duplicate_count()
+
+    # Streamed completions through both gateways carry prefix-exact SSE.
+    for rid in ("g1-0", "g1-1", "g1-2", "g2-0"):
+        r = state["results"][rid]
+        assert r["status"] == 200 and r["done"]["ok"], (rid, r)
+        toks = [t["tok"] for t in r["tokens"]]
+        assert toks == r["done"]["tokens"][:len(toks)], rid
+    # The gateway-kill victim was mid-stream when g1 died: no client
+    # disconnect was ever observed, so it completes NORMALLY.
+    assert state["results"]["kill_status"] == 200
+    kill_resp = spool.get_response("slowreq-kill")
+    assert kill_resp["ok"] is True, kill_resp
+    # Typed terminals: deadline at claim, cancel between steps.
+    late = state["results"]["late"]
+    assert late["status"] == 200
+    assert late["done"]["finish"] == FINISH_DEADLINE, late
+    cancel_resp = spool.get_response("slowreq-cancel")
+    assert cancel_resp["finish"] == FINISH_CANCELED, cancel_resp
+
+    # The w0 die burned an incarnation and recovery rode the lease path.
+    incs = {r["worker_id"]: r["incarnations"] for r in res.replicas}
+    assert incs["w0"] >= 2, f"w0 was never killed+relaunched: {incs}"
+    assert res.lease_expiries >= 1 and res.respooled >= 1, res.to_dict()
+    assert _no_corrupt(out) == []
+    spool.gc_claimed(force=True)
+
+    # Fold the gateway's event stream (g1's SIGKILL-dangling spans get
+    # synthesized closes, exactly like a killed replica's) and gate the
+    # merged stream — check_request_traces runs inside --check and must
+    # accept the gateway-parented first_token points.
+    merged = os.path.join(out, "_events.jsonl")
+    assert fleet_mod.merge_events(out, ["gateway"]) > 0
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--check", merged],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+    events = [json.loads(ln) for ln in open(merged) if ln.strip()]
+    gw_spans = [e for e in events if e.get("ev") == "start"
+                and e.get("kind") == "gateway"]
+    assert gw_spans, "no gateway spans in the merged stream"
+    gw_firsts = [e for e in events if e.get("ev") == "point"
+                 and e.get("name") == reqtrace.FIRST_TOKEN_POINT
+                 and (e.get("attrs") or {}).get("source") == "gateway"]
+    assert gw_firsts, "no gateway-side serve.first_token joined"
+    # The clean cancel's terminal is the scheduler's typed close, never
+    # the fleet-merge's synthesized error.
+    cancel_span_ids = {e["id"] for e in events if e.get("ev") == "start"
+                       and e.get("kind") == "request"
+                       and (e.get("attrs") or {}).get("request")
+                       == "slowreq-cancel"}
+    cancel_ends = [e for e in events if e.get("ev") == "end"
+                   and e.get("id") in cancel_span_ids
+                   and (e.get("attrs") or {}).get("terminal")]
+    assert cancel_ends, "canceled request has no terminal span end"
+    assert all(not (e.get("attrs") or {}).get("synthesized")
+               for e in cancel_ends)
+    assert any((e.get("attrs") or {}).get("finish") == FINISH_CANCELED
+               for e in cancel_ends)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the gateway_latency regression gate.
+# ---------------------------------------------------------------------------
+
+
+def _write_round(tmp_path, n, extra):
+    payload = {"n": n, "parsed": {"value": 20.0, **extra}}
+    with open(str(tmp_path / f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_compare_gateway_latency_within_band(tmp_path):
+    _write_round(tmp_path, 1, {"gateway_latency": {"ttft_p99": 0.40}})
+    _write_round(tmp_path, 2, {"gateway_latency": {"ttft_p99": 0.55}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0 and not regressions
+
+
+def test_bench_compare_gateway_latency_flags_regression(tmp_path):
+    _write_round(tmp_path, 1, {"gateway_latency": {"ttft_p99": 0.40}})
+    _write_round(tmp_path, 2, {"gateway_latency": {"ttft_p99": 0.90}})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 1
+    assert any("gateway_latency.ttft_p99" in r for r in regressions)
+
+
+def test_bench_compare_gateway_latency_missing_is_skipped(tmp_path):
+    """A round that ran with BENCH_GATEWAY=0 has no gateway headline —
+    skip with a note, never a crash or a false regression."""
+    _write_round(tmp_path, 1, {"gateway_latency": {"ttft_p99": 0.40}})
+    _write_round(tmp_path, 2, {})
+    lines, regressions, rc = bench_compare.compare(str(tmp_path))
+    assert rc == 0
+    assert any("gateway_latency.ttft_p99" in line and "skipped" in line
+               for line in lines)

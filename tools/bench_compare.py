@@ -88,6 +88,13 @@ METRICS: Dict[str, Tuple[float, bool, float]] = {
     # relaunch + lease + poll intervals, so run-to-run jitter is
     # structural.
     "serve_fleet_recovery.recovery_seconds": (0.50, False, 0.0),
+    # Network front door (bench.py gateway_latency, ISSUE 20): network
+    # TTFT p99 through the HTTP+SSE gateway hop must not creep up.  Same
+    # wide ±50% band as the other control-plane stages: the path crosses
+    # two subprocesses, socket transit and tail-poll intervals, so
+    # run-to-run jitter is structural.  Skipped with a note on rounds that
+    # ran without the stage (BENCH_GATEWAY=0).
+    "gateway_latency.ttft_p99": (0.50, False, 0.0),
     # Base-resident delta switch (bench.py delta_switch, ISSUE 12): the
     # word-switch latency over the resident base must not creep up (wide
     # ±50% band: the path crosses filesystem reads, so run-to-run jitter is

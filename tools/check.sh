@@ -91,6 +91,13 @@ if [ "$FAST" -eq 0 ]; then
   gate "serve loadgen selfcheck" \
     env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu loadgen --selfcheck
 
+  # Network front door: loopback socket smoke over a real serve subprocess
+  # — N streamed completions, one mid-stream client-disconnect cancel, one
+  # over-quota 429 (+Retry-After), 413/400 rejects, exactly-once responses,
+  # SIGTERM drain on 75 for both processes.
+  gate "gateway selfcheck" \
+    env JAX_PLATFORMS=cpu python -m taboo_brittleness_tpu gateway --selfcheck
+
   # Tensor-parallel serving parity: spool identical traffic through a
   # tp=2-sharded engine and an unsharded reference on a forced 8-device
   # host mesh; token streams must match bit-for-bit and the tp arm must

@@ -897,8 +897,10 @@ def check_request_traces(path: str,
       attempt: when the run completed, a later attempt must carry the
       terminal for that request;
     - an ok terminal that emitted tokens must carry ``ttft_seconds``, and
-      every ``serve.first_token`` point must parent into a request span of
-      the same request (the TTFT event is causally attached, not floating).
+      every ``serve.first_token`` point must parent into a request span —
+      or a ``gateway``-kind span (the gateway emits first_token at SSE
+      stream start, parented to ITS per-request span; ISSUE 20) — of the
+      same request (the TTFT event is causally attached, not floating).
     """
     errors: List[str] = []
     spans, points = build_spans(events)
@@ -975,7 +977,7 @@ def check_request_traces(path: str,
         attrs = p.get("attrs") or {}
         req = str(attrs.get("request"))
         parent = spans.get(p.get("parent"))
-        if parent is None or parent.kind != "request":
+        if parent is None or parent.kind not in ("request", "gateway"):
             errors.append(
                 f"{path}: serve.first_token for request {req} does not "
                 "parent into a request span (floating TTFT event)")
